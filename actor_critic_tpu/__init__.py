@@ -1,0 +1,25 @@
+"""TPU-native actor-critic RL framework (JAX/XLA/Flax).
+
+A ground-up rebuild of the capabilities of the reference
+`Jiths/Actor-Critic-Algs-on-Tensorflow` (spec: BASELINE.json:5-12; the
+reference mount was empty at survey time, see SURVEY.md §0) designed
+TPU-first:
+
+- compute path: jit-compiled XLA programs (fused rollout+GAE+update),
+- parallelism: `jax.sharding.Mesh` + `shard_map` with ICI collectives
+  (replacing the reference's tf.distribute MirroredStrategy/NCCL path),
+- off-policy replay: donated HBM ring buffer,
+- environments: pure-JAX vmapped envs for throughput, host gymnasium/MuJoCo
+  pools for continuous control.
+
+Package layout (SURVEY.md §7.1):
+    models/    encoders (MLP/CNN), policy/value heads, distributions
+    ops/       pure math: GAE / λ-returns / V-trace scans, polyak, losses
+    parallel/  device mesh, shard_map data-parallel wrapper, collectives
+    envs/      JaxEnv protocol + pure-JAX envs; HostEnvPool for gym/MuJoCo
+    replay/    HBM-resident ring replay buffer
+    algos/     A2C, PPO, DDPG, TD3, SAC, IMPALA trainers
+    utils/     PRNG plumbing, config, logging, checkpointing
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,240 @@
+"""A2C — synchronous advantage actor-critic, fully fused on-device.
+
+Capability parity with the reference's A2C CartPole config
+(BASELINE.json:7; reference mount empty at survey, SURVEY.md §0), built
+the TPU way: one jitted program per train step containing
+
+    lax.scan over T: [policy fwd → vmapped env.step]   (rollout)
+    → GAE reverse scan                                  (targets)
+    → policy-gradient + value-MSE + entropy loss        (update)
+    → optax update (grads pmean-ed over the dp mesh axis)
+
+so the host is touched once per iteration, not once per env step — the
+design that makes the ≥1M env-steps/sec north star (BASELINE.json:5)
+reachable where the reference's host-stepped loop cannot.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from actor_critic_tpu.algos.common import (
+    RolloutState,
+    TrainState,
+    Transition,
+    episode_metrics_update,
+    init_rollout,
+    rollout_scan,
+    truncation_bootstrap_rewards,
+)
+from actor_critic_tpu.envs.jax_env import JaxEnv
+from actor_critic_tpu.models.networks import ActorCriticDiscrete, ActorCriticGaussian
+from actor_critic_tpu.ops.returns import gae, normalize_advantages
+from actor_critic_tpu.parallel import mesh as pmesh
+
+
+@dataclasses.dataclass(frozen=True)
+class A2CConfig:
+    num_envs: int = 64
+    rollout_steps: int = 16  # T
+    gamma: float = 0.99
+    gae_lambda: float = 0.95
+    lr: float = 3e-4
+    value_coef: float = 0.5
+    entropy_coef: float = 0.01
+    max_grad_norm: float = 0.5
+    hidden: tuple[int, ...] = (64, 64)
+    normalize_adv: bool = False
+    # bfloat16 activations for MXU throughput; params/optimizer stay fp32.
+    bf16_compute: bool = False
+
+
+def make_network(env: JaxEnv, cfg: A2CConfig):
+    dtype = jnp.bfloat16 if cfg.bf16_compute else jnp.float32
+    if env.spec.discrete:
+        return ActorCriticDiscrete(
+            num_actions=env.spec.action_dim, hidden=cfg.hidden, compute_dtype=dtype
+        )
+    return ActorCriticGaussian(
+        action_dim=env.spec.action_dim, hidden=cfg.hidden, compute_dtype=dtype
+    )
+
+
+def make_optimizer(cfg: A2CConfig) -> optax.GradientTransformation:
+    return optax.chain(
+        optax.clip_by_global_norm(cfg.max_grad_norm),
+        optax.adam(cfg.lr),
+    )
+
+
+def init_state(env: JaxEnv, cfg: A2CConfig, key: jax.Array) -> TrainState:
+    net = make_network(env, cfg)
+    opt = make_optimizer(cfg)
+    key, pkey, rkey = jax.random.split(key, 3)
+    dummy = jnp.zeros((1, *env.spec.obs_shape), env.spec.obs_dtype)
+    params = net.init(pkey, dummy)
+    rstate = init_rollout(env, rkey, cfg.num_envs)
+    E = cfg.num_envs
+    return TrainState(
+        params=params,
+        opt_state=opt.init(params),
+        rollout=rstate,
+        key=key,
+        update_step=jnp.zeros((), jnp.int32),
+        ep_return=jnp.zeros((E,)),
+        ep_length=jnp.zeros((E,)),
+        avg_return=jnp.zeros(()),
+    )
+
+
+def a2c_loss(
+    params: Any,
+    apply_fn: Callable,
+    traj: Transition,
+    advantages: jax.Array,
+    returns: jax.Array,
+    cfg: A2CConfig,
+) -> tuple[jax.Array, dict[str, jax.Array]]:
+    """Policy-gradient + value-MSE + entropy-bonus loss on a [T, E] batch.
+
+    Re-evaluates the policy at the stored obs (same params as rollout, so
+    ratio==1; the re-evaluation is what makes the loss differentiable).
+    """
+    obs = traj.obs.reshape(-1, *traj.obs.shape[2:])
+    actions = traj.action.reshape(-1, *traj.action.shape[2:])
+    adv = advantages.reshape(-1)
+    ret = returns.reshape(-1)
+    if cfg.normalize_adv:
+        adv = normalize_advantages(adv)
+
+    dist, value = apply_fn(params, obs)
+    log_prob = dist.log_prob(actions)
+    entropy = jnp.mean(dist.entropy())
+
+    pg_loss = -jnp.mean(jax.lax.stop_gradient(adv) * log_prob)
+    v_loss = 0.5 * jnp.mean((value - jax.lax.stop_gradient(ret)) ** 2)
+    loss = pg_loss + cfg.value_coef * v_loss - cfg.entropy_coef * entropy
+    return loss, {
+        "loss": loss,
+        "pg_loss": pg_loss,
+        "v_loss": v_loss,
+        "entropy": entropy,
+    }
+
+
+def make_train_step(
+    env: JaxEnv,
+    cfg: A2CConfig,
+    axis_name: Optional[str] = None,
+) -> Callable[[TrainState], tuple[TrainState, dict[str, jax.Array]]]:
+    """Build the fused train step. `axis_name` names the dp mesh axis when
+    running under shard_map (grads/metrics pmean-ed over it); None for
+    single-device."""
+    net = make_network(env, cfg)
+    opt = make_optimizer(cfg)
+    apply_fn = net.apply
+
+    def train_step(state: TrainState) -> tuple[TrainState, dict[str, jax.Array]]:
+        key, rkey = jax.random.split(state.key)
+
+        # --- rollout (T steps, E envs, on-device) ---
+        new_rollout, traj = rollout_scan(
+            env, apply_fn, state.params, state.rollout, rkey, cfg.rollout_steps
+        )
+
+        # --- targets ---
+        _, bootstrap_value = apply_fn(state.params, new_rollout.obs)
+        # Value of pre-reset final obs for truncation bootstrap.
+        T, E = traj.reward.shape
+        _, final_values = apply_fn(
+            state.params, traj.final_obs.reshape(T * E, *traj.final_obs.shape[2:])
+        )
+        rewards = truncation_bootstrap_rewards(
+            traj, final_values.reshape(T, E), cfg.gamma
+        )
+        advantages, returns = gae(
+            rewards, traj.value, traj.done, bootstrap_value, cfg.gamma, cfg.gae_lambda
+        )
+
+        # --- update ---
+        grad_fn = jax.value_and_grad(a2c_loss, has_aux=True)
+        (_, metrics), grads = grad_fn(
+            state.params, apply_fn, traj, advantages, returns, cfg
+        )
+        grads = pmesh.pmean_tree(grads, axis_name)
+        updates, new_opt_state = opt.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+
+        # --- metrics / accounting ---
+        ep_ret, ep_len, avg_ret, ep_metrics = episode_metrics_update(
+            state.ep_return, state.ep_length, state.avg_return, traj
+        )
+        # Keep the EMA replicated across the dp axis (it is part of the
+        # replicated state; per-device episode streams would diverge).
+        avg_ret = pmesh.pmean(avg_ret, axis_name)
+        metrics.update(ep_metrics)
+        metrics = {k: pmesh.pmean(v, axis_name) for k, v in metrics.items()}
+
+        new_state = TrainState(
+            params=new_params,
+            opt_state=new_opt_state,
+            rollout=new_rollout,
+            key=key,
+            update_step=state.update_step + 1,
+            ep_return=ep_ret,
+            ep_length=ep_len,
+            avg_return=avg_ret,
+        )
+        return new_state, metrics
+
+    return train_step
+
+
+def train(
+    env: JaxEnv,
+    cfg: A2CConfig,
+    num_iterations: int,
+    seed: int = 0,
+    state: Optional[TrainState] = None,
+    log_every: int = 0,
+    log_fn: Optional[Callable[[int, dict], None]] = None,
+) -> tuple[TrainState, dict[str, jax.Array]]:
+    """Simple host loop around the fused step (single device).
+
+    For N iterations without host logging, the loop body is itself scanned
+    on-device (`log_every=0`) so the host dispatches O(1) programs.
+    """
+    if state is None:
+        state = init_state(env, cfg, jax.random.key(seed))
+    step = make_train_step(env, cfg)
+
+    if log_every <= 0:
+        if num_iterations < 1:
+            raise ValueError("num_iterations must be >= 1")
+
+        @jax.jit
+        def run(state):
+            def body(s, _):
+                s, m = step(s)
+                return s, None
+            s, _ = jax.lax.scan(body, state, None, length=num_iterations - 1)
+            # exactly num_iterations updates; last one returns the metrics
+            s, m = step(s)
+            return s, m
+
+        state, metrics = run(state)
+        return state, metrics
+
+    jit_step = jax.jit(step, donate_argnums=0)
+    metrics = {}
+    for it in range(num_iterations):
+        state, metrics = jit_step(state)
+        if log_fn is not None and (it + 1) % log_every == 0:
+            log_fn(it + 1, {k: float(v) for k, v in metrics.items()})
+    return state, metrics

@@ -1,0 +1,157 @@
+"""Shared trainer plumbing: transition pytrees, train state, rollout scan.
+
+The fused on-device rollout is the framework's answer to the reference's
+per-step host↔device ping-pong (SURVEY.md §3.1 boundary analysis;
+reference mount empty, §0): `lax.scan` over T timesteps of
+(policy forward → vmapped env step), with the whole thing living inside
+one jitted train step (BASELINE.json:5 north star).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from actor_critic_tpu.envs.jax_env import JaxEnv
+
+
+class Transition(NamedTuple):
+    """One time-slice of a vmapped rollout; arrays are [T, E, ...] after scan."""
+
+    obs: jax.Array
+    action: jax.Array
+    log_prob: jax.Array
+    value: jax.Array
+    reward: jax.Array
+    done: jax.Array        # episode ended this step (term or trunc)
+    terminated: jax.Array  # true termination (cuts bootstrap)
+    final_obs: jax.Array   # pre-reset obs of the step (== next obs if not done)
+
+
+class RolloutState(NamedTuple):
+    """Carry of the rollout scan (per-env state + current obs)."""
+
+    env_state: Any
+    obs: jax.Array
+
+
+class TrainState(NamedTuple):
+    """On-policy trainer state. Total env steps = update_step · T · E,
+    computed on the host (int32-on-device would wrap within ~36 min at the
+    1M steps/s target)."""
+
+    params: Any
+    opt_state: Any
+    rollout: RolloutState
+    key: jax.Array
+    update_step: jax.Array  # number of train_step calls
+    # Running episode-return accounting (per env).
+    ep_return: jax.Array
+    ep_length: jax.Array
+    # Exponential-moving stats of completed-episode returns, for metrics.
+    avg_return: jax.Array
+
+
+def init_rollout(env: JaxEnv, key: jax.Array, num_envs: int) -> RolloutState:
+    keys = jax.random.split(key, num_envs)
+    env_state, obs = jax.vmap(env.reset)(keys)
+    return RolloutState(env_state=env_state, obs=obs)
+
+
+def rollout_scan(
+    env: JaxEnv,
+    apply_fn: Callable[[Any, jax.Array], tuple[Any, jax.Array]],
+    params: Any,
+    rstate: RolloutState,
+    key: jax.Array,
+    num_steps: int,
+) -> tuple[RolloutState, Transition]:
+    """Collect `num_steps` of experience from the vmapped env batch.
+
+    `apply_fn(params, obs) -> (dist, value)`; actions are sampled per env
+    with per-step keys. Returns time-major Transition with arrays
+    [T, E, ...].
+    """
+
+    def step_fn(carry: RolloutState, step_key: jax.Array):
+        dist, value = apply_fn(params, carry.obs)
+        n_envs = carry.obs.shape[0]
+        akeys = jax.random.split(step_key, n_envs)
+        action = jax.vmap(lambda d, k: d.sample(k), in_axes=(0, 0))(dist, akeys)
+        log_prob = jax.vmap(lambda d, a: d.log_prob(a))(dist, action)
+        out = jax.vmap(env.step)(carry.env_state, action)
+        trans = Transition(
+            obs=carry.obs,
+            action=action,
+            log_prob=log_prob,
+            value=value,
+            reward=out.reward,
+            done=out.done,
+            terminated=out.info["terminated"],
+            final_obs=out.info["final_obs"],
+        )
+        return RolloutState(env_state=out.state, obs=out.obs), trans
+
+    step_keys = jax.random.split(key, num_steps)
+    return jax.lax.scan(step_fn, rstate, step_keys)
+
+
+def truncation_bootstrap_rewards(
+    traj: Transition,
+    final_values: jax.Array,
+    gamma: float,
+) -> jax.Array:
+    """Patch rewards so truncated (not terminated) episode ends bootstrap.
+
+    r_t ← r_t + γ·V(final_obs_t) where the episode was truncated at t.
+    With this patch, `gae` can treat `done` as a hard cut (SURVEY §7.2.5:
+    correct time-limit handling without branching inside the scan).
+    """
+    truncated = traj.done * (1.0 - traj.terminated)
+    return traj.reward + gamma * final_values * truncated
+
+
+def episode_metrics_update(
+    ep_return: jax.Array,
+    ep_length: jax.Array,
+    avg_return: jax.Array,
+    traj: Transition,
+    decay: float = 0.99,
+) -> tuple[jax.Array, jax.Array, jax.Array, dict[str, jax.Array]]:
+    """Fold a [T, E] trajectory into running per-env episode accounting.
+
+    Returns updated (ep_return, ep_length, avg_return EMA, metrics).
+    Runs inside jit; O(T·E) elementwise.
+    """
+
+    def fold(carry, x):
+        ep_ret, ep_len, avg, n_done, sum_done = carry
+        reward, done = x
+        ep_ret = ep_ret + reward
+        ep_len = ep_len + 1.0
+        n_done = n_done + jnp.sum(done)
+        sum_done = sum_done + jnp.sum(ep_ret * done)
+        # EMA over completed episodes (batch-mean of finished returns).
+        batch_done = jnp.sum(done)
+        batch_mean = jnp.where(
+            batch_done > 0, jnp.sum(ep_ret * done) / jnp.maximum(batch_done, 1.0), avg
+        )
+        avg = jnp.where(batch_done > 0, decay * avg + (1 - decay) * batch_mean, avg)
+        ep_ret = ep_ret * (1.0 - done)
+        ep_len = ep_len * (1.0 - done)
+        return (ep_ret, ep_len, avg, n_done, sum_done), None
+
+    (ep_return, ep_length, avg_return, n_done, sum_done), _ = jax.lax.scan(
+        fold,
+        (ep_return, ep_length, avg_return, jnp.zeros(()), jnp.zeros(())),
+        (traj.reward, traj.done),
+    )
+    metrics = {
+        "episodes_finished": n_done,
+        "mean_finished_return": sum_done / jnp.maximum(n_done, 1.0),
+        "avg_return_ema": avg_return,
+    }
+    return ep_return, ep_length, avg_return, metrics

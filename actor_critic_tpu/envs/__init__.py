@@ -1,0 +1,18 @@
+from actor_critic_tpu.envs.jax_env import EnvSpec, JaxEnv, StepOutput, auto_reset
+from actor_critic_tpu.envs.cartpole import make_cartpole
+from actor_critic_tpu.envs.testbeds import (
+    make_bandit,
+    make_point_mass,
+    make_two_state_mdp,
+)
+
+__all__ = [
+    "EnvSpec",
+    "JaxEnv",
+    "StepOutput",
+    "auto_reset",
+    "make_bandit",
+    "make_cartpole",
+    "make_point_mass",
+    "make_two_state_mdp",
+]

@@ -1,0 +1,87 @@
+"""Pure-JAX CartPole-v1 with exact gymnasium dynamics.
+
+Replaces the reference's host-stepped `gym.make("CartPole-v1")`
+(BASELINE.json:7; reference mount empty, SURVEY.md §0) with an on-device
+vmappable env so the A2C rollout+update is one fused XLA program — the
+≥1M env-steps/sec north-star config (BASELINE.json:5).
+
+Dynamics, thresholds, reset distribution, reward (+1 every step, incl.
+the terminating one) and the 500-step time limit match gymnasium 1.2.2's
+`CartPoleEnv` (verified numerically in tests/test_envs.py against the
+installed gymnasium).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from actor_critic_tpu.envs.jax_env import EnvSpec, JaxEnv, StepOutput, auto_reset
+
+GRAVITY = 9.8
+MASSCART = 1.0
+MASSPOLE = 0.1
+TOTAL_MASS = MASSCART + MASSPOLE
+LENGTH = 0.5  # half the pole's length
+POLEMASS_LENGTH = MASSPOLE * LENGTH
+FORCE_MAG = 10.0
+TAU = 0.02
+THETA_THRESHOLD = 12 * 2 * jnp.pi / 360
+X_THRESHOLD = 2.4
+MAX_STEPS = 500
+
+
+class CartPoleState(NamedTuple):
+    x: jax.Array
+    x_dot: jax.Array
+    theta: jax.Array
+    theta_dot: jax.Array
+    t: jax.Array  # step count for the TimeLimit truncation
+    key: jax.Array
+
+
+def _obs(s: CartPoleState) -> jax.Array:
+    return jnp.stack([s.x, s.x_dot, s.theta, s.theta_dot]).astype(jnp.float32)
+
+
+def _reset(key: jax.Array) -> tuple[CartPoleState, jax.Array]:
+    key, sub = jax.random.split(key)
+    vals = jax.random.uniform(sub, (4,), jnp.float32, -0.05, 0.05)
+    state = CartPoleState(
+        x=vals[0], x_dot=vals[1], theta=vals[2], theta_dot=vals[3],
+        t=jnp.zeros((), jnp.int32), key=key,
+    )
+    return state, _obs(state)
+
+
+def _raw_step(state: CartPoleState, action: jax.Array):
+    force = jnp.where(action == 1, FORCE_MAG, -FORCE_MAG).astype(jnp.float32)
+    costheta = jnp.cos(state.theta)
+    sintheta = jnp.sin(state.theta)
+    temp = (force + POLEMASS_LENGTH * state.theta_dot**2 * sintheta) / TOTAL_MASS
+    thetaacc = (GRAVITY * sintheta - costheta * temp) / (
+        LENGTH * (4.0 / 3.0 - MASSPOLE * costheta**2 / TOTAL_MASS)
+    )
+    xacc = temp - POLEMASS_LENGTH * thetaacc * costheta / TOTAL_MASS
+    # gymnasium's default Euler integrator
+    x = state.x + TAU * state.x_dot
+    x_dot = state.x_dot + TAU * xacc
+    theta = state.theta + TAU * state.theta_dot
+    theta_dot = state.theta_dot + TAU * thetaacc
+    t = state.t + 1
+
+    nstate = CartPoleState(x, x_dot, theta, theta_dot, t, state.key)
+    terminated = (
+        (jnp.abs(x) > X_THRESHOLD) | (jnp.abs(theta) > THETA_THRESHOLD)
+    ).astype(jnp.float32)
+    truncated = (t >= MAX_STEPS).astype(jnp.float32) * (1.0 - terminated)
+    reward = jnp.ones((), jnp.float32)
+    return nstate, _obs(nstate), reward, terminated, truncated
+
+
+def make_cartpole() -> JaxEnv:
+    spec = EnvSpec(obs_shape=(4,), action_dim=2, discrete=True)
+    step = auto_reset(_reset, _raw_step, key_of_state=lambda s: s.key)
+    return JaxEnv(spec=spec, reset=_reset, step=step)

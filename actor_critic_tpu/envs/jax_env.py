@@ -1,0 +1,105 @@
+"""Pure-JAX environment protocol — the on-device rollout substrate.
+
+The reference steps host environments (gym classic-control / MuJoCo / ALE)
+one process boundary away from the device (SURVEY.md §3.1 boundary
+analysis; reference mount empty, SURVEY.md §0). On TPU that ping-pong is
+the throughput killer, so the framework's first-class env interface is a
+*functional* one: `reset` and `step` are pure jit-safe functions over an
+explicit state pytree, vmapped over thousands of env instances and fused
+into the training step (north star ≥1M steps/s, BASELINE.json:5).
+
+Conventions:
+- `reset(key) -> (state, obs)`;
+  `step(state, action) -> (state, obs, reward, done, info)`.
+- `done` is 1.0 at a step that *ends* the episode (termination OR
+  truncation); `info["terminated"]` distinguishes true termination so GAE
+  can bootstrap through time-limit truncations.
+- `step` must auto-reset: when an episode ends, the returned state/obs are
+  from a fresh episode (the returned `obs` is the new episode's first obs;
+  the pre-reset terminal obs is in `info["final_obs"]`). This keeps the
+  vmapped batch rectangular with no host intervention.
+- Everything is float32; shapes static; randomness via explicit keys
+  threaded in `state`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class StepOutput(NamedTuple):
+    state: Any  # env state pytree (post auto-reset)
+    obs: jax.Array
+    reward: jax.Array
+    done: jax.Array  # 1.0 where episode ended this step (term or trunc)
+    info: dict[str, jax.Array]
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvSpec:
+    """Static metadata a trainer needs to build networks."""
+
+    obs_shape: tuple[int, ...]
+    action_dim: int  # num discrete actions, or continuous action dims
+    discrete: bool
+    obs_dtype: Any = jnp.float32
+
+
+@dataclasses.dataclass(frozen=True)
+class JaxEnv:
+    """A pure-functional environment: a spec plus reset/step closures.
+
+    Instances are static (hashable) so they can be closed over by jitted
+    trainers without retracing.
+    """
+
+    spec: EnvSpec
+    reset: Callable[[jax.Array], tuple[Any, jax.Array]]
+    step: Callable[[Any, jax.Array], StepOutput]
+
+    def __hash__(self):
+        return id(self)
+
+    def __eq__(self, other):
+        return self is other
+
+
+def auto_reset(
+    reset_fn: Callable[[jax.Array], tuple[Any, jax.Array]],
+    raw_step: Callable[[Any, jax.Array], tuple[Any, jax.Array, jax.Array, jax.Array, jax.Array]],
+    key_of_state: Callable[[Any], jax.Array],
+) -> Callable[[Any, jax.Array], StepOutput]:
+    """Wrap a raw step (no reset logic) into the auto-resetting protocol.
+
+    `raw_step(state, action) -> (state, obs, reward, terminated, truncated)`.
+    On done, replaces state/obs with a fresh `reset` (keyed off the env
+    state's PRNG key) via `lax.cond`-free `tree.map(where)` select — branchless,
+    so the vmapped batch stays a single fused program.
+    """
+
+    def step(state, action) -> StepOutput:
+        nstate, obs, reward, terminated, truncated = raw_step(state, action)
+        done = jnp.maximum(terminated, truncated)
+        key = key_of_state(nstate)
+        reset_key, _ = jax.random.split(key)
+        rstate, robs = reset_fn(reset_key)
+
+        def select(a, b):
+            d = done.reshape(done.shape + (1,) * (a.ndim - done.ndim))
+            return jnp.where(d.astype(jnp.bool_), a, b)
+
+        out_state = jax.tree.map(select, rstate, nstate)
+        out_obs = select(robs, obs)
+        return StepOutput(
+            state=out_state,
+            obs=out_obs,
+            reward=reward,
+            done=done,
+            info={"terminated": terminated, "final_obs": obs},
+        )
+
+    return step

@@ -1,0 +1,127 @@
+"""Analytic micro-environments with known optima (SURVEY.md §4).
+
+Used by the integration ("learning") tests: each algorithm must drive these
+to their known optimal policy/value in a few hundred steps. Pure JAX, same
+protocol as cartpole.py — each env is a raw step wrapped by
+`auto_reset`, so the reset/final_obs semantics live in exactly one place.
+
+- `make_bandit(payouts)`: single-step bandit; optimal policy picks
+  argmax(payouts); optimal V = max(payouts).
+- `make_two_state_mdp()`: 2 states, 2 actions, deterministic transitions;
+  always taking action 1 is optimal (reward 1 per step); with the
+  truncation-bootstrap reward patch the critic's fixed point is the
+  infinite-horizon V* = 1/(1-γ).
+- `make_point_mass()`: 1-d continuous-action point mass; reward −(pos+a)²;
+  optimal action = −pos; tests Gaussian/tanh policies.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from actor_critic_tpu.envs.jax_env import EnvSpec, JaxEnv, auto_reset
+
+
+class _KeyState(NamedTuple):
+    key: jax.Array
+    t: jax.Array
+
+
+def make_bandit(payouts=(0.2, 0.9, 0.4)) -> JaxEnv:
+    """One-step episodes: obs is constant [1.0]; reward = payouts[action]."""
+    payouts_arr = jnp.asarray(payouts, jnp.float32)
+    obs0 = jnp.ones((1,), jnp.float32)
+
+    def reset(key):
+        key, _ = jax.random.split(key)
+        return _KeyState(key=key, t=jnp.zeros((), jnp.int32)), obs0
+
+    def raw_step(state, action):
+        reward = payouts_arr[action]
+        terminated = jnp.ones((), jnp.float32)
+        truncated = jnp.zeros((), jnp.float32)
+        return state, obs0, reward, terminated, truncated
+
+    return JaxEnv(
+        spec=EnvSpec(obs_shape=(1,), action_dim=len(payouts), discrete=True),
+        reset=reset,
+        step=auto_reset(reset, raw_step, key_of_state=lambda s: s.key),
+    )
+
+
+class _TwoStateState(NamedTuple):
+    s: jax.Array  # 0 or 1
+    key: jax.Array
+    t: jax.Array
+
+
+def make_two_state_mdp(horizon: int = 8) -> JaxEnv:
+    """Deterministic 2-state MDP, truncated at `horizon` steps.
+
+    Transitions: next state == action (from either state).
+    Rewards: r(s, a) = 1.0 if a == 1 else 0.0.
+    Optimal policy: always a=1. Obs is one-hot of the state.
+    """
+
+    def obs_of(s):
+        return jax.nn.one_hot(s, 2, dtype=jnp.float32)
+
+    def reset(key):
+        key, sub = jax.random.split(key)
+        s = jax.random.bernoulli(sub).astype(jnp.int32)
+        st = _TwoStateState(s=s, key=key, t=jnp.zeros((), jnp.int32))
+        return st, obs_of(s)
+
+    def raw_step(state, action):
+        action = action.astype(jnp.int32)
+        reward = action.astype(jnp.float32)
+        t = state.t + 1
+        nstate = _TwoStateState(s=action, key=state.key, t=t)
+        terminated = jnp.zeros((), jnp.float32)
+        truncated = (t >= horizon).astype(jnp.float32)
+        return nstate, obs_of(action), reward, terminated, truncated
+
+    return JaxEnv(
+        spec=EnvSpec(obs_shape=(2,), action_dim=2, discrete=True),
+        reset=reset,
+        step=auto_reset(reset, raw_step, key_of_state=lambda s: s.key),
+    )
+
+
+class _PointMassState(NamedTuple):
+    pos: jax.Array
+    key: jax.Array
+    t: jax.Array
+
+
+def make_point_mass(horizon: int = 16) -> JaxEnv:
+    """1-d continuous control: obs = [pos]; reward = −(pos+a)²; pos' = pos+a.
+
+    Optimal action a* = −pos (within [−1, 1]); fixed-horizon episodes.
+    Positions start uniform in [−0.5, 0.5] so a* is always reachable.
+    """
+
+    def reset(key):
+        key, sub = jax.random.split(key)
+        pos = jax.random.uniform(sub, (), jnp.float32, -0.5, 0.5)
+        st = _PointMassState(pos=pos, key=key, t=jnp.zeros((), jnp.int32))
+        return st, pos[None]
+
+    def raw_step(state, action):
+        a = jnp.clip(action.reshape(()), -1.0, 1.0)
+        npos = state.pos + a
+        reward = -(npos**2)
+        t = state.t + 1
+        nstate = _PointMassState(pos=npos, key=state.key, t=t)
+        terminated = jnp.zeros((), jnp.float32)
+        truncated = (t >= horizon).astype(jnp.float32)
+        return nstate, npos[None], reward, terminated, truncated
+
+    return JaxEnv(
+        spec=EnvSpec(obs_shape=(1,), action_dim=1, discrete=False),
+        reset=reset,
+        step=auto_reset(reset, raw_step, key_of_state=lambda s: s.key),
+    )

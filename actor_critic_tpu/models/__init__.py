@@ -1,0 +1,7 @@
+from actor_critic_tpu.models.distributions import (
+    Categorical,
+    DiagGaussian,
+    TanhGaussian,
+)
+
+__all__ = ["Categorical", "DiagGaussian", "TanhGaussian"]

@@ -1,0 +1,162 @@
+"""Action distributions as lightweight pytrees.
+
+Covers the reference's policy heads (BASELINE.json:7-11; reference mount
+empty at survey, SURVEY.md §0): categorical for discrete control (A2C
+CartPole, IMPALA Pong), diagonal Gaussian for continuous control (PPO
+HalfCheetah), and tanh-squashed Gaussian for SAC.
+
+Design notes (TPU-first):
+- Each distribution is a NamedTuple → automatically a JAX pytree, so it
+  flows through `jit` / `vmap` / `lax.scan` carries without wrappers.
+- All math is elementwise + reductions over the event axis: XLA fuses it
+  into the surrounding matmuls; nothing here warrants a Pallas kernel.
+- Tanh-Gaussian log-probs use the softplus-stable change-of-variables
+  (no `log(1 - tanh(x)^2)`), and `log_prob(action)` clips the pre-atanh
+  action away from ±1 (SURVEY.md §7.2 item 5).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+_LOG_2PI = math.log(2.0 * math.pi)
+# Clip log-std into a sane range (SAC-style) so exp() never over/underflows.
+LOG_STD_MIN = -20.0
+LOG_STD_MAX = 2.0
+
+
+class Categorical(NamedTuple):
+    """Categorical distribution over discrete actions, parameterised by logits.
+
+    `logits` has shape [..., num_actions]; the trailing axis is the event axis.
+    """
+
+    logits: jax.Array
+
+    @property
+    def log_probs(self) -> jax.Array:
+        return jax.nn.log_softmax(self.logits, axis=-1)
+
+    def sample(self, key: jax.Array) -> jax.Array:
+        return jax.random.categorical(key, self.logits, axis=-1)
+
+    def log_prob(self, action: jax.Array) -> jax.Array:
+        lp = self.log_probs
+        return jnp.take_along_axis(lp, action[..., None].astype(jnp.int32), axis=-1)[
+            ..., 0
+        ]
+
+    def entropy(self) -> jax.Array:
+        lp = self.log_probs
+        p = jnp.exp(lp)
+        return -jnp.sum(p * lp, axis=-1)
+
+    def mode(self) -> jax.Array:
+        return jnp.argmax(self.logits, axis=-1)
+
+    def kl(self, other: "Categorical") -> jax.Array:
+        lp, lq = self.log_probs, other.log_probs
+        return jnp.sum(jnp.exp(lp) * (lp - lq), axis=-1)
+
+
+class DiagGaussian(NamedTuple):
+    """Diagonal Gaussian over continuous actions.
+
+    `mean` and `log_std` have shape [..., action_dim]; log-prob / entropy
+    reduce over the trailing event axis.
+    """
+
+    mean: jax.Array
+    log_std: jax.Array
+
+    @property
+    def std(self) -> jax.Array:
+        return jnp.exp(self.log_std)
+
+    def sample(self, key: jax.Array) -> jax.Array:
+        eps = jax.random.normal(key, self.mean.shape, self.mean.dtype)
+        return self.mean + self.std * eps
+
+    def log_prob(self, action: jax.Array) -> jax.Array:
+        z = (action - self.mean) / self.std
+        per_dim = -0.5 * (z * z + _LOG_2PI) - self.log_std
+        return jnp.sum(per_dim, axis=-1)
+
+    def entropy(self) -> jax.Array:
+        return jnp.sum(self.log_std + 0.5 * (_LOG_2PI + 1.0), axis=-1)
+
+    def mode(self) -> jax.Array:
+        return self.mean
+
+    def kl(self, other: "DiagGaussian") -> jax.Array:
+        var, ovar = jnp.exp(2 * self.log_std), jnp.exp(2 * other.log_std)
+        per_dim = (
+            other.log_std
+            - self.log_std
+            + (var + (self.mean - other.mean) ** 2) / (2.0 * ovar)
+            - 0.5
+        )
+        return jnp.sum(per_dim, axis=-1)
+
+
+def _tanh_log_det_jacobian(pre_tanh: jax.Array) -> jax.Array:
+    """log |d tanh(x)/dx| = log(1 - tanh(x)^2), computed stably.
+
+    Uses the identity log(1 - tanh(x)^2) = 2*(log 2 - x - softplus(-2x)),
+    which never evaluates log(0) for large |x|.
+    """
+    return 2.0 * (math.log(2.0) - pre_tanh - jax.nn.softplus(-2.0 * pre_tanh))
+
+
+class TanhGaussian(NamedTuple):
+    """Tanh-squashed diagonal Gaussian (SAC actor; BASELINE.json:10).
+
+    Actions live in (-1, 1)^d. `log_std` is clipped to
+    [LOG_STD_MIN, LOG_STD_MAX] at construction time by `create`.
+    """
+
+    mean: jax.Array
+    log_std: jax.Array
+
+    @classmethod
+    def create(cls, mean: jax.Array, log_std: jax.Array) -> "TanhGaussian":
+        return cls(mean, jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX))
+
+    @property
+    def base(self) -> DiagGaussian:
+        return DiagGaussian(self.mean, self.log_std)
+
+    def sample_and_log_prob(self, key: jax.Array) -> tuple[jax.Array, jax.Array]:
+        """Reparameterised sample with its log-prob (the SAC hot path)."""
+        pre = self.base.sample(key)
+        action = jnp.tanh(pre)
+        logp = self.base.log_prob(pre) - jnp.sum(
+            _tanh_log_det_jacobian(pre), axis=-1
+        )
+        return action, logp
+
+    def sample(self, key: jax.Array) -> jax.Array:
+        return jnp.tanh(self.base.sample(key))
+
+    def log_prob(
+        self, action: jax.Array, pre_tanh: Optional[jax.Array] = None
+    ) -> jax.Array:
+        """Log-prob of a squashed action.
+
+        Prefer passing `pre_tanh` when available (e.g. stored at sampling
+        time); otherwise the action is clipped to ±(1-1e-6) before atanh
+        for numerical safety (SURVEY.md §7.2 item 5).
+        """
+        if pre_tanh is None:
+            clipped = jnp.clip(action, -1.0 + 1e-6, 1.0 - 1e-6)
+            pre_tanh = jnp.arctanh(clipped)
+        return self.base.log_prob(pre_tanh) - jnp.sum(
+            _tanh_log_det_jacobian(pre_tanh), axis=-1
+        )
+
+    def mode(self) -> jax.Array:
+        return jnp.tanh(self.mean)

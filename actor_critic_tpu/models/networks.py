@@ -1,0 +1,230 @@
+"""Flax linen encoders and actor-critic networks.
+
+Covers the reference's "shared policy/value MLP-and-CNN encoders"
+(BASELINE.json:5; reference mount empty at survey, SURVEY.md §0) and the
+per-algorithm heads: categorical (BASELINE.json:7,11), diagonal Gaussian
+(BASELINE.json:8), tanh-Gaussian + twin-Q (BASELINE.json:9-10).
+
+TPU-first design notes:
+- Parameters are created in float32; the `compute_dtype` field casts
+  activations (bfloat16 on TPU keeps the MXU fed at 2× the flop rate while
+  the optimizer state stays fp32). Distribution parameters (logits, mean,
+  log_std) and values are cast back to float32 before any log/exp math.
+- The CNN is Nature-DQN shaped (stride-4/2/1 convs): XLA lowers these to
+  MXU convolutions when channel counts are padded-friendly; at Pong-like
+  sizes this is already compute-dense enough without custom kernels.
+- Everything is a pure `Module.apply`; no mutable state. Observation
+  normalization lives outside the network (envs/normalize.py) so the same
+  params work in the fused on-device rollout and the host-env path.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from actor_critic_tpu.models.distributions import (
+    Categorical,
+    DiagGaussian,
+    TanhGaussian,
+)
+
+# Orthogonal init is the genre-standard for on-policy PG stability.
+ortho = nn.initializers.orthogonal
+
+
+class MLPTorso(nn.Module):
+    """2-layer (default) MLP torso shared by actor & critic heads."""
+
+    hidden: Sequence[int] = (64, 64)
+    activation: Callable[[jax.Array], jax.Array] = nn.tanh
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = x.astype(self.compute_dtype)
+        for i, h in enumerate(self.hidden):
+            x = nn.Dense(
+                h,
+                kernel_init=ortho(jnp.sqrt(2.0)),
+                bias_init=nn.initializers.zeros,
+                dtype=self.compute_dtype,
+                name=f"dense_{i}",
+            )(x)
+            x = self.activation(x)
+        return x
+
+
+class NatureCNN(nn.Module):
+    """Nature-DQN conv stack for pixel observations (BASELINE.json:11).
+
+    Expects [..., H, W, C] uint8 or float; uint8 is scaled by 1/255.
+    """
+
+    channels: Sequence[int] = (32, 64, 64)
+    kernels: Sequence[int] = (8, 4, 3)
+    strides: Sequence[int] = (4, 2, 1)
+    dense: int = 512
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        if x.dtype == jnp.uint8:
+            x = x.astype(self.compute_dtype) / 255.0
+        else:
+            x = x.astype(self.compute_dtype)
+        for i, (c, k, s) in enumerate(zip(self.channels, self.kernels, self.strides)):
+            x = nn.Conv(
+                c,
+                (k, k),
+                strides=(s, s),
+                padding="VALID",
+                kernel_init=ortho(jnp.sqrt(2.0)),
+                dtype=self.compute_dtype,
+                name=f"conv_{i}",
+            )(x)
+            x = nn.relu(x)
+        x = x.reshape(*x.shape[:-3], -1)
+        x = nn.Dense(
+            self.dense, kernel_init=ortho(jnp.sqrt(2.0)), dtype=self.compute_dtype
+        )(x)
+        return nn.relu(x)
+
+
+def _head(out: int, scale: float, dtype, name: str) -> nn.Dense:
+    return nn.Dense(
+        out,
+        kernel_init=ortho(scale),
+        bias_init=nn.initializers.zeros,
+        dtype=dtype,
+        name=name,
+    )
+
+
+class ActorCriticDiscrete(nn.Module):
+    """Shared-torso policy+value net for discrete actions (A2C/PPO/IMPALA).
+
+    Returns (Categorical, value[...]) — the reference's shared policy/value
+    encoder pattern (BASELINE.json:5,7).
+    """
+
+    num_actions: int
+    hidden: Sequence[int] = (64, 64)
+    pixel_obs: bool = False
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs: jax.Array) -> tuple[Categorical, jax.Array]:
+        if self.pixel_obs:
+            z = NatureCNN(compute_dtype=self.compute_dtype, name="torso")(obs)
+        else:
+            z = MLPTorso(self.hidden, compute_dtype=self.compute_dtype, name="torso")(
+                obs
+            )
+        logits = _head(self.num_actions, 0.01, self.compute_dtype, "policy")(z)
+        value = _head(1, 1.0, self.compute_dtype, "value")(z)
+        return (
+            Categorical(logits.astype(jnp.float32)),
+            value[..., 0].astype(jnp.float32),
+        )
+
+
+class ActorCriticGaussian(nn.Module):
+    """Policy+value net for continuous actions (PPO on MuJoCo).
+
+    Separate torsos for actor and critic (standard for MuJoCo PPO; shared
+    torso hurts there), state-independent learned log_std.
+    """
+
+    action_dim: int
+    hidden: Sequence[int] = (64, 64)
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs: jax.Array) -> tuple[DiagGaussian, jax.Array]:
+        za = MLPTorso(self.hidden, compute_dtype=self.compute_dtype, name="pi_torso")(
+            obs
+        )
+        zc = MLPTorso(self.hidden, compute_dtype=self.compute_dtype, name="vf_torso")(
+            obs
+        )
+        mean = _head(self.action_dim, 0.01, self.compute_dtype, "policy")(za)
+        log_std = self.param(
+            "log_std", nn.initializers.zeros, (self.action_dim,), jnp.float32
+        )
+        value = _head(1, 1.0, self.compute_dtype, "value")(zc)
+        mean = mean.astype(jnp.float32)
+        return (
+            DiagGaussian(mean, jnp.broadcast_to(log_std, mean.shape)),
+            value[..., 0].astype(jnp.float32),
+        )
+
+
+class DeterministicActor(nn.Module):
+    """DDPG/TD3 actor: tanh-bounded deterministic policy (BASELINE.json:9)."""
+
+    action_dim: int
+    hidden: Sequence[int] = (256, 256)
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs: jax.Array) -> jax.Array:
+        z = MLPTorso(
+            self.hidden, activation=nn.relu, compute_dtype=self.compute_dtype,
+            name="torso",
+        )(obs)
+        a = _head(self.action_dim, 0.01, self.compute_dtype, "action")(z)
+        return jnp.tanh(a.astype(jnp.float32))
+
+
+class SquashedGaussianActor(nn.Module):
+    """SAC actor: tanh-Gaussian with state-dependent log_std (BASELINE.json:10)."""
+
+    action_dim: int
+    hidden: Sequence[int] = (256, 256)
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs: jax.Array) -> TanhGaussian:
+        z = MLPTorso(
+            self.hidden, activation=nn.relu, compute_dtype=self.compute_dtype,
+            name="torso",
+        )(obs)
+        mean = _head(self.action_dim, 0.01, self.compute_dtype, "mean")(z)
+        log_std = _head(self.action_dim, 0.01, self.compute_dtype, "log_std")(z)
+        return TanhGaussian.create(
+            mean.astype(jnp.float32), log_std.astype(jnp.float32)
+        )
+
+
+class QFunction(nn.Module):
+    """Q(s, a) critic for off-policy algorithms."""
+
+    hidden: Sequence[int] = (256, 256)
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs: jax.Array, action: jax.Array) -> jax.Array:
+        x = jnp.concatenate([obs, action], axis=-1)
+        z = MLPTorso(
+            self.hidden, activation=nn.relu, compute_dtype=self.compute_dtype,
+            name="torso",
+        )(x)
+        q = _head(1, 1.0, self.compute_dtype, "q")(z)
+        return q[..., 0].astype(jnp.float32)
+
+
+class TwinQ(nn.Module):
+    """Twin Q-heads (TD3/SAC; BASELINE.json:9-10). Returns (q1, q2)."""
+
+    hidden: Sequence[int] = (256, 256)
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs: jax.Array, action: jax.Array) -> tuple[jax.Array, jax.Array]:
+        q1 = QFunction(self.hidden, self.compute_dtype, name="q1")(obs, action)
+        q2 = QFunction(self.hidden, self.compute_dtype, name="q2")(obs, action)
+        return q1, q2

@@ -1,0 +1,22 @@
+from actor_critic_tpu.ops.polyak import hard_update, polyak_update
+from actor_critic_tpu.ops.returns import (
+    VTraceOutput,
+    discounted_returns,
+    gae,
+    lambda_returns,
+    n_step_returns,
+    normalize_advantages,
+    vtrace,
+)
+
+__all__ = [
+    "VTraceOutput",
+    "discounted_returns",
+    "gae",
+    "hard_update",
+    "lambda_returns",
+    "n_step_returns",
+    "normalize_advantages",
+    "polyak_update",
+    "vtrace",
+]

@@ -1,0 +1,22 @@
+"""Target-network utilities (DDPG/TD3/SAC; BASELINE.json:9-10)."""
+
+from __future__ import annotations
+
+import jax
+
+
+def polyak_update(online_params, target_params, tau: float):
+    """target ← (1−τ)·target + τ·online, elementwise over the pytree.
+
+    τ is the *update* rate (e.g. 0.005), matching the DDPG/SAC convention.
+    Pure function: callers re-bind the returned pytree (donation-friendly).
+    """
+    return jax.tree.map(
+        lambda o, t: (1.0 - tau) * t + tau * o, online_params, target_params
+    )
+
+
+def hard_update(online_params, target_params):
+    """target ← online (periodic hard sync, DQN-style)."""
+    del target_params
+    return jax.tree.map(lambda o: o, online_params)

@@ -1,0 +1,184 @@
+"""Return / advantage computations as reverse `lax.scan`s.
+
+Covers the reference's GAE/λ-return scan (BASELINE.json:5,8) and IMPALA's
+V-trace off-policy correction (BASELINE.json:11; reference mount empty at
+survey, SURVEY.md §0). All functions:
+
+- take time-major arrays `[T, ...]` (trailing batch axes broadcast freely,
+  so the same code serves a single trajectory or a [T, E] vmapped batch),
+- are pure and jit-safe: O(T) `lax.scan(reverse=True)`, static shapes,
+  no Python control flow on traced values,
+- treat `dones` as terminations (cut both the bootstrap and the trace);
+  truncated episodes should bootstrap through — pass `terminations` here
+  and handle truncation by patching rewards with `value` upstream.
+
+TPU note (SURVEY.md §5.7): the scan is over the *time* axis, which stays
+per-device; the batch axis is what gets sharded over the mesh.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+def discounted_returns(
+    rewards: jax.Array,
+    dones: jax.Array,
+    bootstrap_value: jax.Array,
+    gamma: float,
+) -> jax.Array:
+    """Monte-Carlo returns G_t = r_t + γ·(1-d_t)·G_{t+1}, bootstrapped."""
+
+    def step(g_next, x):
+        r, d = x
+        g = r + gamma * (1.0 - d) * g_next
+        return g, g
+
+    _, returns = jax.lax.scan(
+        step, bootstrap_value, (rewards, dones.astype(rewards.dtype)), reverse=True
+    )
+    return returns
+
+
+def gae(
+    rewards: jax.Array,
+    values: jax.Array,
+    dones: jax.Array,
+    bootstrap_value: jax.Array,
+    gamma: float,
+    lam: float,
+) -> tuple[jax.Array, jax.Array]:
+    """Generalized Advantage Estimation (the reference's GAE/λ scan).
+
+    Args:
+      rewards: [T, ...] reward at each step.
+      values: [T, ...] V(s_t) under the current critic.
+      dones: [T, ...] 1.0 where the episode *terminated* at step t.
+      bootstrap_value: [...] V(s_T) for the state after the last step.
+      gamma, lam: discount and GAE-λ.
+
+    Returns:
+      (advantages, returns) each [T, ...], with returns = advantages + values
+      (the λ-return targets for the critic).
+    """
+    dones = dones.astype(rewards.dtype)
+
+    def step(carry, x):
+        adv_next, v_next = carry
+        r, v, d = x
+        nonterm = 1.0 - d
+        delta = r + gamma * v_next * nonterm - v
+        adv = delta + gamma * lam * nonterm * adv_next
+        return (adv, v), adv
+
+    init = (jnp.zeros_like(bootstrap_value), bootstrap_value)
+    _, advantages = jax.lax.scan(step, init, (rewards, values, dones), reverse=True)
+    return advantages, advantages + values
+
+
+def lambda_returns(
+    rewards: jax.Array,
+    values: jax.Array,
+    dones: jax.Array,
+    bootstrap_value: jax.Array,
+    gamma: float,
+    lam: float,
+) -> jax.Array:
+    """TD(λ) return targets; equals `gae(...)[1]` (kept for clarity/tests)."""
+    return gae(rewards, values, dones, bootstrap_value, gamma, lam)[1]
+
+
+class VTraceOutput(NamedTuple):
+    vs: jax.Array  # [T, ...] V-trace value targets
+    pg_advantages: jax.Array  # [T, ...] policy-gradient advantages
+    clipped_rhos: jax.Array  # [T, ...] min(rho_bar, π/μ)
+
+
+def vtrace(
+    target_log_probs: jax.Array,
+    behaviour_log_probs: jax.Array,
+    rewards: jax.Array,
+    values: jax.Array,
+    dones: jax.Array,
+    bootstrap_value: jax.Array,
+    gamma: float,
+    rho_bar: float = 1.0,
+    c_bar: float = 1.0,
+    lam: float = 1.0,
+) -> VTraceOutput:
+    """V-trace targets (IMPALA; BASELINE.json:11, PAPERS.md:6).
+
+    vs_t = V(x_t) + δ_t + γ_t·c_t·(vs_{t+1} − V(x_{t+1}))
+    δ_t  = ρ_t·(r_t + γ_t·V(x_{t+1}) − V(x_t))
+    ρ_t  = min(ρ̄, π(a_t|x_t)/μ(a_t|x_t)),  c_t = λ·min(c̄, π/μ)
+
+    with γ_t = γ·(1 − done_t). With π == μ and ρ̄, c̄ → ∞ this reduces to
+    the λ-return (golden-tested in tests/test_returns.py).
+    """
+    dones = dones.astype(rewards.dtype)
+    discounts = gamma * (1.0 - dones)
+    rhos = jnp.exp(target_log_probs - behaviour_log_probs)
+    clipped_rhos = jnp.minimum(rho_bar, rhos)
+    cs = lam * jnp.minimum(c_bar, rhos)
+
+    values_tp1 = jnp.concatenate([values[1:], bootstrap_value[None]], axis=0)
+    deltas = clipped_rhos * (rewards + discounts * values_tp1 - values)
+
+    def step(acc, x):
+        delta, disc, c = x
+        acc = delta + disc * c * acc
+        return acc, acc
+
+    init = jnp.zeros_like(bootstrap_value)
+    _, vs_minus_v = jax.lax.scan(step, init, (deltas, discounts, cs), reverse=True)
+    vs = vs_minus_v + values
+
+    vs_tp1 = jnp.concatenate([vs[1:], bootstrap_value[None]], axis=0)
+    pg_advantages = clipped_rhos * (rewards + discounts * vs_tp1 - values)
+    return VTraceOutput(vs=vs, pg_advantages=pg_advantages, clipped_rhos=clipped_rhos)
+
+
+def n_step_returns(
+    rewards: jax.Array,
+    values: jax.Array,
+    dones: jax.Array,
+    bootstrap_value: jax.Array,
+    gamma: float,
+    n: int,
+) -> jax.Array:
+    """n-step truncated returns G_t = Σ_{k<m} γ^k r_{t+k} + γ^m V(s_{t+m}),
+    where m = min(n, T−t) and the sum stops at episode terminations.
+
+    `values[t]` is V(s_t); `bootstrap_value` is V(s_T). O(T·n) with static
+    n (small), fully branchless so it vmaps/jits cleanly.
+    """
+    T = rewards.shape[0]
+    dones = dones.astype(rewards.dtype)
+    vals_ext = jnp.concatenate([values, bootstrap_value[None]], axis=0)
+
+    def single(t_idx):
+        g = jnp.zeros_like(bootstrap_value)
+        alive = jnp.ones_like(bootstrap_value)
+        disc = 1.0
+        for k in range(n):
+            idx = jnp.minimum(t_idx + k, T - 1)
+            valid = ((t_idx + k) < T).astype(rewards.dtype)
+            g = g + disc * alive * valid * rewards[idx]
+            alive = alive * (1.0 - dones[idx] * valid)
+            disc = disc * gamma
+        m = jnp.minimum(n, T - t_idx)
+        boot_idx = jnp.minimum(t_idx + n, T)
+        g = g + (gamma**m) * alive * vals_ext[boot_idx]
+        return g
+
+    return jax.vmap(single)(jnp.arange(T))
+
+
+def normalize_advantages(advantages: jax.Array, eps: float = 1e-8) -> jax.Array:
+    """Standard PPO advantage normalization over all leading axes."""
+    mean = jnp.mean(advantages)
+    std = jnp.std(advantages)
+    return (advantages - mean) / (std + eps)

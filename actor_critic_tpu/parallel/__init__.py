@@ -1,0 +1,29 @@
+from actor_critic_tpu.parallel.mesh import (
+    DP_AXIS,
+    MODEL_AXIS,
+    MeshConfig,
+    make_mesh,
+    multihost_init,
+    pmean,
+    pmean_tree,
+    psum,
+)
+from actor_critic_tpu.parallel.dp import (
+    distribute_state,
+    make_dp_train_step,
+    train_state_specs,
+)
+
+__all__ = [
+    "DP_AXIS",
+    "MODEL_AXIS",
+    "MeshConfig",
+    "distribute_state",
+    "make_dp_train_step",
+    "make_mesh",
+    "multihost_init",
+    "pmean",
+    "pmean_tree",
+    "psum",
+    "train_state_specs",
+]

@@ -1,0 +1,94 @@
+"""Data-parallel execution of the fused on-policy train step.
+
+TPU-native replacement for the reference's MirroredStrategy/NCCL
+data-parallel path (BASELINE.json:5; SURVEY.md §2.3-2.4 — reference mount
+empty, §0). The fused trainer keeps its env batch *inside* `TrainState`,
+so data parallelism here means sharding the state itself over the mesh:
+
+    params / opt_state / update_step / avg_return  → replicated  (P())
+    rollout (env states + obs), ep_return/length   → sharded     (P("dp"))
+    key                                            → per-device  (P("dp"))
+
+Each device then runs the whole fused program (rollout → GAE → grads) on
+its shard of envs with its own PRNG stream; the single cross-device
+communication is the gradient/metric `pmean` the trainer already does
+over `axis_name` — which XLA lowers to an ICI all-reduce, exactly the
+role NCCL plays in the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from actor_critic_tpu.algos.common import TrainState
+from actor_critic_tpu.parallel.mesh import DP_AXIS
+
+
+def train_state_specs() -> TrainState:
+    """Prefix-tree of PartitionSpecs for TrainState under dp sharding."""
+    return TrainState(
+        params=P(),
+        opt_state=P(),
+        rollout=P(DP_AXIS),
+        key=P(DP_AXIS),
+        update_step=P(),
+        ep_return=P(DP_AXIS),
+        ep_length=P(DP_AXIS),
+        avg_return=P(),
+    )
+
+
+def distribute_state(state: TrainState, mesh: Mesh) -> TrainState:
+    """Place a host-built TrainState onto the mesh.
+
+    The scalar PRNG key becomes a [ndev] batch (one independent stream per
+    device); env-batch leaves are sharded over dp (num_envs must divide by
+    the dp size); everything else is replicated.
+    """
+    ndev = mesh.shape[DP_AXIS]
+    num_envs = state.ep_return.shape[0]
+    if num_envs % ndev != 0:
+        raise ValueError(f"num_envs={num_envs} not divisible by dp={ndev}")
+    state = state._replace(key=jax.random.split(state.key, ndev))
+    specs = train_state_specs()
+
+    def expand(spec, subtree):
+        return jax.tree.map(lambda _: NamedSharding(mesh, spec), subtree)
+
+    shardings = jax.tree.map(
+        expand, specs, state, is_leaf=lambda x: isinstance(x, P)
+    )
+    return jax.tree.map(jax.device_put, state, shardings)
+
+
+def make_dp_train_step(
+    train_step: Callable[[TrainState], tuple[TrainState, dict]],
+    mesh: Mesh,
+) -> Callable[[TrainState], tuple[TrainState, dict]]:
+    """shard_map + jit the fused train step over the dp axis (built once).
+
+    `train_step` must be built with `axis_name=DP_AXIS` so its gradient
+    pmean becomes the cross-device all-reduce. The per-device view of
+    `key` is a [1] slice of the [ndev] key batch; the wrapper unwraps it.
+    """
+    shard_map = jax.shard_map
+
+    specs = train_state_specs()
+
+    def local_step(state: TrainState):
+        state = state._replace(key=state.key[0])
+        new_state, metrics = train_step(state)
+        return new_state._replace(key=new_state.key[None]), metrics
+
+    fn = shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(specs,),
+        out_specs=(specs, P()),
+        check_vma=False,
+    )
+    return jax.jit(fn, donate_argnums=0)

@@ -1,0 +1,90 @@
+"""Device mesh + collectives — the distributed communication backend.
+
+TPU-native replacement for the reference's tf.distribute
+MirroredStrategy/NCCL gradient-all-reduce path and its Python-queue
+actor↔learner transport (BASELINE.json:5,11; SURVEY.md §2.4 — reference
+mount empty at survey, §0). Instead of wrapping a transport library, the
+framework expresses parallelism as shardings over a `jax.sharding.Mesh`
+and lets XLA insert collectives that ride ICI (intra-slice) or DCN
+(multi-host, via `jax.distributed.initialize`).
+
+Axes convention (SURVEY.md §2.3):
+- "dp": data parallel — env batch and minibatches sharded; gradients
+  `psum`-ed. The only axis the RL workloads need.
+- "model": reserved stub for tensor parallelism (unused by these model
+  sizes; kept so the mesh API doesn't change if TP is ever added).
+
+All trainers are written against `axis_name=...` pmean/psum helpers that
+degrade to no-ops off-mesh, so the same train-step code runs single-chip
+and under `shard_map`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DP_AXIS = "dp"
+MODEL_AXIS = "model"
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """How to lay the process's devices out as a mesh."""
+
+    dp: int = -1  # -1 → all remaining devices
+    model: int = 1
+
+
+def make_mesh(cfg: MeshConfig = MeshConfig(), devices=None) -> Mesh:
+    devices = jax.devices() if devices is None else devices
+    n = len(devices)
+    model = cfg.model
+    dp = n // model if cfg.dp == -1 else cfg.dp
+    if dp * model != n:
+        raise ValueError(f"mesh {dp}x{model} != {n} devices")
+    return jax.make_mesh((dp, model), (DP_AXIS, MODEL_AXIS), devices=devices)
+
+
+def multihost_init(coordinator: Optional[str] = None) -> None:
+    """Multi-host (DCN) initialization. On a single-process deployment this
+    is a no-op; on a pod slice each host calls it before building the mesh
+    (the JAX distributed runtime owns the DCN wire protocol — SURVEY §5.8)."""
+    if coordinator is None and jax.process_count() == 1:
+        return
+    jax.distributed.initialize(coordinator_address=coordinator)
+
+
+# --- collective helpers: no-op when axis_name is None ---------------------
+
+def pmean(x, axis_name: Optional[str]):
+    if axis_name is None:
+        return x
+    return jax.lax.pmean(x, axis_name)
+
+
+def psum(x, axis_name: Optional[str]):
+    if axis_name is None:
+        return x
+    return jax.lax.psum(x, axis_name)
+
+
+def pmean_tree(tree, axis_name: Optional[str]):
+    if axis_name is None:
+        return tree
+    return jax.tree.map(partial(jax.lax.pmean, axis_name=axis_name), tree)
+
+
+# --- sharding helpers ------------------------------------------------------
+
+def shard_batch_spec(mesh: Mesh) -> NamedSharding:
+    """Sharding for a [B, ...] batch: B split over dp, rest replicated."""
+    return NamedSharding(mesh, P(DP_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,27 @@
+"""Test configuration: run the suite on a fake 8-device CPU mesh.
+
+Per SURVEY.md §4 ("Distributed tests without a cluster"): the axon plugin
+exposes a single TPU chip, so tests validate sharding/collective semantics
+with `--xla_force_host_platform_device_count=8` CPU devices.
+
+Environment quirk (verified in-session): this container's
+`sitecustomize.py` (PYTHONPATH=/root/.axon_site) imports jax and registers
+the axon TPU PJRT plugin at *interpreter startup*, and a fresh process
+started with `JAX_PLATFORMS=cpu` deadlocks inside that registration. So
+instead of env vars, we flip the already-imported jax to CPU via
+`jax.config` — backends are created lazily, so as long as this runs before
+the first computation (conftest import time), the forced device count
+takes effect.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

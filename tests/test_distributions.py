@@ -1,0 +1,104 @@
+"""Distribution math tests: log-probs vs scipy-style references, entropy,
+tanh change-of-variables vs numerical integration (SURVEY.md §4)."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from actor_critic_tpu.models import Categorical, DiagGaussian, TanhGaussian
+
+
+def test_categorical_log_prob_and_entropy():
+    logits = jnp.asarray(np.random.RandomState(0).randn(5, 7).astype(np.float32))
+    dist = Categorical(logits)
+    probs = np.asarray(jax.nn.softmax(logits, axis=-1))
+    actions = jnp.asarray([0, 3, 6, 2, 1])
+    lp = np.asarray(dist.log_prob(actions))
+    for i, a in enumerate([0, 3, 6, 2, 1]):
+        np.testing.assert_allclose(lp[i], math.log(probs[i, a]), rtol=1e-4)
+    ent = np.asarray(dist.entropy())
+    nent = -(probs * np.log(probs)).sum(-1)
+    np.testing.assert_allclose(ent, nent, rtol=1e-4)
+
+
+def test_categorical_sampling_distribution():
+    logits = jnp.log(jnp.asarray([0.1, 0.6, 0.3]))
+    dist = Categorical(logits)
+    keys = jax.random.split(jax.random.key(0), 20000)
+    samples = jax.vmap(dist.sample)(keys)
+    freqs = np.bincount(np.asarray(samples), minlength=3) / 20000
+    np.testing.assert_allclose(freqs, [0.1, 0.6, 0.3], atol=0.02)
+
+
+def test_diag_gaussian_log_prob():
+    mean = jnp.asarray([0.5, -1.0])
+    log_std = jnp.asarray([0.1, -0.3])
+    dist = DiagGaussian(mean, log_std)
+    x = jnp.asarray([0.7, -0.8])
+    # manual
+    std = np.exp(np.asarray(log_std))
+    z = (np.asarray(x) - np.asarray(mean)) / std
+    expected = (-0.5 * (z**2 + math.log(2 * math.pi)) - np.asarray(log_std)).sum()
+    np.testing.assert_allclose(float(dist.log_prob(x)), expected, rtol=1e-5)
+
+
+def test_diag_gaussian_entropy_matches_sampled():
+    dist = DiagGaussian(jnp.asarray([0.0, 2.0]), jnp.asarray([0.2, -0.5]))
+    keys = jax.random.split(jax.random.key(1), 50000)
+    samples = jax.vmap(dist.sample)(keys)
+    est = -np.mean(np.asarray(jax.vmap(dist.log_prob)(samples)))
+    np.testing.assert_allclose(float(dist.entropy()), est, rtol=0.02)
+
+
+def test_diag_gaussian_kl_self_is_zero():
+    dist = DiagGaussian(jnp.asarray([1.0, -1.0]), jnp.asarray([0.3, 0.0]))
+    np.testing.assert_allclose(float(dist.kl(dist)), 0.0, atol=1e-6)
+
+
+def test_tanh_gaussian_log_prob_change_of_variables():
+    """Compare sample_and_log_prob against atanh-based log_prob, and against
+    the unstable direct formula log N(pre) − Σ log(1−tanh²(pre))."""
+    dist = TanhGaussian.create(jnp.asarray([0.3, -0.2]), jnp.asarray([-0.5, 0.1]))
+    key = jax.random.key(2)
+    action, logp = dist.sample_and_log_prob(key)
+    assert bool(jnp.all(jnp.abs(action) < 1.0))
+    # Recompute via atanh path.
+    logp2 = dist.log_prob(action)
+    np.testing.assert_allclose(float(logp), float(logp2), rtol=1e-4)
+    # Direct (unstable) formula on moderate values:
+    pre = jnp.arctanh(action)
+    direct = dist.base.log_prob(pre) - jnp.sum(jnp.log(1 - jnp.tanh(pre) ** 2))
+    np.testing.assert_allclose(float(logp), float(direct), rtol=1e-4)
+
+
+def test_tanh_gaussian_extreme_stability():
+    """Large |pre-tanh| values must not produce inf/nan (SURVEY §7.2.5)."""
+    dist = TanhGaussian.create(jnp.asarray([15.0]), jnp.asarray([-3.0]))
+    action, logp = dist.sample_and_log_prob(jax.random.key(3))
+    assert bool(jnp.isfinite(logp))
+    # action numerically == 1.0; atanh path must still be finite
+    assert bool(jnp.isfinite(dist.log_prob(action)))
+
+
+def test_tanh_gaussian_integrates_to_one():
+    """∫ p(a) da ≈ 1 over (-1,1) by trapezoid on a 1-d squashed Gaussian."""
+    dist = TanhGaussian.create(jnp.asarray([0.2]), jnp.asarray([0.0]))
+    grid = jnp.linspace(-1 + 1e-4, 1 - 1e-4, 4001)[:, None]
+    dens = jnp.exp(jax.vmap(dist.log_prob)(grid))
+    integral = float(jnp.trapezoid(dens, dx=float(grid[1, 0] - grid[0, 0])))
+    np.testing.assert_allclose(integral, 1.0, atol=2e-3)
+
+
+def test_distributions_are_pytrees():
+    """Must flow through jit/vmap/scan carries untouched."""
+    dist = DiagGaussian(jnp.zeros(3), jnp.zeros(3))
+    leaves = jax.tree.leaves(dist)
+    assert len(leaves) == 2
+
+    @jax.jit
+    def f(d: DiagGaussian):
+        return d.entropy()
+
+    assert f(dist).shape == ()

@@ -1,0 +1,121 @@
+"""Env protocol tests: CartPole numerics vs installed gymnasium, auto-reset
+semantics, vmap compatibility (SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from actor_critic_tpu.envs import (
+    make_bandit,
+    make_cartpole,
+    make_point_mass,
+    make_two_state_mdp,
+)
+
+
+def test_cartpole_matches_gymnasium_dynamics():
+    """Step both implementations from identical states with identical
+    action sequences; trajectories must match to float32 precision."""
+    gym = pytest.importorskip("gymnasium")
+    genv = gym.make("CartPole-v1").unwrapped
+    jenv = make_cartpole()
+
+    state, obs = jenv.reset(jax.random.key(0))
+    genv.reset(seed=0)
+    # Force identical initial state.
+    genv.state = np.asarray(obs, dtype=np.float64)
+
+    rng = np.random.RandomState(42)
+    for t in range(50):
+        action = int(rng.randint(2))
+        out = jenv.step(state, jnp.asarray(action))
+        gobs, grew, gterm, gtrunc, _ = genv.step(action)
+        if gterm:
+            # JAX env auto-resets; compare the pre-reset obs instead.
+            np.testing.assert_allclose(
+                out.info["final_obs"], gobs, rtol=1e-5, atol=1e-5
+            )
+            assert float(out.done) == 1.0
+            break
+        np.testing.assert_allclose(out.obs, gobs, rtol=1e-5, atol=1e-5)
+        assert float(out.reward) == grew == 1.0
+        state = out.state
+
+
+def test_cartpole_truncates_at_500():
+    """A policy that balances forever must be truncated at step 500."""
+    env = make_cartpole()
+    state, obs = env.reset(jax.random.key(1))
+
+    def body(carry, _):
+        state, _ = carry
+        # alternate actions to keep the pole up long enough is hard;
+        # instead just force t high by stepping and ignoring termination.
+        out = env.step(state, jnp.asarray(1))
+        return (out.state, out.done), out.done
+
+    # Instead check the step-counter logic directly: craft a state at t=499.
+    state = state._replace(t=jnp.asarray(499, jnp.int32))
+    out = env.step(state, jnp.asarray(0))
+    term = float(out.info["terminated"])
+    assert float(out.done) == 1.0
+    # near-origin state: must be truncation, not termination
+    assert term == 0.0
+    # auto-reset: new episode's t is 0
+    assert int(out.state.t) == 0
+
+
+def test_auto_reset_gives_fresh_obs():
+    env = make_two_state_mdp(horizon=3)
+    state, obs = env.reset(jax.random.key(0))
+    for _ in range(2):
+        out = env.step(state, jnp.asarray(1))
+        state = out.state
+        assert float(out.done) == 0.0
+    out = env.step(state, jnp.asarray(1))
+    assert float(out.done) == 1.0
+    # reward for the final step is still granted
+    assert float(out.reward) == 1.0
+    # final_obs reflects the pre-reset transition (state 1 one-hot)
+    np.testing.assert_allclose(out.info["final_obs"], [0.0, 1.0])
+    # post-reset t is 0 and episode continues
+    assert int(out.state.t) == 0
+
+
+def test_bandit_one_step_episodes():
+    env = make_bandit((0.1, 0.9))
+    state, obs = env.reset(jax.random.key(0))
+    out = env.step(state, jnp.asarray(1))
+    assert float(out.reward) == pytest.approx(0.9)
+    assert float(out.done) == 1.0
+    out2 = env.step(out.state, jnp.asarray(0))
+    assert float(out2.reward) == pytest.approx(0.1)
+    assert float(out2.done) == 1.0
+
+
+def test_point_mass_reward_and_clip():
+    env = make_point_mass()
+    state, obs = env.reset(jax.random.key(3))
+    pos = float(obs[0])
+    out = env.step(state, jnp.asarray([5.0]))  # clipped to 1.0
+    assert float(out.reward) == pytest.approx(-((pos + 1.0) ** 2), rel=1e-5)
+
+
+def test_envs_vmap_and_jit():
+    """The whole protocol must survive vmap+jit (the rollout shape)."""
+    env = make_cartpole()
+    E = 8
+    keys = jax.random.split(jax.random.key(0), E)
+    state, obs = jax.vmap(env.reset)(keys)
+    assert obs.shape == (E, 4)
+
+    @jax.jit
+    def step_all(state, actions):
+        return jax.vmap(env.step)(state, actions)
+
+    out = step_all(state, jnp.ones((E,), jnp.int32))
+    assert out.obs.shape == (E, 4)
+    assert out.reward.shape == (E,)
+    out2 = step_all(out.state, jnp.zeros((E,), jnp.int32))
+    assert bool(jnp.all(jnp.isfinite(out2.obs)))

@@ -1,0 +1,216 @@
+"""Golden tests for GAE / λ-returns / V-trace (SURVEY.md §4).
+
+Each scan is checked against a naive O(T²) (or recursive) NumPy
+implementation on small random trajectories, plus hand-checked edge cases.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from actor_critic_tpu.ops import (
+    discounted_returns,
+    gae,
+    lambda_returns,
+    n_step_returns,
+    vtrace,
+)
+
+
+def naive_gae(rewards, values, dones, bootstrap, gamma, lam):
+    T = len(rewards)
+    vals_tp1 = np.concatenate([values[1:], [bootstrap]])
+    advs = np.zeros(T)
+    last = 0.0
+    for t in reversed(range(T)):
+        nonterm = 1.0 - dones[t]
+        delta = rewards[t] + gamma * vals_tp1[t] * nonterm - values[t]
+        last = delta + gamma * lam * nonterm * last
+        advs[t] = last
+    return advs, advs + values
+
+
+def naive_vtrace(t_logp, b_logp, rewards, values, dones, bootstrap, gamma,
+                 rho_bar, c_bar, lam=1.0):
+    T = len(rewards)
+    rhos = np.exp(t_logp - b_logp)
+    crho = np.minimum(rho_bar, rhos)
+    cs = lam * np.minimum(c_bar, rhos)
+    disc = gamma * (1.0 - dones)
+    vals_tp1 = np.concatenate([values[1:], [bootstrap]])
+    vs = np.zeros(T)
+    acc = 0.0
+    for t in reversed(range(T)):
+        delta = crho[t] * (rewards[t] + disc[t] * vals_tp1[t] - values[t])
+        acc = delta + disc[t] * cs[t] * acc
+        vs[t] = acc + values[t]
+    vs_tp1 = np.concatenate([vs[1:], [bootstrap]])
+    pg_adv = crho * (rewards + disc * vs_tp1 - values)
+    return vs, pg_adv
+
+
+@pytest.fixture
+def traj():
+    rng = np.random.RandomState(0)
+    T = 17
+    return dict(
+        rewards=rng.randn(T).astype(np.float32),
+        values=rng.randn(T).astype(np.float32),
+        dones=(rng.rand(T) < 0.2).astype(np.float32),
+        bootstrap=np.float32(rng.randn()),
+    )
+
+
+def test_gae_matches_naive(traj):
+    gamma, lam = 0.99, 0.95
+    adv, ret = gae(
+        jnp.asarray(traj["rewards"]),
+        jnp.asarray(traj["values"]),
+        jnp.asarray(traj["dones"]),
+        jnp.asarray(traj["bootstrap"]),
+        gamma,
+        lam,
+    )
+    nadv, nret = naive_gae(
+        traj["rewards"], traj["values"], traj["dones"], traj["bootstrap"], gamma, lam
+    )
+    np.testing.assert_allclose(adv, nadv, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(ret, nret, rtol=1e-5, atol=1e-5)
+
+
+def test_gae_hand_computed():
+    # Two steps, no dones: delta1 = r1 + γ·V2 − V1 at t=1 uses bootstrap.
+    gamma, lam = 0.5, 0.5
+    rewards = jnp.array([1.0, 2.0])
+    values = jnp.array([0.5, 1.0])
+    dones = jnp.zeros(2)
+    bootstrap = jnp.asarray(2.0)
+    adv, _ = gae(rewards, values, dones, bootstrap, gamma, lam)
+    # t=1: delta = 2 + .5*2 - 1 = 2.0 ; adv1 = 2.0
+    # t=0: delta = 1 + .5*1 - .5 = 1.0 ; adv0 = 1 + .25*2 = 1.5
+    np.testing.assert_allclose(adv, [1.5, 2.0], rtol=1e-6)
+
+
+def test_gae_done_cuts_bootstrap():
+    gamma, lam = 0.99, 0.95
+    rewards = jnp.array([1.0, 1.0])
+    values = jnp.array([10.0, 10.0])
+    dones = jnp.array([0.0, 1.0])  # terminal at the last step
+    adv, _ = gae(rewards, values, dones, jnp.asarray(1e6), gamma, lam)
+    # Huge bootstrap value must not leak through the terminal.
+    assert float(jnp.abs(adv[1])) < 100.0
+
+
+def test_lambda_returns_lam1_is_mc():
+    rng = np.random.RandomState(1)
+    T = 11
+    rewards = rng.randn(T).astype(np.float32)
+    dones = np.zeros(T, np.float32)
+    values = rng.randn(T).astype(np.float32)
+    bootstrap = np.float32(0.3)
+    ret = lambda_returns(
+        jnp.asarray(rewards), jnp.asarray(values), jnp.asarray(dones),
+        jnp.asarray(bootstrap), 0.9, 1.0,
+    )
+    mc = discounted_returns(
+        jnp.asarray(rewards), jnp.asarray(dones), jnp.asarray(bootstrap), 0.9
+    )
+    np.testing.assert_allclose(ret, mc, rtol=1e-4, atol=1e-5)
+
+
+def test_vtrace_matches_naive(traj):
+    rng = np.random.RandomState(2)
+    T = len(traj["rewards"])
+    t_logp = rng.randn(T).astype(np.float32) * 0.3
+    b_logp = rng.randn(T).astype(np.float32) * 0.3
+    out = vtrace(
+        jnp.asarray(t_logp), jnp.asarray(b_logp),
+        jnp.asarray(traj["rewards"]), jnp.asarray(traj["values"]),
+        jnp.asarray(traj["dones"]), jnp.asarray(traj["bootstrap"]),
+        gamma=0.99, rho_bar=1.0, c_bar=1.0,
+    )
+    nvs, npg = naive_vtrace(
+        t_logp, b_logp, traj["rewards"], traj["values"], traj["dones"],
+        traj["bootstrap"], 0.99, 1.0, 1.0,
+    )
+    np.testing.assert_allclose(out.vs, nvs, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(out.pg_advantages, npg, rtol=1e-4, atol=1e-5)
+
+
+def test_vtrace_on_policy_reduces_to_lambda_return(traj):
+    """With π == μ and no clipping, vs must equal the λ-return."""
+    T = len(traj["rewards"])
+    logp = jnp.zeros(T)
+    out = vtrace(
+        logp, logp,
+        jnp.asarray(traj["rewards"]), jnp.asarray(traj["values"]),
+        jnp.asarray(traj["dones"]), jnp.asarray(traj["bootstrap"]),
+        gamma=0.99, rho_bar=1e9, c_bar=1e9, lam=0.95,
+    )
+    ret = lambda_returns(
+        jnp.asarray(traj["rewards"]), jnp.asarray(traj["values"]),
+        jnp.asarray(traj["dones"]), jnp.asarray(traj["bootstrap"]), 0.99, 0.95,
+    )
+    np.testing.assert_allclose(out.vs, ret, rtol=1e-4, atol=1e-5)
+
+
+def test_batched_time_major_broadcast():
+    """Same code must serve [T] and [T, E] shapes (vmapped envs)."""
+    rng = np.random.RandomState(3)
+    T, E = 9, 4
+    rewards = rng.randn(T, E).astype(np.float32)
+    values = rng.randn(T, E).astype(np.float32)
+    dones = (rng.rand(T, E) < 0.15).astype(np.float32)
+    bootstrap = rng.randn(E).astype(np.float32)
+    adv, ret = gae(
+        jnp.asarray(rewards), jnp.asarray(values), jnp.asarray(dones),
+        jnp.asarray(bootstrap), 0.99, 0.95,
+    )
+    assert adv.shape == (T, E)
+    for e in range(E):
+        nadv, nret = naive_gae(
+            rewards[:, e], values[:, e], dones[:, e], bootstrap[e], 0.99, 0.95
+        )
+        np.testing.assert_allclose(adv[:, e], nadv, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(ret[:, e], nret, rtol=1e-4, atol=1e-5)
+
+
+def test_n_step_returns():
+    rewards = jnp.array([1.0, 1.0, 1.0, 1.0])
+    values = jnp.array([2.0, 3.0, 4.0, 5.0])
+    dones = jnp.zeros(4)
+    bootstrap = jnp.asarray(10.0)
+    g = n_step_returns(rewards, values, dones, bootstrap, gamma=0.5, n=2)
+    # t=0: r0 + γ r1 + γ² V(s2) = 1 + .5 + .25*4 = 2.5
+    # t=2: r2 + γ r3 + γ² V(s4=boot) = 1 + .5 + 2.5 = 4.0
+    # t=3: r3 + γ V(boot) = 1 + 5 = 6.0
+    np.testing.assert_allclose(g[0], 2.5, rtol=1e-5)
+    np.testing.assert_allclose(g[2], 4.0, rtol=1e-5)
+    np.testing.assert_allclose(g[3], 6.0, rtol=1e-5)
+
+
+def test_n_step_returns_done_stops():
+    rewards = jnp.array([1.0, 1.0, 1.0])
+    values = jnp.array([2.0, 3.0, 4.0])
+    dones = jnp.array([0.0, 1.0, 0.0])
+    bootstrap = jnp.asarray(10.0)
+    g = n_step_returns(rewards, values, dones, bootstrap, gamma=0.5, n=3)
+    # t=0: r0 + γ r1, then done → no further rewards, no bootstrap
+    np.testing.assert_allclose(g[0], 1.5, rtol=1e-5)
+
+
+def test_gae_jit_and_grad():
+    """The scan must be jit-able and differentiable w.r.t. values."""
+    T = 8
+    rewards = jnp.ones(T)
+    dones = jnp.zeros(T)
+
+    @jax.jit
+    def loss(values, bootstrap):
+        adv, _ = gae(rewards, values, dones, bootstrap, 0.99, 0.95)
+        return jnp.sum(adv**2)
+
+    g = jax.grad(loss)(jnp.zeros(T), jnp.asarray(0.0))
+    assert g.shape == (T,)
+    assert bool(jnp.all(jnp.isfinite(g)))
