@@ -17,7 +17,6 @@ reachable where the reference's host-stepped loop cannot.
 from __future__ import annotations
 
 import dataclasses
-from functools import partial
 from typing import Any, Callable, Optional
 
 import jax
@@ -25,7 +24,6 @@ import jax.numpy as jnp
 import optax
 
 from actor_critic_tpu.algos.common import (
-    RolloutState,
     TrainState,
     Transition,
     episode_metrics_update,
@@ -100,18 +98,20 @@ def a2c_loss(
     advantages: jax.Array,
     returns: jax.Array,
     cfg: A2CConfig,
+    axis_name: Optional[str] = None,
 ) -> tuple[jax.Array, dict[str, jax.Array]]:
     """Policy-gradient + value-MSE + entropy-bonus loss on a [T, E] batch.
 
     Re-evaluates the policy at the stored obs (same params as rollout, so
     ratio==1; the re-evaluation is what makes the loss differentiable).
+    `axis_name` keeps advantage-normalization statistics global under dp.
     """
     obs = traj.obs.reshape(-1, *traj.obs.shape[2:])
     actions = traj.action.reshape(-1, *traj.action.shape[2:])
     adv = advantages.reshape(-1)
     ret = returns.reshape(-1)
     if cfg.normalize_adv:
-        adv = normalize_advantages(adv)
+        adv = normalize_advantages(adv, axis_name)
 
     dist, value = apply_fn(params, obs)
     log_prob = dist.log_prob(actions)
@@ -150,14 +150,18 @@ def make_train_step(
 
         # --- targets ---
         _, bootstrap_value = apply_fn(state.params, new_rollout.obs)
-        # Value of pre-reset final obs for truncation bootstrap.
-        T, E = traj.reward.shape
-        _, final_values = apply_fn(
-            state.params, traj.final_obs.reshape(T * E, *traj.final_obs.shape[2:])
-        )
-        rewards = truncation_bootstrap_rewards(
-            traj, final_values.reshape(T, E), cfg.gamma
-        )
+        if env.spec.can_truncate:
+            # Value of pre-reset final obs for truncation bootstrap.
+            T, E = traj.reward.shape
+            _, final_values = apply_fn(
+                state.params,
+                traj.final_obs.reshape(T * E, *traj.final_obs.shape[2:]),
+            )
+            rewards = truncation_bootstrap_rewards(
+                traj, final_values.reshape(T, E), cfg.gamma
+            )
+        else:
+            rewards = traj.reward
         advantages, returns = gae(
             rewards, traj.value, traj.done, bootstrap_value, cfg.gamma, cfg.gae_lambda
         )
@@ -165,7 +169,7 @@ def make_train_step(
         # --- update ---
         grad_fn = jax.value_and_grad(a2c_loss, has_aux=True)
         (_, metrics), grads = grad_fn(
-            state.params, apply_fn, traj, advantages, returns, cfg
+            state.params, apply_fn, traj, advantages, returns, cfg, axis_name
         )
         grads = pmesh.pmean_tree(grads, axis_name)
         updates, new_opt_state = opt.update(grads, state.opt_state, state.params)
@@ -179,7 +183,15 @@ def make_train_step(
         # replicated state; per-device episode streams would diverge).
         avg_ret = pmesh.pmean(avg_ret, axis_name)
         metrics.update(ep_metrics)
-        metrics = {k: pmesh.pmean(v, axis_name) for k, v in metrics.items()}
+        # Counts sum across the dp axis; everything else averages.
+        metrics = {
+            k: (
+                pmesh.psum(v, axis_name)
+                if k == "episodes_finished"
+                else pmesh.pmean(v, axis_name)
+            )
+            for k, v in metrics.items()
+        }
 
         new_state = TrainState(
             params=new_params,

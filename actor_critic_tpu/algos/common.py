@@ -13,7 +13,6 @@ from typing import Any, Callable, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
-import optax
 
 from actor_critic_tpu.envs.jax_env import JaxEnv
 

@@ -47,6 +47,9 @@ class EnvSpec:
     action_dim: int  # num discrete actions, or continuous action dims
     discrete: bool
     obs_dtype: Any = jnp.float32
+    # False ⇒ episodes only ever terminate (never time-limit truncate), so
+    # trainers can statically skip the truncation-bootstrap forward pass.
+    can_truncate: bool = True
 
 
 @dataclasses.dataclass(frozen=True)

@@ -46,7 +46,10 @@ def make_bandit(payouts=(0.2, 0.9, 0.4)) -> JaxEnv:
         return state, obs0, reward, terminated, truncated
 
     return JaxEnv(
-        spec=EnvSpec(obs_shape=(1,), action_dim=len(payouts), discrete=True),
+        spec=EnvSpec(
+            obs_shape=(1,), action_dim=len(payouts), discrete=True,
+            can_truncate=False,
+        ),
         reset=reset,
         step=auto_reset(reset, raw_step, key_of_state=lambda s: s.key),
     )

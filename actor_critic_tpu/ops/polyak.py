@@ -17,6 +17,7 @@ def polyak_update(online_params, target_params, tau: float):
 
 
 def hard_update(online_params, target_params):
-    """target ← online (periodic hard sync, DQN-style)."""
+    """target ← online (periodic hard sync, DQN-style). Returns the online
+    pytree itself — JAX arrays are immutable, no copy is needed."""
     del target_params
-    return jax.tree.map(lambda o: o, online_params)
+    return online_params

@@ -177,8 +177,20 @@ def n_step_returns(
     return jax.vmap(single)(jnp.arange(T))
 
 
-def normalize_advantages(advantages: jax.Array, eps: float = 1e-8) -> jax.Array:
-    """Standard PPO advantage normalization over all leading axes."""
+def normalize_advantages(
+    advantages: jax.Array, axis_name=None, eps: float = 1e-8
+) -> jax.Array:
+    """Standard PPO advantage normalization over all leading axes.
+
+    Under a dp `shard_map`, pass `axis_name` so the statistics are
+    computed over the GLOBAL batch (pmean of mean and second moment) —
+    otherwise per-shard stats would silently break the
+    sharded-grad == full-batch-grad equivalence (tests/test_parallel.py).
+    """
     mean = jnp.mean(advantages)
-    std = jnp.std(advantages)
-    return (advantages - mean) / (std + eps)
+    sq = jnp.mean(advantages**2)
+    if axis_name is not None:
+        mean = jax.lax.pmean(mean, axis_name)
+        sq = jax.lax.pmean(sq, axis_name)
+    var = jnp.maximum(sq - mean**2, 0.0)
+    return (advantages - mean) / (jnp.sqrt(var) + eps)

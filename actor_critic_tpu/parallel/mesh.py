@@ -51,12 +51,25 @@ def make_mesh(cfg: MeshConfig = MeshConfig(), devices=None) -> Mesh:
 
 
 def multihost_init(coordinator: Optional[str] = None) -> None:
-    """Multi-host (DCN) initialization. On a single-process deployment this
-    is a no-op; on a pod slice each host calls it before building the mesh
-    (the JAX distributed runtime owns the DCN wire protocol — SURVEY §5.8)."""
-    if coordinator is None and jax.process_count() == 1:
+    """Multi-host (DCN) initialization (SURVEY §5.8).
+
+    Must be called before anything initializes the XLA backend (JAX's
+    `distributed.initialize` raises otherwise), so the single-process
+    check CANNOT use `jax.process_count()` — that call would itself
+    initialize the backend. Instead: initialize iff a coordinator is
+    given explicitly or the standard cluster env vars are present
+    (TPU pod slices / JAX_COORDINATOR_ADDRESS); plain single-process
+    runs fall through as a no-op.
+    """
+    import os
+
+    if coordinator is not None:
+        jax.distributed.initialize(coordinator_address=coordinator)
         return
-    jax.distributed.initialize(coordinator_address=coordinator)
+    if os.environ.get("JAX_COORDINATOR_ADDRESS") or os.environ.get(
+        "COORDINATOR_ADDRESS"
+    ):
+        jax.distributed.initialize()
 
 
 # --- collective helpers: no-op when axis_name is None ---------------------

@@ -1,0 +1,76 @@
+"""Headline benchmark: A2C CartPole-v1 fused-trainer throughput.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "env-steps/sec/chip", "vs_baseline": N}
+
+`vs_baseline` is relative to the BASELINE.json:5 north-star target of
+1,000,000 env-steps/sec (the reference publishes no numbers of its own —
+empty mount, SURVEY.md §0 / BASELINE.md).
+
+Design: the entire rollout(T)×E + GAE + update is one jitted program, and
+ITERS_PER_CALL iterations are scanned inside a single dispatch so the
+host↔device (tunnel) latency is amortized away. Steps/sec counts actual
+environment transitions: calls × iters × T × E.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from actor_critic_tpu.algos import a2c
+    from actor_critic_tpu.envs import make_cartpole
+
+    E = int(os.environ.get("BENCH_ENVS", 4096))
+    T = int(os.environ.get("BENCH_ROLLOUT", 32))
+    iters_per_call = int(os.environ.get("BENCH_ITERS_PER_CALL", 50))
+    calls = int(os.environ.get("BENCH_CALLS", 5))
+
+    env = make_cartpole()
+    cfg = a2c.A2CConfig(num_envs=E, rollout_steps=T, lr=1e-3)
+    state = a2c.init_state(env, cfg, jax.random.key(0))
+    train_step = a2c.make_train_step(env, cfg)
+
+    @jax.jit
+    def run_block(state):
+        def body(s, _):
+            s, _m = train_step(s)
+            return s, None
+
+        s, _ = jax.lax.scan(body, state, None, length=iters_per_call)
+        return s
+
+    run_block_donating = jax.jit(run_block, donate_argnums=0)
+
+    # Warm-up / compile.
+    state = run_block_donating(state)
+    jax.block_until_ready(state)
+
+    t0 = time.perf_counter()
+    for _ in range(calls):
+        state = run_block_donating(state)
+    jax.block_until_ready(state)
+    dt = time.perf_counter() - t0
+
+    steps = calls * iters_per_call * T * E
+    sps = steps / dt
+    print(
+        json.dumps(
+            {
+                "metric": "a2c_cartpole_fused_throughput",
+                "value": round(sps, 1),
+                "unit": "env-steps/sec/chip",
+                "vs_baseline": round(sps / 1_000_000, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -44,18 +44,10 @@ def test_cartpole_matches_gymnasium_dynamics():
 
 
 def test_cartpole_truncates_at_500():
-    """A policy that balances forever must be truncated at step 500."""
+    """The TimeLimit must truncate (not terminate) at step 500."""
     env = make_cartpole()
     state, obs = env.reset(jax.random.key(1))
-
-    def body(carry, _):
-        state, _ = carry
-        # alternate actions to keep the pole up long enough is hard;
-        # instead just force t high by stepping and ignoring termination.
-        out = env.step(state, jnp.asarray(1))
-        return (out.state, out.done), out.done
-
-    # Instead check the step-counter logic directly: craft a state at t=499.
+    # Check the step-counter logic directly: craft a state at t=499.
     state = state._replace(t=jnp.asarray(499, jnp.int32))
     out = env.step(state, jnp.asarray(0))
     term = float(out.info["terminated"])
