@@ -31,6 +31,7 @@ from actor_critic_tpu.algos.common import (
     rollout_scan,
     truncation_bootstrap_rewards,
 )
+from actor_critic_tpu.algos.metrics import aggregate_metrics
 from actor_critic_tpu.envs.jax_env import JaxEnv
 from actor_critic_tpu.models.networks import ActorCriticDiscrete, ActorCriticGaussian
 from actor_critic_tpu.ops.returns import gae, normalize_advantages
@@ -182,16 +183,8 @@ def make_train_step(
         # Keep the EMA replicated across the dp axis (it is part of the
         # replicated state; per-device episode streams would diverge).
         avg_ret = pmesh.pmean(avg_ret, axis_name)
-        metrics.update(ep_metrics)
-        # Counts sum across the dp axis; everything else averages.
-        metrics = {
-            k: (
-                pmesh.psum(v, axis_name)
-                if k == "episodes_finished"
-                else pmesh.pmean(v, axis_name)
-            )
-            for k, v in metrics.items()
-        }
+        ep_metrics["avg_return_ema"] = avg_ret
+        metrics = aggregate_metrics(metrics, ep_metrics, axis_name)
 
         new_state = TrainState(
             params=new_params,

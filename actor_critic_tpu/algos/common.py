@@ -148,9 +148,12 @@ def episode_metrics_update(
         (ep_return, ep_length, avg_return, jnp.zeros(()), jnp.zeros(())),
         (traj.reward, traj.done),
     )
+    # Raw count and sum so dp callers can psum both and divide AFTER the
+    # reduction (an unweighted pmean of per-device means would bias toward
+    # devices with zero finished episodes).
     metrics = {
         "episodes_finished": n_done,
-        "mean_finished_return": sum_done / jnp.maximum(n_done, 1.0),
+        "finished_return_sum": sum_done,
         "avg_return_ema": avg_return,
     }
     return ep_return, ep_length, avg_return, metrics

@@ -1,0 +1,24 @@
+"""Cross-device metric aggregation shared by the trainers."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from actor_critic_tpu.parallel import mesh as pmesh
+
+
+def aggregate_metrics(
+    metrics: dict, ep_metrics: dict, axis_name: Optional[str]
+) -> dict:
+    """Combine loss metrics (pmean) with episode accounting (psum-then-
+    divide, so devices with zero finished episodes don't bias the mean)."""
+    n = pmesh.psum(ep_metrics["episodes_finished"], axis_name)
+    s = pmesh.psum(ep_metrics["finished_return_sum"], axis_name)
+    out = {k: pmesh.pmean(v, axis_name) for k, v in metrics.items()}
+    out["episodes_finished"] = n
+    out["mean_finished_return"] = s / jnp.maximum(n, 1.0)
+    # avg_return_ema is pmean'd by the caller before state update.
+    out["avg_return_ema"] = ep_metrics["avg_return_ema"]
+    return out

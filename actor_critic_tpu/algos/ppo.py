@@ -1,0 +1,405 @@
+"""PPO-clip — fused rollout + in-jit epoch/minibatch updates.
+
+Capability parity with the reference's PPO config (BASELINE.json:8:
+"PPO-clip on MuJoCo HalfCheetah (GAE-λ, continuous Gaussian policy)";
+reference mount empty at survey, SURVEY.md §0), built TPU-first:
+
+- For pure-JAX envs the whole iteration (rollout scan → GAE → E epochs ×
+  M minibatches of clipped-surrogate updates) is ONE jitted program; the
+  epoch/minibatch loops are `lax.scan`s over shuffled index blocks, so
+  XLA sees static shapes and a fixed-length loop nest (SURVEY §3.1).
+- For host envs (MuJoCo via envs/host_pool.py) the same `ppo_update`
+  is reused as a single jitted device program per iteration, with one
+  host→device batch transfer (SURVEY §7.2 item 2).
+
+Losses: clipped ratio surrogate, clipped value MSE, entropy bonus;
+metrics include approx-KL and clip fraction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from actor_critic_tpu.algos.common import (
+    TrainState,
+    episode_metrics_update,
+    init_rollout,
+    rollout_scan,
+    truncation_bootstrap_rewards,
+)
+from actor_critic_tpu.algos.metrics import aggregate_metrics
+from actor_critic_tpu.envs.jax_env import JaxEnv
+from actor_critic_tpu.models.networks import ActorCriticDiscrete, ActorCriticGaussian
+from actor_critic_tpu.ops.returns import gae, normalize_advantages
+from actor_critic_tpu.parallel import mesh as pmesh
+
+
+@dataclasses.dataclass(frozen=True)
+class PPOConfig:
+    num_envs: int = 64
+    rollout_steps: int = 128  # T
+    epochs: int = 4
+    num_minibatches: int = 4
+    gamma: float = 0.99
+    gae_lambda: float = 0.95
+    clip_eps: float = 0.2
+    vf_clip: float = 0.2  # <=0 disables value clipping
+    lr: float = 3e-4
+    value_coef: float = 0.5
+    entropy_coef: float = 0.0
+    max_grad_norm: float = 0.5
+    hidden: tuple[int, ...] = (64, 64)
+    normalize_adv: bool = True
+    bf16_compute: bool = False
+
+
+class PPOBatch(NamedTuple):
+    """Flattened experience batch for the update loop ([B, ...])."""
+
+    obs: jax.Array
+    action: jax.Array
+    log_prob_old: jax.Array
+    value_old: jax.Array
+    advantage: jax.Array
+    ret: jax.Array
+
+
+def make_network(env_spec, cfg: PPOConfig):
+    dtype = jnp.bfloat16 if cfg.bf16_compute else jnp.float32
+    if env_spec.discrete:
+        return ActorCriticDiscrete(
+            num_actions=env_spec.action_dim, hidden=cfg.hidden, compute_dtype=dtype
+        )
+    return ActorCriticGaussian(
+        action_dim=env_spec.action_dim, hidden=cfg.hidden, compute_dtype=dtype
+    )
+
+
+def make_optimizer(cfg: PPOConfig) -> optax.GradientTransformation:
+    return optax.chain(
+        optax.clip_by_global_norm(cfg.max_grad_norm),
+        optax.adam(cfg.lr, eps=1e-5),
+    )
+
+
+def ppo_loss(
+    params: Any,
+    apply_fn: Callable,
+    batch: PPOBatch,
+    cfg: PPOConfig,
+    axis_name: Optional[str] = None,
+) -> tuple[jax.Array, dict[str, jax.Array]]:
+    """Clipped-surrogate + clipped-value + entropy loss on a minibatch."""
+    dist, value = apply_fn(params, batch.obs)
+    log_prob = dist.log_prob(batch.action)
+    entropy = jnp.mean(dist.entropy())
+
+    adv = batch.advantage
+    if cfg.normalize_adv:
+        adv = normalize_advantages(adv, axis_name)
+
+    log_ratio = log_prob - batch.log_prob_old
+    ratio = jnp.exp(log_ratio)
+    surr1 = ratio * adv
+    surr2 = jnp.clip(ratio, 1.0 - cfg.clip_eps, 1.0 + cfg.clip_eps) * adv
+    pg_loss = -jnp.mean(jnp.minimum(surr1, surr2))
+
+    if cfg.vf_clip > 0:
+        v_clipped = batch.value_old + jnp.clip(
+            value - batch.value_old, -cfg.vf_clip, cfg.vf_clip
+        )
+        v_loss = 0.5 * jnp.mean(
+            jnp.maximum((value - batch.ret) ** 2, (v_clipped - batch.ret) ** 2)
+        )
+    else:
+        v_loss = 0.5 * jnp.mean((value - batch.ret) ** 2)
+
+    loss = pg_loss + cfg.value_coef * v_loss - cfg.entropy_coef * entropy
+    # Schulman's low-variance KL estimator: E[(r-1) - log r].
+    approx_kl = jnp.mean((ratio - 1.0) - log_ratio)
+    clip_frac = jnp.mean((jnp.abs(ratio - 1.0) > cfg.clip_eps).astype(jnp.float32))
+    return loss, {
+        "loss": loss,
+        "pg_loss": pg_loss,
+        "v_loss": v_loss,
+        "entropy": entropy,
+        "approx_kl": approx_kl,
+        "clip_frac": clip_frac,
+    }
+
+
+def ppo_update(
+    params: Any,
+    opt_state: Any,
+    batch: PPOBatch,
+    key: jax.Array,
+    apply_fn: Callable,
+    opt: optax.GradientTransformation,
+    cfg: PPOConfig,
+    axis_name: Optional[str] = None,
+) -> tuple[Any, Any, dict[str, jax.Array]]:
+    """E epochs × M shuffled minibatches of PPO updates, all in-jit.
+
+    The batch size B must be divisible by num_minibatches. Under dp,
+    each device shuffles its local shard; gradients pmean per minibatch
+    (the ICI analogue of the reference's per-step NCCL all-reduce).
+    """
+    B = batch.obs.shape[0]
+    mb = B // cfg.num_minibatches
+    if B % cfg.num_minibatches != 0:
+        raise ValueError(f"batch {B} % minibatches {cfg.num_minibatches} != 0")
+
+    grad_fn = jax.value_and_grad(ppo_loss, has_aux=True)
+
+    def minibatch_body(carry, idx):
+        params, opt_state = carry
+        mb_batch = jax.tree.map(lambda x: x[idx], batch)
+        (_, metrics), grads = grad_fn(params, apply_fn, mb_batch, cfg, axis_name)
+        grads = pmesh.pmean_tree(grads, axis_name)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return (params, opt_state), metrics
+
+    def epoch_body(carry, ekey):
+        perm = jax.random.permutation(ekey, B)
+        idxs = perm.reshape(cfg.num_minibatches, mb)
+        return jax.lax.scan(minibatch_body, carry, idxs)
+
+    epoch_keys = jax.random.split(key, cfg.epochs)
+    (params, opt_state), metrics = jax.lax.scan(
+        epoch_body, (params, opt_state), epoch_keys
+    )
+    # metrics: [epochs, minibatches] — report the mean over the loop nest.
+    metrics = jax.tree.map(jnp.mean, metrics)
+    return params, opt_state, metrics
+
+
+def init_state(env: JaxEnv, cfg: PPOConfig, key: jax.Array) -> TrainState:
+    net = make_network(env.spec, cfg)
+    opt = make_optimizer(cfg)
+    key, pkey, rkey = jax.random.split(key, 3)
+    dummy = jnp.zeros((1, *env.spec.obs_shape), env.spec.obs_dtype)
+    params = net.init(pkey, dummy)
+    rstate = init_rollout(env, rkey, cfg.num_envs)
+    E = cfg.num_envs
+    return TrainState(
+        params=params,
+        opt_state=opt.init(params),
+        rollout=rstate,
+        key=key,
+        update_step=jnp.zeros((), jnp.int32),
+        ep_return=jnp.zeros((E,)),
+        ep_length=jnp.zeros((E,)),
+        avg_return=jnp.zeros(()),
+    )
+
+
+def make_policy_step(env_spec, cfg: PPOConfig):
+    """Jitted (params, obs, key) → (action, log_prob, value) for host loops."""
+    net = make_network(env_spec, cfg)
+
+    @jax.jit
+    def policy_step(params, obs, key):
+        dist, value = net.apply(params, obs)
+        action = dist.sample(key)
+        return action, dist.log_prob(action), value
+
+    return policy_step
+
+
+def make_host_update_step(env_spec, cfg: PPOConfig, can_truncate: bool = True):
+    """Jitted per-iteration update for host-collected trajectories.
+
+    Takes time-major [T, E] arrays (one host→device transfer per
+    iteration — SURVEY §3.1 boundary fix), computes truncation-aware GAE
+    on-device, and runs the in-jit epoch/minibatch PPO update.
+    """
+    net = make_network(env_spec, cfg)
+    opt = make_optimizer(cfg)
+    apply_fn = net.apply
+
+    @jax.jit
+    def update(
+        params, opt_state, obs, action, log_prob, value, reward, done,
+        terminated, final_obs, last_obs, key,
+    ):
+        T, E = reward.shape
+        _, bootstrap_value = apply_fn(params, last_obs)
+        if can_truncate:
+            _, final_values = apply_fn(
+                params, final_obs.reshape(T * E, *final_obs.shape[2:])
+            )
+            truncated = done * (1.0 - terminated)
+            rewards = reward + cfg.gamma * final_values.reshape(T, E) * truncated
+        else:
+            rewards = reward
+        advantages, returns = gae(
+            rewards, value, done, bootstrap_value, cfg.gamma, cfg.gae_lambda
+        )
+        batch = PPOBatch(
+            obs=obs.reshape(T * E, *obs.shape[2:]),
+            action=action.reshape(T * E, *action.shape[2:]),
+            log_prob_old=log_prob.reshape(T * E),
+            value_old=value.reshape(T * E),
+            advantage=advantages.reshape(T * E),
+            ret=returns.reshape(T * E),
+        )
+        return ppo_update(params, opt_state, batch, key, apply_fn, opt, cfg)
+
+    return update
+
+
+def init_host_params(env_spec, cfg: PPOConfig, key: jax.Array):
+    net = make_network(env_spec, cfg)
+    dummy = jnp.zeros((1, *env_spec.obs_shape), jnp.float32)
+    params = net.init(key, dummy)
+    opt_state = make_optimizer(cfg).init(params)
+    return params, opt_state
+
+
+def train_host(
+    pool,
+    cfg: PPOConfig,
+    num_iterations: int,
+    seed: int = 0,
+    log_every: int = 10,
+    log_fn: Optional[Callable[[int, dict], None]] = None,
+):
+    """PPO on a HostEnvPool (MuJoCo etc.): host rollout, device update.
+
+    Returns (params, opt_state, history) where history is a list of
+    (iteration, metrics dict incl. raw episode returns).
+    """
+    import numpy as np
+
+    key = jax.random.key(seed)
+    key, pkey = jax.random.split(key)
+    params, opt_state = init_host_params(pool.spec, cfg, pkey)
+    policy_step = make_policy_step(pool.spec, cfg)
+    update = make_host_update_step(pool.spec, cfg, can_truncate=True)
+
+    obs = pool.reset()
+    E = pool.num_envs
+    T = cfg.rollout_steps
+    ep_ret = np.zeros(E)
+    finished: list[float] = []
+    history = []
+
+    for it in range(num_iterations):
+        buf = {
+            k: []
+            for k in (
+                "obs", "action", "log_prob", "value", "reward", "done",
+                "terminated", "final_obs",
+            )
+        }
+        for _ in range(T):
+            key, akey = jax.random.split(key)
+            action, logp, value = policy_step(params, jnp.asarray(obs), akey)
+            action_np = np.asarray(action)
+            out = pool.step(action_np)
+            buf["obs"].append(obs)
+            buf["action"].append(action_np)
+            buf["log_prob"].append(np.asarray(logp))
+            buf["value"].append(np.asarray(value))
+            buf["reward"].append(out.reward)
+            buf["done"].append(out.done)
+            buf["terminated"].append(out.terminated)
+            buf["final_obs"].append(out.final_obs)
+            ep_ret += out.raw_reward
+            for i in np.nonzero(out.done)[0]:
+                finished.append(float(ep_ret[i]))
+                ep_ret[i] = 0.0
+            obs = out.obs
+
+        key, ukey = jax.random.split(key)
+        arrays = {k: jnp.asarray(np.stack(v)) for k, v in buf.items()}
+        params, opt_state, metrics = update(
+            params, opt_state,
+            arrays["obs"], arrays["action"], arrays["log_prob"],
+            arrays["value"], arrays["reward"], arrays["done"],
+            arrays["terminated"], arrays["final_obs"],
+            jnp.asarray(obs), ukey,
+        )
+        if (it + 1) % max(log_every, 1) == 0:
+            m = {k: float(v) for k, v in metrics.items()}
+            m["recent_return"] = float(np.mean(finished[-20:])) if finished else float("nan")
+            m["episodes"] = len(finished)
+            history.append((it + 1, m))
+            if log_fn is not None:
+                log_fn(it + 1, m)
+    return params, opt_state, history
+
+
+def make_train_step(
+    env: JaxEnv,
+    cfg: PPOConfig,
+    axis_name: Optional[str] = None,
+) -> Callable[[TrainState], tuple[TrainState, dict[str, jax.Array]]]:
+    """Fused PPO iteration for pure-JAX envs (same contract as a2c's)."""
+    net = make_network(env.spec, cfg)
+    opt = make_optimizer(cfg)
+    apply_fn = net.apply
+
+    def train_step(state: TrainState) -> tuple[TrainState, dict[str, jax.Array]]:
+        key, rkey, ukey = jax.random.split(state.key, 3)
+
+        new_rollout, traj = rollout_scan(
+            env, apply_fn, state.params, state.rollout, rkey, cfg.rollout_steps
+        )
+
+        _, bootstrap_value = apply_fn(state.params, new_rollout.obs)
+        T, E = traj.reward.shape
+        if env.spec.can_truncate:
+            _, final_values = apply_fn(
+                state.params,
+                traj.final_obs.reshape(T * E, *traj.final_obs.shape[2:]),
+            )
+            rewards = truncation_bootstrap_rewards(
+                traj, final_values.reshape(T, E), cfg.gamma
+            )
+        else:
+            rewards = traj.reward
+        advantages, returns = gae(
+            rewards, traj.value, traj.done, bootstrap_value, cfg.gamma, cfg.gae_lambda
+        )
+
+        batch = PPOBatch(
+            obs=traj.obs.reshape(T * E, *traj.obs.shape[2:]),
+            action=traj.action.reshape(T * E, *traj.action.shape[2:]),
+            log_prob_old=traj.log_prob.reshape(T * E),
+            value_old=traj.value.reshape(T * E),
+            advantage=advantages.reshape(T * E),
+            ret=returns.reshape(T * E),
+        )
+        new_params, new_opt_state, metrics = ppo_update(
+            state.params, state.opt_state, batch, ukey, apply_fn, opt, cfg, axis_name
+        )
+
+        ep_ret, ep_len, avg_ret, ep_metrics = episode_metrics_update(
+            state.ep_return, state.ep_length, state.avg_return, traj
+        )
+        avg_ret = pmesh.pmean(avg_ret, axis_name)
+        ep_metrics["avg_return_ema"] = avg_ret
+        metrics = aggregate_metrics(metrics, ep_metrics, axis_name)
+
+        return (
+            TrainState(
+                params=new_params,
+                opt_state=new_opt_state,
+                rollout=new_rollout,
+                key=key,
+                update_step=state.update_step + 1,
+                ep_return=ep_ret,
+                ep_length=ep_len,
+                avg_return=avg_ret,
+            ),
+            metrics,
+        )
+
+    return train_step

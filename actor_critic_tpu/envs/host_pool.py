@@ -1,0 +1,203 @@
+"""Host environment pool: gymnasium/MuJoCo behind the JaxEnv-like protocol.
+
+The reference steps single host envs inline with the session loop
+(SURVEY.md §3.1-3.2; reference mount empty, §0). Here host envs are a
+batched pool (SyncVectorEnv, SAME_STEP autoreset) whose step/reset
+semantics mirror envs/jax_env.py exactly — `done` marks the ending step,
+`final_obs` carries the pre-reset observation, the returned obs is the
+new episode's — so trainers see one protocol regardless of backend.
+
+Includes the genre-standard MuJoCo preprocessing (SURVEY §2.1 "Env
+wrappers"): running mean/std observation normalization (clipped) and
+discounted-return-scale reward normalization, both checkpointable via
+`get_state`/`set_state`.
+
+On this machine the host has a single CPU core (SURVEY §7.0), so the pool
+is the throughput-limiting path by design; the trainers overlap device
+compute with host stepping where it matters (SURVEY §7.2 item 2).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import numpy as np
+
+from actor_critic_tpu.envs.jax_env import EnvSpec
+
+
+class RunningMeanStd:
+    """Welford-style running mean/variance over batches (float64 host-side)."""
+
+    def __init__(self, shape: tuple[int, ...]):
+        self.mean = np.zeros(shape, np.float64)
+        self.var = np.ones(shape, np.float64)
+        self.count = 1e-4
+
+    def update(self, x: np.ndarray) -> None:
+        bmean = x.mean(axis=0)
+        bvar = x.var(axis=0)
+        bcount = x.shape[0]
+        delta = bmean - self.mean
+        tot = self.count + bcount
+        self.mean = self.mean + delta * bcount / tot
+        m_a = self.var * self.count
+        m_b = bvar * bcount
+        m2 = m_a + m_b + delta**2 * self.count * bcount / tot
+        self.var = m2 / tot
+        self.count = tot
+
+    def normalize(self, x: np.ndarray, clip: float) -> np.ndarray:
+        z = (x - self.mean) / np.sqrt(self.var + 1e-8)
+        return np.clip(z, -clip, clip).astype(np.float32)
+
+    def state_dict(self) -> dict[str, Any]:
+        return {"mean": self.mean, "var": self.var, "count": self.count}
+
+    def load_state_dict(self, d: dict[str, Any]) -> None:
+        self.mean = np.asarray(d["mean"], np.float64)
+        self.var = np.asarray(d["var"], np.float64)
+        self.count = float(d["count"])
+
+
+@dataclasses.dataclass
+class HostStepOutput:
+    obs: np.ndarray          # post-reset obs (normalized)
+    reward: np.ndarray       # normalized reward
+    raw_reward: np.ndarray   # unnormalized (for episode-return reporting)
+    done: np.ndarray         # 1.0 where episode ended this step
+    terminated: np.ndarray   # true termination (cuts bootstrap)
+    final_obs: np.ndarray    # pre-reset obs (normalized); == obs if not done
+
+
+class HostEnvPool:
+    """Batched gymnasium envs with normalization, one `step(actions)` call.
+
+    Actions: for Box spaces the policy's raw (Gaussian) actions are clipped
+    to the space bounds; for Discrete they pass through as int arrays.
+    """
+
+    def __init__(
+        self,
+        env_id: str,
+        num_envs: int,
+        seed: int = 0,
+        normalize_obs: bool = True,
+        normalize_reward: bool = True,
+        clip_obs: float = 10.0,
+        clip_reward: float = 10.0,
+        gamma: float = 0.99,
+    ):
+        import gymnasium as gym
+        from gymnasium.vector import AutoresetMode, SyncVectorEnv
+
+        self.env_id = env_id
+        self.num_envs = num_envs
+        self._envs = SyncVectorEnv(
+            [lambda: gym.make(env_id) for _ in range(num_envs)],
+            autoreset_mode=AutoresetMode.SAME_STEP,
+        )
+        space = self._envs.single_action_space
+        obs_space = self._envs.single_observation_space
+        self._discrete = hasattr(space, "n")
+        if self._discrete:
+            action_dim = int(space.n)
+            self._act_low = self._act_high = None
+        else:
+            action_dim = int(np.prod(space.shape))
+            self._act_low = np.asarray(space.low, np.float32)
+            self._act_high = np.asarray(space.high, np.float32)
+        self.spec = EnvSpec(
+            obs_shape=tuple(obs_space.shape),
+            action_dim=action_dim,
+            discrete=self._discrete,
+            can_truncate=True,
+        )
+        self._seed = seed
+        self._normalize_obs = normalize_obs
+        self._normalize_reward = normalize_reward
+        self._clip_obs = clip_obs
+        self._clip_reward = clip_reward
+        self._gamma = gamma
+        self.obs_rms = RunningMeanStd(tuple(obs_space.shape))
+        self.ret_rms = RunningMeanStd(())
+        self._returns = np.zeros(num_envs, np.float64)
+
+    # -- normalization ----------------------------------------------------
+    def _norm_obs(self, obs: np.ndarray, update: bool = True) -> np.ndarray:
+        obs = np.asarray(obs, np.float32)
+        if not self._normalize_obs:
+            return obs
+        if update:
+            self.obs_rms.update(obs)
+        return self.obs_rms.normalize(obs, self._clip_obs)
+
+    def _norm_reward(self, reward: np.ndarray, done: np.ndarray) -> np.ndarray:
+        reward = np.asarray(reward, np.float64)
+        if not self._normalize_reward:
+            return reward.astype(np.float32)
+        self._returns = self._returns * self._gamma * (1.0 - done) + reward
+        self.ret_rms.update(self._returns)
+        scaled = reward / np.sqrt(self.ret_rms.var + 1e-8)
+        return np.clip(scaled, -self._clip_reward, self._clip_reward).astype(
+            np.float32
+        )
+
+    # -- protocol ---------------------------------------------------------
+    def reset(self) -> np.ndarray:
+        obs, _ = self._envs.reset(seed=self._seed)
+        self._returns[:] = 0.0
+        return self._norm_obs(obs)
+
+    def step(self, actions: np.ndarray) -> HostStepOutput:
+        actions = np.asarray(actions)
+        if self._discrete:
+            actions = actions.astype(np.int64)
+        else:
+            actions = np.clip(
+                actions.astype(np.float32), self._act_low, self._act_high
+            )
+        obs, reward, term, trunc, info = self._envs.step(actions)
+        term = np.asarray(term)
+        trunc = np.asarray(trunc)
+        done = (term | trunc).astype(np.float32)
+
+        final_obs = np.asarray(obs, np.float32).copy()
+        if "final_obs" in info:
+            for i, fo in enumerate(info["final_obs"]):
+                if fo is not None:
+                    final_obs[i] = fo
+
+        nobs = self._norm_obs(obs)
+        # final_obs normalized with the SAME stats, not updating them twice.
+        nfinal = (
+            self.obs_rms.normalize(final_obs, self._clip_obs)
+            if self._normalize_obs
+            else final_obs.astype(np.float32)
+        )
+        nreward = self._norm_reward(reward, done)
+        return HostStepOutput(
+            obs=nobs,
+            reward=nreward,
+            raw_reward=np.asarray(reward, np.float32),
+            done=done,
+            terminated=term.astype(np.float32),
+            final_obs=nfinal,
+        )
+
+    # -- checkpointable state --------------------------------------------
+    def get_state(self) -> dict[str, Any]:
+        return {
+            "obs_rms": self.obs_rms.state_dict(),
+            "ret_rms": self.ret_rms.state_dict(),
+            "returns": self._returns.copy(),
+        }
+
+    def set_state(self, state: dict[str, Any]) -> None:
+        self.obs_rms.load_state_dict(state["obs_rms"])
+        self.ret_rms.load_state_dict(state["ret_rms"])
+        self._returns = np.asarray(state["returns"], np.float64).copy()
+
+    def close(self) -> None:
+        self._envs.close()

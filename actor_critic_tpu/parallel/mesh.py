@@ -56,20 +56,22 @@ def multihost_init(coordinator: Optional[str] = None) -> None:
     Must be called before anything initializes the XLA backend (JAX's
     `distributed.initialize` raises otherwise), so the single-process
     check CANNOT use `jax.process_count()` — that call would itself
-    initialize the backend. Instead: initialize iff a coordinator is
-    given explicitly or the standard cluster env vars are present
-    (TPU pod slices / JAX_COORDINATOR_ADDRESS); plain single-process
-    runs fall through as a no-op.
+    initialize the backend. Instead we let JAX's own cluster
+    auto-detection (SLURM, Open MPI, Cloud TPU pod metadata,
+    JAX_COORDINATOR_ADDRESS, ...) decide: if it finds no cluster, its
+    error is swallowed and the process runs single-host.
     """
-    import os
-
     if coordinator is not None:
         jax.distributed.initialize(coordinator_address=coordinator)
         return
-    if os.environ.get("JAX_COORDINATOR_ADDRESS") or os.environ.get(
-        "COORDINATOR_ADDRESS"
-    ):
+    try:
         jax.distributed.initialize()
+    except RuntimeError:
+        # Backend already initialized — a real misuse worth surfacing.
+        raise
+    except Exception:
+        # No recognizable cluster environment: single-process no-op.
+        pass
 
 
 # --- collective helpers: no-op when axis_name is None ---------------------

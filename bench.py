@@ -22,7 +22,6 @@ import time
 
 def main() -> None:
     import jax
-    import jax.numpy as jnp
 
     from actor_critic_tpu.algos import a2c
     from actor_critic_tpu.envs import make_cartpole
@@ -37,7 +36,6 @@ def main() -> None:
     state = a2c.init_state(env, cfg, jax.random.key(0))
     train_step = a2c.make_train_step(env, cfg)
 
-    @jax.jit
     def run_block(state):
         def body(s, _):
             s, _m = train_step(s)

@@ -1,0 +1,65 @@
+"""HostEnvPool protocol tests (gymnasium-backed; no MuJoCo needed)."""
+
+import numpy as np
+import pytest
+
+gym = pytest.importorskip("gymnasium")
+
+from actor_critic_tpu.envs.host_pool import HostEnvPool, RunningMeanStd
+
+
+def test_running_mean_std_matches_numpy():
+    rms = RunningMeanStd((3,))
+    rng = np.random.RandomState(0)
+    chunks = [rng.randn(17, 3) * 2.0 + 1.0 for _ in range(5)]
+    for c in chunks:
+        rms.update(c)
+    allx = np.concatenate(chunks)
+    np.testing.assert_allclose(rms.mean, allx.mean(0), rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(rms.var, allx.var(0), rtol=1e-3, atol=1e-4)
+
+
+def test_pool_protocol_cartpole():
+    pool = HostEnvPool("CartPole-v1", num_envs=3, seed=0, normalize_obs=True)
+    assert pool.spec.discrete and pool.spec.action_dim == 2
+    obs = pool.reset()
+    assert obs.shape == (3, 4) and obs.dtype == np.float32
+    total_done = 0
+    for t in range(250):
+        out = pool.step(np.ones(3, np.int64))
+        assert out.obs.shape == (3, 4)
+        assert out.reward.shape == (3,)
+        if out.done.any():
+            total_done += int(out.done.sum())
+            # final_obs rows where done differ from the fresh-reset obs rows
+            i = int(np.nonzero(out.done)[0][0])
+            assert not np.allclose(out.final_obs[i], out.obs[i])
+        else:
+            np.testing.assert_array_equal(out.final_obs, out.obs)
+    assert total_done > 0, "constant-action CartPole must terminate episodes"
+    # raw rewards are unnormalized (always 1.0 in CartPole)
+    np.testing.assert_allclose(out.raw_reward, np.ones(3))
+    pool.close()
+
+
+def test_pool_state_roundtrip():
+    pool = HostEnvPool("CartPole-v1", num_envs=2, seed=1)
+    pool.reset()
+    for _ in range(30):
+        pool.step(np.zeros(2, np.int64))
+    st = pool.get_state()
+    pool2 = HostEnvPool("CartPole-v1", num_envs=2, seed=1)
+    pool2.set_state(st)
+    np.testing.assert_allclose(pool2.obs_rms.mean, pool.obs_rms.mean)
+    np.testing.assert_allclose(pool2.ret_rms.var, pool.ret_rms.var)
+    pool.close()
+    pool2.close()
+
+
+def test_pool_clips_continuous_actions():
+    pytest.importorskip("mujoco")
+    pool = HostEnvPool("HalfCheetah-v5", num_envs=1, seed=0)
+    pool.reset()
+    out = pool.step(np.full((1, 6), 100.0, np.float32))  # way out of bounds
+    assert np.isfinite(out.obs).all()
+    pool.close()

@@ -1,0 +1,142 @@
+"""PPO unit + learning tests (SURVEY.md §4): ratio/clip edge cases against
+hand-computed values, and convergence on analytic envs."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from actor_critic_tpu.algos import ppo
+from actor_critic_tpu.envs import make_point_mass, make_two_state_mdp
+
+
+def _const_batch(B=8):
+    return ppo.PPOBatch(
+        obs=jnp.zeros((B, 2)),
+        action=jnp.zeros((B,), jnp.int32),
+        log_prob_old=jnp.zeros((B,)),
+        value_old=jnp.zeros((B,)),
+        advantage=jnp.ones((B,)),
+        ret=jnp.zeros((B,)),
+    )
+
+
+def test_ppo_loss_clip_edges():
+    """Hand-check the clipped surrogate on controlled ratios."""
+    cfg = ppo.PPOConfig(clip_eps=0.2, normalize_adv=False, vf_clip=0.0,
+                        entropy_coef=0.0, value_coef=0.0)
+
+    # Fake apply_fn: log_prob = theta (scalar param broadcast), value = 0.
+    class FakeDist:
+        def __init__(self, lp):
+            self._lp = lp
+        def log_prob(self, a):
+            return jnp.broadcast_to(self._lp, a.shape)
+        def entropy(self):
+            return jnp.zeros(())
+
+    def apply_fn(theta, obs):
+        return FakeDist(theta), jnp.zeros(obs.shape[0])
+
+    batch = _const_batch()
+
+    # positive advantage: ratio above 1+eps must be clipped -> grad 0
+    loss_fn = lambda th: ppo.ppo_loss(th, apply_fn, batch, cfg)[0]
+    theta_hi = jnp.log(1.5)  # ratio 1.5 > 1.2
+    g = jax.grad(loss_fn)(theta_hi)
+    np.testing.assert_allclose(float(g), 0.0, atol=1e-6)
+    # loss value equals -clip(1.5 -> 1.2)*adv = -1.2
+    np.testing.assert_allclose(float(loss_fn(theta_hi)), -1.2, rtol=1e-5)
+
+    # ratio inside the clip band: gradient flows (= -ratio)
+    theta_in = jnp.log(1.1)
+    g_in = jax.grad(loss_fn)(theta_in)
+    np.testing.assert_allclose(float(g_in), -1.1, rtol=1e-5)
+
+    # negative advantage, ratio below 1-eps: clipped -> grad 0
+    batch_neg = batch._replace(advantage=-jnp.ones(8))
+    loss_fn_neg = lambda th: ppo.ppo_loss(th, apply_fn, batch_neg, cfg)[0]
+    theta_lo = jnp.log(0.5)
+    g_neg = jax.grad(loss_fn_neg)(theta_lo)
+    np.testing.assert_allclose(float(g_neg), 0.0, atol=1e-6)
+    np.testing.assert_allclose(float(loss_fn_neg(theta_lo)), 0.8, rtol=1e-5)
+
+
+def test_ppo_value_clip():
+    cfg = ppo.PPOConfig(vf_clip=0.1, normalize_adv=False, entropy_coef=0.0,
+                        value_coef=1.0, clip_eps=0.2)
+
+    class ZeroDist:
+        def log_prob(self, a):
+            return jnp.zeros(a.shape)
+        def entropy(self):
+            return jnp.zeros(())
+
+    def apply_fn(v, obs):
+        return ZeroDist(), jnp.broadcast_to(v, (obs.shape[0],))
+
+    batch = _const_batch()._replace(
+        value_old=jnp.zeros((8,)), ret=jnp.ones((8,)), advantage=jnp.zeros((8,))
+    )
+    # v = 0.5: clipped to 0.1; loss = 0.5*max((0.5-1)^2, (0.1-1)^2) = 0.5*0.81
+    loss, m = ppo.ppo_loss(jnp.asarray(0.5), apply_fn, batch, cfg)
+    np.testing.assert_allclose(float(loss), 0.5 * 0.81, rtol=1e-5)
+
+
+def test_ppo_update_shapes_and_determinism():
+    env = make_two_state_mdp()
+    cfg = ppo.PPOConfig(num_envs=8, rollout_steps=8, epochs=2,
+                        num_minibatches=4, hidden=(16,))
+    state = ppo.init_state(env, cfg, jax.random.key(0))
+    step = jax.jit(ppo.make_train_step(env, cfg))
+    s1, m1 = step(state)
+    s2, m2 = step(state)
+    # same input state => bitwise-identical result (determinism, SURVEY §4)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        s1.params,
+        s2.params,
+    )
+    assert np.isfinite(float(m1["approx_kl"]))
+    assert 0.0 <= float(m1["clip_frac"]) <= 1.0
+
+
+def test_ppo_update_rejects_indivisible_batch():
+    env = make_two_state_mdp()
+    cfg = ppo.PPOConfig(num_envs=3, rollout_steps=3, num_minibatches=4, hidden=(8,))
+    state = ppo.init_state(env, cfg, jax.random.key(0))
+    with pytest.raises(ValueError, match="minibatches"):
+        ppo.make_train_step(env, cfg)(state)
+
+
+def test_ppo_learns_two_state():
+    env = make_two_state_mdp()
+    cfg = ppo.PPOConfig(
+        num_envs=16, rollout_steps=16, epochs=4, num_minibatches=4,
+        lr=3e-3, gamma=0.9, hidden=(32,), entropy_coef=0.001,
+    )
+    state = ppo.init_state(env, cfg, jax.random.key(1))
+    step = jax.jit(ppo.make_train_step(env, cfg), donate_argnums=0)
+    for _ in range(60):
+        state, metrics = step(state)
+    net = ppo.make_network(env.spec, cfg)
+    dist, v = net.apply(state.params, jnp.eye(2))
+    p1 = jax.nn.softmax(dist.logits)[:, 1]
+    assert float(p1.min()) > 0.9, f"PPO failed to learn: P(a=1)={p1}"
+    # critic fixed point with truncation bootstrap is 1/(1-gamma) = 10
+    np.testing.assert_allclose(np.asarray(v), [10.0, 10.0], rtol=0.15)
+
+
+@pytest.mark.slow
+def test_ppo_learns_point_mass_continuous():
+    env = make_point_mass()
+    cfg = ppo.PPOConfig(
+        num_envs=32, rollout_steps=16, epochs=4, num_minibatches=4,
+        lr=3e-3, hidden=(32, 32), entropy_coef=0.0,
+    )
+    state = ppo.init_state(env, cfg, jax.random.key(2))
+    step = jax.jit(ppo.make_train_step(env, cfg), donate_argnums=0)
+    for _ in range(300):
+        state, metrics = step(state)
+    # verified convergence profile: ema ≈ -0.12 at 300 iters, policy mean ≈ -pos
+    assert float(metrics["avg_return_ema"]) > -0.3
