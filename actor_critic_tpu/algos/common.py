@@ -98,6 +98,61 @@ def rollout_scan(
     return jax.lax.scan(step_fn, rstate, step_keys)
 
 
+class OffPolicyTransition(NamedTuple):
+    """One replay-ready transition (DDPG/TD3/SAC; BASELINE.json:9-10).
+
+    `next_obs` is the pre-reset successor observation (the env protocol's
+    `final_obs`), so the TD bootstrap r + γ·(1−terminated)·Q(next_obs, ·)
+    is correct across both terminations (masked) and time-limit
+    truncations (bootstrapped through). `done` is kept for episode
+    accounting, not for the bootstrap.
+    """
+
+    obs: jax.Array
+    action: jax.Array
+    reward: jax.Array
+    next_obs: jax.Array
+    terminated: jax.Array
+    done: jax.Array
+
+
+def offpolicy_rollout(
+    env: JaxEnv,
+    act_fn: Callable[[Any, jax.Array, jax.Array, jax.Array], jax.Array],
+    params: Any,
+    rstate: RolloutState,
+    key: jax.Array,
+    num_steps: int,
+    env_steps: jax.Array,
+) -> tuple[RolloutState, jax.Array, OffPolicyTransition]:
+    """Collect `num_steps` exploration steps from the vmapped env batch.
+
+    `act_fn(params, obs, key, env_steps) -> action` owns the exploration
+    policy (noise, warmup-uniform gating). `env_steps` is this device's
+    running env-step count, threaded through so warmup gating stays
+    correct inside the scan. Returns time-major [T, E, ...] transitions.
+    """
+
+    def step_fn(carry, step_key: jax.Array):
+        rs, steps = carry
+        action = act_fn(params, rs.obs, step_key, steps)
+        out = jax.vmap(env.step)(rs.env_state, action)
+        trans = OffPolicyTransition(
+            obs=rs.obs,
+            action=action,
+            reward=out.reward,
+            next_obs=out.info["final_obs"],
+            terminated=out.info["terminated"],
+            done=out.done,
+        )
+        steps = steps + rs.obs.shape[0]
+        return (RolloutState(env_state=out.state, obs=out.obs), steps), trans
+
+    step_keys = jax.random.split(key, num_steps)
+    (rstate, env_steps), traj = jax.lax.scan(step_fn, (rstate, env_steps), step_keys)
+    return rstate, env_steps, traj
+
+
 def truncation_bootstrap_rewards(
     traj: Transition,
     final_values: jax.Array,
@@ -111,6 +166,38 @@ def truncation_bootstrap_rewards(
     """
     truncated = traj.done * (1.0 - traj.terminated)
     return traj.reward + gamma * final_values * truncated
+
+
+def evaluate(
+    env: JaxEnv,
+    act_fn: Callable[[Any, jax.Array], jax.Array],
+    params: Any,
+    key: jax.Array,
+    num_envs: int = 32,
+    num_steps: int = 256,
+) -> jax.Array:
+    """Greedy eval: mean return of each env's FIRST episode (SURVEY §3.4).
+
+    `act_fn(params, obs) -> action` is the deterministic policy (mode /
+    mean action). Rewards stop accumulating at the first `done`; envs
+    whose episode outlives `num_steps` contribute their partial return.
+    One jittable program; used by trainers' periodic eval and the
+    learning tests.
+    """
+    keys = jax.random.split(key, num_envs)
+    env_state, obs = jax.vmap(env.reset)(keys)
+    init = (env_state, obs, jnp.zeros(num_envs), jnp.ones(num_envs))
+
+    def step(carry, _):
+        env_state, obs, ret, alive = carry
+        action = act_fn(params, obs)
+        out = jax.vmap(env.step)(env_state, action)
+        ret = ret + out.reward * alive
+        alive = alive * (1.0 - out.done)
+        return (out.state, out.obs, ret, alive), None
+
+    (_, _, returns, _), _ = jax.lax.scan(step, init, None, length=num_steps)
+    return jnp.mean(returns)
 
 
 def episode_metrics_update(

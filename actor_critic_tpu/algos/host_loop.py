@@ -1,0 +1,97 @@
+"""Shared host-env rollout plumbing for the `train_host` paths.
+
+PPO (on-policy), DDPG/TD3 and SAC (off-policy) all step a `HostEnvPool`
+from a host loop (SURVEY.md §3.1-3.2 host boundary; reference mount
+empty, §0) and need the same bookkeeping: stack per-step arrays into a
+time-major [K, E] block for the single host→device transfer, and track
+raw episode returns for reporting. This module owns both so the trainers
+don't each carry a diverging copy.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+
+class EpisodeTracker:
+    """Raw-return episode accounting across host steps."""
+
+    def __init__(self, num_envs: int):
+        self._ep_ret = np.zeros(num_envs)
+        self.finished: list[float] = []
+
+    def update(self, raw_reward: np.ndarray, done: np.ndarray) -> None:
+        self._ep_ret += raw_reward
+        for i in np.nonzero(done)[0]:
+            self.finished.append(float(self._ep_ret[i]))
+            self._ep_ret[i] = 0.0
+
+    def report(self, window: int = 20) -> dict[str, float]:
+        return {
+            "recent_return": (
+                float(np.mean(self.finished[-window:]))
+                if self.finished
+                else float("nan")
+            ),
+            "episodes": float(len(self.finished)),
+        }
+
+
+def host_collect(
+    pool,
+    obs: np.ndarray,
+    num_steps: int,
+    act_fn: Callable[[np.ndarray], tuple[np.ndarray, dict[str, np.ndarray]]],
+    tracker: EpisodeTracker,
+) -> tuple[np.ndarray, dict[str, np.ndarray]]:
+    """Step the pool `num_steps` times; return (last obs, stacked block).
+
+    `act_fn(obs) -> (action, extras)`; extras (e.g. log_prob/value for
+    on-policy) are recorded alongside the standard fields. The block's
+    arrays are time-major [K, E, ...] float/int numpy — exactly one
+    device transfer's worth.
+    """
+    block: dict[str, list[np.ndarray]] = {}
+
+    def record(name: str, value: np.ndarray) -> None:
+        block.setdefault(name, []).append(value)
+
+    for _ in range(num_steps):
+        action, extras = act_fn(obs)
+        out = pool.step(action)
+        record("obs", obs)
+        record("action", action)
+        for k, v in extras.items():
+            record(k, v)
+        record("reward", out.reward)
+        record("done", out.done)
+        record("terminated", out.terminated)
+        record("final_obs", out.final_obs)
+        tracker.update(out.raw_reward, out.done)
+        obs = out.obs
+
+    return obs, {k: np.stack(v) for k, v in block.items()}
+
+
+def maybe_log(
+    it: int,
+    log_every: int,
+    metrics: dict,
+    tracker: EpisodeTracker,
+    history: list,
+    log_fn: Optional[Callable[[int, dict], None]],
+    extra: Optional[dict] = None,
+) -> None:
+    """Append host-side metrics to `history` (and `log_fn`) every
+    `log_every` iterations."""
+    if (it + 1) % max(log_every, 1) != 0:
+        return
+    m = {k: float(v) for k, v in metrics.items()}
+    m.update(tracker.report())
+    if extra:
+        m.update(extra)
+    history.append((it + 1, m))
+    if log_fn is not None:
+        log_fn(it + 1, m)

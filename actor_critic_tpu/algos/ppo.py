@@ -277,6 +277,12 @@ def train_host(
     """
     import numpy as np
 
+    from actor_critic_tpu.algos.host_loop import (
+        EpisodeTracker,
+        host_collect,
+        maybe_log,
+    )
+
     key = jax.random.key(seed)
     key, pkey = jax.random.split(key)
     params, opt_state = init_host_params(pool.spec, cfg, pkey)
@@ -284,41 +290,25 @@ def train_host(
     update = make_host_update_step(pool.spec, cfg, can_truncate=True)
 
     obs = pool.reset()
-    E = pool.num_envs
-    T = cfg.rollout_steps
-    ep_ret = np.zeros(E)
-    finished: list[float] = []
-    history = []
+    tracker = EpisodeTracker(pool.num_envs)
+    history: list = []
 
     for it in range(num_iterations):
-        buf = {
-            k: []
-            for k in (
-                "obs", "action", "log_prob", "value", "reward", "done",
-                "terminated", "final_obs",
-            )
-        }
-        for _ in range(T):
-            key, akey = jax.random.split(key)
-            action, logp, value = policy_step(params, jnp.asarray(obs), akey)
-            action_np = np.asarray(action)
-            out = pool.step(action_np)
-            buf["obs"].append(obs)
-            buf["action"].append(action_np)
-            buf["log_prob"].append(np.asarray(logp))
-            buf["value"].append(np.asarray(value))
-            buf["reward"].append(out.reward)
-            buf["done"].append(out.done)
-            buf["terminated"].append(out.terminated)
-            buf["final_obs"].append(out.final_obs)
-            ep_ret += out.raw_reward
-            for i in np.nonzero(out.done)[0]:
-                finished.append(float(ep_ret[i]))
-                ep_ret[i] = 0.0
-            obs = out.obs
 
+        def policy_act(o):
+            nonlocal key
+            key, akey = jax.random.split(key)
+            action, logp, value = policy_step(params, jnp.asarray(o), akey)
+            return np.asarray(action), {
+                "log_prob": np.asarray(logp),
+                "value": np.asarray(value),
+            }
+
+        obs, block = host_collect(
+            pool, obs, cfg.rollout_steps, policy_act, tracker
+        )
         key, ukey = jax.random.split(key)
-        arrays = {k: jnp.asarray(np.stack(v)) for k, v in buf.items()}
+        arrays = {k: jnp.asarray(v) for k, v in block.items()}
         params, opt_state, metrics = update(
             params, opt_state,
             arrays["obs"], arrays["action"], arrays["log_prob"],
@@ -326,13 +316,7 @@ def train_host(
             arrays["terminated"], arrays["final_obs"],
             jnp.asarray(obs), ukey,
         )
-        if (it + 1) % max(log_every, 1) == 0:
-            m = {k: float(v) for k, v in metrics.items()}
-            m["recent_return"] = float(np.mean(finished[-20:])) if finished else float("nan")
-            m["episodes"] = len(finished)
-            history.append((it + 1, m))
-            if log_fn is not None:
-                log_fn(it + 1, m)
+        maybe_log(it, log_every, metrics, tracker, history, log_fn)
     return params, opt_state, history
 
 

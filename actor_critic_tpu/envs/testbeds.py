@@ -100,11 +100,15 @@ class _PointMassState(NamedTuple):
     t: jax.Array
 
 
-def make_point_mass(horizon: int = 16) -> JaxEnv:
+def make_point_mass(horizon: int = 16, pos_clip: float = 2.0) -> JaxEnv:
     """1-d continuous control: obs = [pos]; reward = −(pos+a)²; pos' = pos+a.
 
     Optimal action a* = −pos (within [−1, 1]); fixed-horizon episodes.
-    Positions start uniform in [−0.5, 0.5] so a* is always reachable.
+    Positions start uniform in [−0.5, 0.5] so a* is always reachable, and
+    are clipped to ±pos_clip so the state space stays bounded — without
+    the clip a bad early policy random-walks positions to ±horizon and
+    off-policy critics spend their capacity fitting that divergent regime
+    (the analytic testbeds are meant to be well-conditioned; SURVEY §4).
     """
 
     def reset(key):
@@ -115,7 +119,7 @@ def make_point_mass(horizon: int = 16) -> JaxEnv:
 
     def raw_step(state, action):
         a = jnp.clip(action.reshape(()), -1.0, 1.0)
-        npos = state.pos + a
+        npos = jnp.clip(state.pos + a, -pos_clip, pos_clip)
         reward = -(npos**2)
         t = state.t + 1
         nstate = _PointMassState(pos=npos, key=state.key, t=t)

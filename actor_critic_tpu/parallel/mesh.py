@@ -69,9 +69,19 @@ def multihost_init(coordinator: Optional[str] = None) -> None:
     except RuntimeError:
         # Backend already initialized — a real misuse worth surfacing.
         raise
-    except Exception:
-        # No recognizable cluster environment: single-process no-op.
-        pass
+    except Exception as e:
+        # No recognizable cluster environment: single-process no-op. The
+        # exception is logged because a *detected-but-misconfigured*
+        # cluster (malformed SLURM/pod env vars) lands here too, and
+        # silently running N independent single-host trainings would be
+        # much worse than a startup crash.
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "jax.distributed.initialize() failed (%s: %s); continuing "
+            "single-host. If this job was meant to be multi-host, fix the "
+            "cluster env or pass coordinator= explicitly.", type(e).__name__, e,
+        )
 
 
 # --- collective helpers: no-op when axis_name is None ---------------------

@@ -1,0 +1,17 @@
+from actor_critic_tpu.replay.buffer import (
+    ReplayState,
+    add_batch,
+    capacity_of,
+    init,
+    sample,
+    sample_sequences,
+)
+
+__all__ = [
+    "ReplayState",
+    "add_batch",
+    "capacity_of",
+    "init",
+    "sample",
+    "sample_sequences",
+]

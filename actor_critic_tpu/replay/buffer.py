@@ -1,0 +1,121 @@
+"""HBM-resident replay buffer — pure-functional ring over device arrays.
+
+The reference keeps its off-policy transition store in host RAM and pays a
+host→device copy on every `buffer.sample(B)` (SURVEY.md §3.2 boundary
+analysis; reference mount empty, §0). The TPU-native design keeps the
+whole ring IN HBM as a pytree of `[capacity, ...]` arrays that lives
+inside the (donated) training state: inserts are index-scatters, sampling
+is an on-device gather with on-device PRNG, and neither ever touches the
+host (BASELINE.json:5 "off-policy replay buffer lives in HBM",
+BASELINE.json:9).
+
+Donation discipline (SURVEY.md §7.2 item 4): every function here is pure
+and returns a new `ReplayState`; callers close over them inside a jitted
+train step whose state argument is donated (`donate_argnums=0`), so XLA
+updates the multi-GB storage in place instead of copying it each step
+(verified by the buffer-pointer test in tests/test_replay.py).
+
+Sharding: under data-parallel training each device holds an independent
+shard of the ring (its own envs feed it, its own sampler reads it) — the
+buffer needs no collectives, so `ReplayState` simply takes `P("dp")` in
+the dp PartitionSpec tree.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class ReplayState(NamedTuple):
+    """The ring: storage pytree of [capacity, ...] arrays + write cursor.
+
+    `insert_pos` is the next slot to write; `size` counts valid entries
+    (saturates at capacity once the ring has wrapped).
+    """
+
+    storage: Any
+    insert_pos: jax.Array  # int32
+    size: jax.Array  # int32
+
+
+def capacity_of(state: ReplayState) -> int:
+    """Static ring capacity (leading dim of every storage leaf)."""
+    return jax.tree.leaves(state.storage)[0].shape[0]
+
+
+def init(example_item: Any, capacity: int) -> ReplayState:
+    """Allocate a zeroed ring shaped after one example item.
+
+    `example_item` is a pytree of per-transition arrays (no batch axis);
+    storage leaves get shape [capacity, *item_shape] and the item's dtype.
+    """
+    storage = jax.tree.map(
+        lambda x: jnp.zeros((capacity, *jnp.shape(x)), jnp.asarray(x).dtype),
+        example_item,
+    )
+    return ReplayState(
+        storage=storage,
+        insert_pos=jnp.zeros((), jnp.int32),
+        size=jnp.zeros((), jnp.int32),
+    )
+
+
+def add_batch(state: ReplayState, batch: Any) -> ReplayState:
+    """Insert a [B, ...] batch of transitions, wrapping around the ring.
+
+    B is static (leaf shape). Indices are computed mod capacity so a
+    batch can straddle the wrap point; XLA lowers the `.at[idx].set` to an
+    in-place scatter when the state is donated.
+    """
+    capacity = capacity_of(state)
+    b = jax.tree.leaves(batch)[0].shape[0]
+    idx = (state.insert_pos + jnp.arange(b, dtype=jnp.int32)) % capacity
+    storage = jax.tree.map(
+        lambda s, x: s.at[idx].set(x.astype(s.dtype)), state.storage, batch
+    )
+    return ReplayState(
+        storage=storage,
+        insert_pos=(state.insert_pos + b) % capacity,
+        size=jnp.minimum(state.size + b, capacity),
+    )
+
+
+def sample(state: ReplayState, key: jax.Array, batch_size: int) -> Any:
+    """Uniform sample of `batch_size` transitions (with replacement).
+
+    On-device RNG + gather: no host round-trip (SURVEY §3.2). Callers
+    must not sample an empty buffer (standard warmup contract); the
+    maximum(size, 1) guard only keeps the randint bounds legal under
+    tracing.
+    """
+    idx = jax.random.randint(
+        key, (batch_size,), 0, jnp.maximum(state.size, 1), dtype=jnp.int32
+    )
+    return jax.tree.map(lambda s: s[idx], state.storage)
+
+
+def sample_sequences(
+    state: ReplayState, key: jax.Array, batch_size: int, seq_len: int
+) -> Any:
+    """Sample `batch_size` sequences of `seq_len` consecutive INSERTS.
+
+    Start offsets are drawn in insertion order relative to the oldest
+    valid entry, so a window can wrap around the physical ring but never
+    crosses the write-cursor seam (which would splice the newest and
+    oldest transitions into a fabricated sequence). Callers ensure
+    size >= seq_len. Returned leaves are [batch_size, seq_len, ...].
+    Sequences may still span episode boundaries; consumers mask on their
+    stored `done` flags.
+    """
+    capacity = capacity_of(state)
+    # Oldest valid entry: physical slot 0 until the ring fills, then the
+    # slot the cursor is about to overwrite.
+    oldest = jnp.where(state.size < capacity, 0, state.insert_pos)
+    max_start = jnp.maximum(state.size - seq_len + 1, 1)
+    start = jax.random.randint(key, (batch_size,), 0, max_start, dtype=jnp.int32)
+    offsets = jnp.arange(seq_len, dtype=jnp.int32)
+    idx = (oldest + start[:, None] + offsets[None, :]) % capacity
+    return jax.tree.map(lambda s: s[idx], state.storage)

@@ -1,0 +1,173 @@
+"""DDPG/TD3 tests: update mechanics (warmup gating, policy delay, twin-Q
+targets) + learning on the analytic point-mass env (SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from actor_critic_tpu import replay
+from actor_critic_tpu.algos import ddpg
+from actor_critic_tpu.algos.common import OffPolicyTransition
+from actor_critic_tpu.envs import make_point_mass
+
+
+def _small_cfg(**kw):
+    base = dict(
+        num_envs=16,
+        steps_per_iter=4,
+        updates_per_iter=2,
+        buffer_capacity=4096,
+        batch_size=64,
+        hidden=(32, 32),
+        actor_lr=1e-3,
+        critic_lr=1e-3,
+        warmup_steps=128,
+    )
+    base.update(kw)
+    return ddpg.DDPGConfig(**base)
+
+
+def _filled_learner(cfg, key=0, n_items=512, obs_dim=1, act_dim=1):
+    """Learner whose ring already holds random transitions."""
+    k = jax.random.key(key)
+    k, lk, dk = jax.random.split(k, 3)
+    learner = ddpg.init_learner((obs_dim,), act_dim, cfg, lk)
+    ks = jax.random.split(dk, 4)
+    batch = OffPolicyTransition(
+        obs=jax.random.normal(ks[0], (n_items, obs_dim)),
+        action=jax.random.uniform(ks[1], (n_items, act_dim), minval=-1, maxval=1),
+        reward=jax.random.normal(ks[2], (n_items,)),
+        next_obs=jax.random.normal(ks[3], (n_items, obs_dim)),
+        terminated=jnp.zeros((n_items,)),
+        done=jnp.zeros((n_items,)),
+    )
+    return learner._replace(replay=replay.add_batch(learner.replay, batch))
+
+
+def _greedy_eval(env, cfg, state) -> float:
+    from actor_critic_tpu.algos.common import evaluate
+
+    actor, _ = ddpg._modules(env.spec.action_dim, cfg)
+    ret = evaluate(
+        env, actor.apply, state.learner.actor_params, jax.random.key(99),
+        num_envs=32, num_steps=16,
+    )
+    return float(ret)
+
+
+def _params_equal(a, b):
+    return all(
+        bool(jnp.all(x == y)) for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+    )
+
+
+class TestUpdateMechanics:
+    def test_warmup_blocks_learning(self):
+        cfg = _small_cfg(updates_per_iter=1)
+        learner = _filled_learner(cfg)
+        loop = ddpg.make_update_loop(1, cfg)
+        new, _ = loop(learner, jnp.asarray(False))
+        assert _params_equal(new.actor_params, learner.actor_params)
+        assert _params_equal(new.critic_params, learner.critic_params)
+        assert int(new.update_count) == 0
+
+    def test_update_changes_params(self):
+        cfg = _small_cfg(updates_per_iter=1)
+        learner = _filled_learner(cfg)
+        loop = ddpg.make_update_loop(1, cfg)
+        new, metrics = loop(learner, jnp.asarray(True))
+        assert not _params_equal(new.critic_params, learner.critic_params)
+        assert not _params_equal(new.actor_params, learner.actor_params)
+        assert int(new.update_count) == 1
+        assert np.isfinite(float(metrics["critic_loss"]))
+
+    def test_policy_delay(self):
+        """With delay=2, updates 0,2,... touch the actor; 1,3,... don't."""
+        cfg = _small_cfg(updates_per_iter=1, twin_q=True, policy_delay=2)
+        learner = _filled_learner(cfg)
+        loop = jax.jit(ddpg.make_update_loop(1, cfg))
+        s1, _ = loop(learner, jnp.asarray(True))  # count 0 → actor moves
+        assert not _params_equal(s1.actor_params, learner.actor_params)
+        s2, _ = loop(s1, jnp.asarray(True))  # count 1 → actor frozen
+        assert _params_equal(s2.actor_params, s1.actor_params)
+        assert _params_equal(s2.target_actor, s1.target_actor)
+        s3, _ = loop(s2, jnp.asarray(True))  # count 2 → actor moves again
+        assert not _params_equal(s3.actor_params, s2.actor_params)
+
+    def test_target_nets_polyak_not_copy(self):
+        cfg = _small_cfg(updates_per_iter=1, tau=0.005)
+        learner = _filled_learner(cfg)
+        new, _ = ddpg.make_update_loop(1, cfg)(learner, jnp.asarray(True))
+        # targets moved but only slightly (τ-weighted), not a hard copy
+        assert not _params_equal(new.target_critic, learner.target_critic)
+        assert not _params_equal(new.target_critic, new.critic_params)
+
+    def test_twin_q_shapes(self):
+        cfg = _small_cfg(twin_q=True)
+        _, critic = ddpg._modules(2, cfg)
+        params = critic.init(jax.random.key(0), jnp.zeros((3, 4)), jnp.zeros((3, 2)))
+        q1, q2 = critic.apply(params, jnp.zeros((3, 4)), jnp.zeros((3, 2)))
+        assert q1.shape == q2.shape == (3,)
+
+
+class TestFusedTrainer:
+    def test_smoke_and_accounting(self):
+        env = make_point_mass()
+        cfg = _small_cfg()
+        state, metrics = ddpg.train(env, cfg, num_iterations=3, seed=0)
+        assert int(state.update_step) == 3
+        assert int(state.env_steps) == 3 * cfg.steps_per_iter * cfg.num_envs
+        for v in metrics.values():
+            assert np.isfinite(float(v))
+
+    def test_warmup_random_actions_fill_replay(self):
+        env = make_point_mass()
+        cfg = _small_cfg(warmup_steps=10_000)
+        state, _ = ddpg.train(env, cfg, num_iterations=2, seed=0)
+        assert int(state.learner.replay.size) == 2 * cfg.steps_per_iter * cfg.num_envs
+        assert int(state.learner.update_count) == 0  # still warming up
+
+    def test_ddpg_learns_point_mass(self):
+        env = make_point_mass()
+        cfg = _small_cfg(
+            updates_per_iter=4, exploration_noise=0.2, warmup_steps=256,
+            buffer_capacity=32768,  # hold the whole run: stale-regime-free
+        )
+        state, _ = ddpg.train(env, cfg, num_iterations=250, seed=1)
+        # Optimal per-episode return is 0; random policy averages ≈ −6.
+        ret = _greedy_eval(env, cfg, state)
+        assert ret > -1.0, ret
+
+    def test_td3_learns_point_mass(self):
+        env = make_point_mass()
+        cfg = ddpg.td3_config(
+            num_envs=16, steps_per_iter=4, updates_per_iter=4,
+            buffer_capacity=32768, batch_size=64, hidden=(32, 32),
+            actor_lr=1e-3, critic_lr=1e-3, warmup_steps=256,
+            exploration_noise=0.2,
+        )
+        state, _ = ddpg.train(env, cfg, num_iterations=250, seed=2)
+        ret = _greedy_eval(env, cfg, state)
+        assert ret > -1.0, ret
+
+
+class TestHostPath:
+    def test_host_ingest_update(self):
+        """Host-block ingest inserts [K,E] transitions and updates."""
+        cfg = _small_cfg(updates_per_iter=1, warmup_steps=0, batch_size=32)
+        learner = ddpg.init_learner((3,), 2, cfg, jax.random.key(0))
+        ingest = ddpg.make_host_ingest_update(2, cfg)
+        K, E = 4, 8
+        k = jax.random.key(1)
+        traj = OffPolicyTransition(
+            obs=jax.random.normal(k, (K, E, 3)),
+            action=jnp.zeros((K, E, 2)),
+            reward=jnp.ones((K, E)),
+            next_obs=jax.random.normal(k, (K, E, 3)),
+            terminated=jnp.zeros((K, E)),
+            done=jnp.zeros((K, E)),
+        )
+        learner, metrics = ingest(learner, traj, jnp.asarray(K * E, jnp.int32))
+        assert int(learner.replay.size) == K * E
+        assert int(learner.update_count) == 1
+        assert np.isfinite(float(metrics["critic_loss"]))

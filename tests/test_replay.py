@@ -1,0 +1,153 @@
+"""Replay buffer tests: wraparound, sampling distribution, donation
+(SURVEY.md §4 "Replay-buffer tests")."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from actor_critic_tpu import replay
+
+
+def _example():
+    return {
+        "obs": jnp.zeros((3,), jnp.float32),
+        "action": jnp.zeros((), jnp.int32),
+        "reward": jnp.zeros((), jnp.float32),
+    }
+
+
+def _batch(values, b):
+    """Batch whose obs rows encode the insert order for traceability."""
+    v = jnp.asarray(values, jnp.float32)
+    return {
+        "obs": jnp.stack([v, v + 0.1, v + 0.2], axis=-1),
+        "action": v.astype(jnp.int32),
+        "reward": v,
+    }
+
+
+class TestInit:
+    def test_shapes_dtypes(self):
+        state = replay.init(_example(), capacity=16)
+        assert state.storage["obs"].shape == (16, 3)
+        assert state.storage["action"].dtype == jnp.int32
+        assert int(state.size) == 0
+        assert replay.capacity_of(state) == 16
+
+    def test_add_grows_size(self):
+        state = replay.init(_example(), capacity=8)
+        state = replay.add_batch(state, _batch(np.arange(3), 3))
+        assert int(state.size) == 3
+        assert int(state.insert_pos) == 3
+        np.testing.assert_allclose(
+            np.asarray(state.storage["reward"][:3]), [0.0, 1.0, 2.0]
+        )
+
+
+class TestWraparound:
+    def test_exact_wrap(self):
+        state = replay.init(_example(), capacity=8)
+        for start in range(0, 16, 4):
+            state = replay.add_batch(state, _batch(np.arange(start, start + 4), 4))
+        assert int(state.size) == 8
+        assert int(state.insert_pos) == 0
+        # Ring holds the newest 8 items in physical order 8..15.
+        np.testing.assert_allclose(
+            np.asarray(state.storage["reward"]), np.arange(8, 16, dtype=np.float32)
+        )
+
+    def test_straddling_batch(self):
+        """A batch crossing the wrap point lands split across the ring."""
+        state = replay.init(_example(), capacity=8)
+        state = replay.add_batch(state, _batch(np.arange(6), 6))
+        state = replay.add_batch(state, _batch(np.arange(6, 12), 6))
+        assert int(state.size) == 8
+        assert int(state.insert_pos) == 4
+        # slots: [8, 9, 10, 11, 4, 5, 6, 7]
+        np.testing.assert_allclose(
+            np.asarray(state.storage["reward"]),
+            [8.0, 9.0, 10.0, 11.0, 4.0, 5.0, 6.0, 7.0],
+        )
+
+    def test_batch_larger_runs(self):
+        state = replay.init(_example(), capacity=4)
+        state = replay.add_batch(state, _batch(np.arange(3), 3))
+        state = replay.add_batch(state, _batch(np.arange(3, 6), 3))
+        assert int(state.size) == 4
+
+    def test_jit_add(self):
+        add = jax.jit(replay.add_batch)
+        state = replay.init(_example(), capacity=8)
+        state = add(state, _batch(np.arange(5), 5))
+        state = add(state, _batch(np.arange(5, 10), 5))
+        assert int(state.size) == 8
+        assert int(state.insert_pos) == 2
+
+
+class TestSampling:
+    def test_only_valid_entries(self):
+        """Sampling never returns the zero-initialized (unwritten) tail."""
+        state = replay.init(_example(), capacity=100)
+        state = replay.add_batch(state, _batch(np.arange(1, 11), 10))
+        out = replay.sample(state, jax.random.key(0), 256)
+        r = np.asarray(out["reward"])
+        assert r.min() >= 1.0 and r.max() <= 10.0
+        assert out["obs"].shape == (256, 3)
+
+    def test_roughly_uniform(self):
+        state = replay.init(_example(), capacity=16)
+        state = replay.add_batch(state, _batch(np.arange(16), 16))
+        out = replay.sample(state, jax.random.key(1), 16 * 2000)
+        counts = np.bincount(np.asarray(out["action"]), minlength=16)
+        freq = counts / counts.sum()
+        # Each slot ~1/16 ± generous tolerance.
+        np.testing.assert_allclose(freq, np.full(16, 1 / 16), atol=0.01)
+
+    def test_rows_internally_consistent(self):
+        """Gather keeps (obs, action, reward) of one transition together."""
+        state = replay.init(_example(), capacity=32)
+        state = replay.add_batch(state, _batch(np.arange(32), 32))
+        out = replay.sample(state, jax.random.key(2), 64)
+        np.testing.assert_allclose(
+            np.asarray(out["obs"][:, 0]), np.asarray(out["reward"])
+        )
+
+    def test_sample_sequences(self):
+        state = replay.init(_example(), capacity=64)
+        state = replay.add_batch(state, _batch(np.arange(40), 40))
+        out = replay.sample_sequences(state, jax.random.key(3), 8, 5)
+        r = np.asarray(out["reward"])
+        assert r.shape == (8, 5)
+        # Each row is consecutive inserts.
+        np.testing.assert_allclose(np.diff(r, axis=1), np.ones((8, 4)))
+        assert r.max() <= 39.0
+
+    def test_sample_sequences_after_wrap(self):
+        """Windows must never cross the write-cursor seam: a wrapped ring
+        holds inserts [8..15] in physical order [8,9,10,11,4,5,6,7]*, and
+        every sampled sequence must still be consecutive inserts."""
+        state = replay.init(_example(), capacity=8)
+        state = replay.add_batch(state, _batch(np.arange(6), 6))
+        state = replay.add_batch(state, _batch(np.arange(6, 12), 6))
+        # physical: [8, 9, 10, 11, 4, 5, 6, 7], insert_pos=4 (oldest=4)
+        out = replay.sample_sequences(state, jax.random.key(0), 64, 3)
+        r = np.asarray(out["reward"])
+        np.testing.assert_allclose(np.diff(r, axis=1), np.ones((64, 2)))
+        assert r.min() >= 4.0 and r.max() <= 11.0
+
+
+class TestDonation:
+    def test_inplace_update_under_donation(self):
+        """Donated jitted add must reuse the storage buffer (no copy of the
+        whole ring per insert — SURVEY §7.2 item 4)."""
+        state = replay.init(_example(), capacity=1024)
+        add = jax.jit(replay.add_batch, donate_argnums=0)
+        state = add(state, _batch(np.arange(4), 4))  # compile
+        before = state.storage["obs"].unsafe_buffer_pointer()
+        state = add(state, _batch(np.arange(4, 8), 4))
+        jax.block_until_ready(state)
+        after = state.storage["obs"].unsafe_buffer_pointer()
+        if before != after:
+            pytest.skip("platform did not honor donation")
+        assert int(state.size) == 8
