@@ -130,7 +130,10 @@ def offpolicy_rollout(
     `act_fn(params, obs, key, env_steps) -> action` owns the exploration
     policy (noise, warmup-uniform gating). `env_steps` is this device's
     running env-step count, threaded through so warmup gating stays
-    correct inside the scan. Returns time-major [T, E, ...] transitions.
+    correct inside the scan; it SATURATES at 2^30 so an int32 wrap can
+    never flip the warmup gate back on in a long run (total step counts
+    belong on the host — see TrainState's docstring). Returns time-major
+    [T, E, ...] transitions.
     """
 
     def step_fn(carry, step_key: jax.Array):
@@ -145,7 +148,7 @@ def offpolicy_rollout(
             terminated=out.info["terminated"],
             done=out.done,
         )
-        steps = steps + rs.obs.shape[0]
+        steps = jnp.minimum(steps + rs.obs.shape[0], jnp.int32(1 << 30))
         return (RolloutState(env_state=out.state, obs=out.obs), steps), trans
 
     step_keys = jax.random.split(key, num_steps)

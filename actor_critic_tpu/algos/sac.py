@@ -1,26 +1,18 @@
-"""DDPG / TD3 — off-policy deterministic actor-critic, replay in HBM.
+"""SAC — soft actor-critic with twin-Q and automatic entropy temperature.
 
-Capability parity with the reference's DDPG/TD3 Walker2d config
-(BASELINE.json:9: "off-policy, HBM replay buffer, target nets"; reference
-mount empty at survey, SURVEY.md §0). TD3 is DDPG plus three flags
-(`twin_q`, `policy_delay`, `target_noise`) — one implementation, two
-configs, matching how the reference layers TD3 over DDPG (SURVEY §2.1).
+Capability parity with the reference's SAC Humanoid config
+(BASELINE.json:10: "twin-Q, entropy-temperature auto-tune"; reference
+mount empty at survey, SURVEY.md §0). Same TPU-first shape as
+algos/ddpg.py: the replay ring lives in HBM, and the fused path runs
+collect → insert → J soft-policy-iteration updates as one jitted,
+donated program (SURVEY §3.2 boundary fix).
 
-TPU-first structure (SURVEY §3.2 boundary fix): one jitted train step =
-
-    lax.scan over K env steps: [actor fwd + noise → vmapped env.step]
-    → replay.add_batch (in-HBM scatter, donated)
-    → lax.scan over J updates: [replay.sample → critic TD step
-         → (delayed) actor step + Polyak targets]
-
-so replay storage, sampling RNG, and both optimizers never leave the
-device. The reference's per-update host→device `buffer.sample(B)` copy
-does not exist here. Delayed actor/target updates are branchless
-`where`-selects (no `cond` inside the vmapped/scanned update loop).
-
-For MuJoCo (host-stepped, SURVEY §7.2 item 2) `train_host` keeps the
-same learner program and feeds it one [K, E] transition block per
-iteration — a single host→device transfer.
+Per update (Haarnoja et al. 2018, soft policy iteration):
+  critic:  y = r + γ(1−term)·[min(Q̄₁,Q̄₂)(s', a') − α·log π(a'|s')],
+           a' ~ π(·|s')  (fresh sample, tanh-Gaussian)
+  actor:   min E[α·log π(a|s) − min(Q₁,Q₂)(s, a)]  (reparameterized)
+  alpha:   min E[−log α·(log π(a|s) + H_target)],  H_target = −action_dim
+  targets: Polyak on the twin critic only (no target actor in SAC).
 """
 
 from __future__ import annotations
@@ -43,93 +35,88 @@ from actor_critic_tpu.algos.common import (
 )
 from actor_critic_tpu.algos.metrics import aggregate_metrics
 from actor_critic_tpu.envs.jax_env import JaxEnv
-from actor_critic_tpu.models.networks import DeterministicActor, QFunction, TwinQ
+from actor_critic_tpu.models.networks import SquashedGaussianActor, TwinQ
 from actor_critic_tpu.ops.polyak import polyak_update
 from actor_critic_tpu.parallel import mesh as pmesh
 
 
 @dataclasses.dataclass(frozen=True)
-class DDPGConfig:
+class SACConfig:
     num_envs: int = 8
-    steps_per_iter: int = 8      # K env steps per train_step call
-    updates_per_iter: int = 8    # J gradient updates per train_step call
+    steps_per_iter: int = 8
+    updates_per_iter: int = 8
     buffer_capacity: int = 1_000_000
     batch_size: int = 256
     gamma: float = 0.99
     tau: float = 0.005
     actor_lr: float = 3e-4
     critic_lr: float = 3e-4
+    alpha_lr: float = 3e-4
     hidden: tuple[int, ...] = (256, 256)
-    exploration_noise: float = 0.1  # behavior-policy Gaussian noise std
-    warmup_steps: int = 1_000       # uniform-random action steps (per device)
-    # --- TD3 extensions (BASELINE.json:9) ---
-    twin_q: bool = False
-    policy_delay: int = 1
-    target_noise: float = 0.0       # target-policy smoothing std
-    target_noise_clip: float = 0.5
+    warmup_steps: int = 1_000
+    init_alpha: float = 1.0
+    # None → auto-tune toward target_entropy (default −action_dim);
+    # a float here freezes α at that value (no alpha optimizer step).
+    fixed_alpha: Optional[float] = None
+    target_entropy: Optional[float] = None
     bf16_compute: bool = False
 
 
-def td3_config(**overrides) -> DDPGConfig:
-    """TD3 = DDPG + twin critics, delayed policy, target smoothing."""
-    base = dict(twin_q=True, policy_delay=2, target_noise=0.2)
-    base.update(overrides)
-    return DDPGConfig(**base)
-
-
-class LearnerState(NamedTuple):
-    """Device-resident learner: params, targets, optimizers, replay ring."""
+class SACLearnerState(NamedTuple):
+    """Device-resident SAC learner (actor, twin critic, α, replay)."""
 
     actor_params: Any
     critic_params: Any
-    target_actor: Any
     target_critic: Any
     actor_opt: Any
     critic_opt: Any
+    log_alpha: jax.Array
+    alpha_opt: Any
     replay: replay.ReplayState
     key: jax.Array
-    update_count: jax.Array  # gradient updates so far (drives policy delay)
+    update_count: jax.Array
 
 
-class OffPolicyState(NamedTuple):
-    """Fused-trainer state: learner + on-device env batch + accounting."""
+class SACState(NamedTuple):
+    """Fused-trainer state: learner + env batch + accounting."""
 
-    learner: LearnerState
+    learner: SACLearnerState
     rollout: RolloutState
-    env_steps: jax.Array  # per-device env steps (warmup gating)
-    update_step: jax.Array  # train_step calls
+    env_steps: jax.Array
+    update_step: jax.Array
     ep_return: jax.Array
     ep_length: jax.Array
     avg_return: jax.Array
 
 
-def _modules(action_dim: int, cfg: DDPGConfig):
+def _modules(action_dim: int, cfg: SACConfig):
     dtype = jnp.bfloat16 if cfg.bf16_compute else jnp.float32
-    actor = DeterministicActor(action_dim, cfg.hidden, compute_dtype=dtype)
-    critic = (
-        TwinQ(cfg.hidden, compute_dtype=dtype)
-        if cfg.twin_q
-        else QFunction(cfg.hidden, compute_dtype=dtype)
-    )
+    actor = SquashedGaussianActor(action_dim, cfg.hidden, compute_dtype=dtype)
+    critic = TwinQ(cfg.hidden, compute_dtype=dtype)
     return actor, critic
 
 
-def _critic_q(critic, params, obs, action, cfg: DDPGConfig):
-    """(q1, q2) from either critic flavor; q2 is None without twin-Q."""
-    if cfg.twin_q:
-        return critic.apply(params, obs, action)
-    return critic.apply(params, obs, action), None
+def _target_entropy(action_dim: int, cfg: SACConfig) -> float:
+    return (
+        cfg.target_entropy if cfg.target_entropy is not None else -float(action_dim)
+    )
 
 
 def init_learner(
-    obs_shape: tuple[int, ...], action_dim: int, cfg: DDPGConfig, key: jax.Array
-) -> LearnerState:
+    obs_shape: tuple[int, ...], action_dim: int, cfg: SACConfig, key: jax.Array
+) -> SACLearnerState:
     actor, critic = _modules(action_dim, cfg)
     akey, ckey, lkey = jax.random.split(key, 3)
     dummy_obs = jnp.zeros((1, *obs_shape), jnp.float32)
     dummy_act = jnp.zeros((1, action_dim), jnp.float32)
     actor_params = actor.init(akey, dummy_obs)
     critic_params = critic.init(ckey, dummy_obs, dummy_act)
+    log_alpha = jnp.log(
+        jnp.asarray(
+            cfg.init_alpha if cfg.fixed_alpha is None else cfg.fixed_alpha,
+            jnp.float32,
+        )
+    )
     example = OffPolicyTransition(
         obs=jnp.zeros(obs_shape, jnp.float32),
         action=jnp.zeros((action_dim,), jnp.float32),
@@ -138,26 +125,27 @@ def init_learner(
         terminated=jnp.zeros((), jnp.float32),
         done=jnp.zeros((), jnp.float32),
     )
-    return LearnerState(
+    return SACLearnerState(
         actor_params=actor_params,
         critic_params=critic_params,
-        # Targets start equal but must be distinct buffers: the fused
-        # trainer donates its state, and XLA rejects aliased donations.
-        target_actor=jax.tree.map(jnp.copy, actor_params),
+        # Distinct buffer from the online critic: the fused trainer
+        # donates its state and XLA rejects aliased donations.
         target_critic=jax.tree.map(jnp.copy, critic_params),
         actor_opt=optax.adam(cfg.actor_lr).init(actor_params),
         critic_opt=optax.adam(cfg.critic_lr).init(critic_params),
+        log_alpha=log_alpha,
+        alpha_opt=optax.adam(cfg.alpha_lr).init(log_alpha),
         replay=replay.init(example, cfg.buffer_capacity),
         key=lkey,
         update_count=jnp.zeros((), jnp.int32),
     )
 
 
-def init_state(env: JaxEnv, cfg: DDPGConfig, key: jax.Array) -> OffPolicyState:
+def init_state(env: JaxEnv, cfg: SACConfig, key: jax.Array) -> SACState:
     key, lkey, rkey = jax.random.split(key, 3)
     learner = init_learner(env.spec.obs_shape, env.spec.action_dim, cfg, lkey)
     E = cfg.num_envs
-    return OffPolicyState(
+    return SACState(
         learner=learner,
         rollout=init_rollout(env, rkey, E),
         env_steps=jnp.zeros((), jnp.int32),
@@ -168,16 +156,14 @@ def init_state(env: JaxEnv, cfg: DDPGConfig, key: jax.Array) -> OffPolicyState:
     )
 
 
-def make_explore_fn(action_dim: int, cfg: DDPGConfig):
-    """Behavior policy: actor + clipped Gaussian noise; uniform actions
-    during warmup (branchless `where` on the env-step counter)."""
+def make_explore_fn(action_dim: int, cfg: SACConfig):
+    """Behavior policy: sample the tanh-Gaussian; uniform during warmup."""
     actor, _ = _modules(action_dim, cfg)
 
     def act(params, obs, key, env_steps):
-        nkey, ukey = jax.random.split(key)
-        a = actor.apply(params, obs)
-        a = a + cfg.exploration_noise * jax.random.normal(nkey, a.shape)
-        a = jnp.clip(a, -1.0, 1.0)
+        skey, ukey = jax.random.split(key)
+        dist = actor.apply(params, obs)
+        a = dist.sample(skey)
         rand = jax.random.uniform(ukey, a.shape, minval=-1.0, maxval=1.0)
         return jnp.where(env_steps < cfg.warmup_steps, rand, a)
 
@@ -186,52 +172,46 @@ def make_explore_fn(action_dim: int, cfg: DDPGConfig):
 
 def make_update_loop(
     action_dim: int,
-    cfg: DDPGConfig,
+    cfg: SACConfig,
     axis_name: Optional[str] = None,
-) -> Callable[[LearnerState, jax.Array], tuple[LearnerState, dict[str, jax.Array]]]:
-    """Build `(learner, do_update) → (learner, metrics)` running
-    `cfg.updates_per_iter` sample→TD→(delayed) actor steps in one scan.
-
-    `do_update` gates learning during warmup: grads are still computed
-    (static program) but params/targets/optimizer state are `where`-kept.
-    """
+) -> Callable[[SACLearnerState, jax.Array], tuple[SACLearnerState, dict]]:
+    """Build `(learner, do_update) → (learner, metrics)`: a scan of
+    `cfg.updates_per_iter` soft-policy-iteration steps. Warmup gating is
+    a branchless `where`-select, as in ddpg.make_update_loop."""
     actor, critic = _modules(action_dim, cfg)
+    h_target = _target_entropy(action_dim, cfg)
 
     def critic_loss_fn(critic_params, target_q, batch: OffPolicyTransition):
-        q1, q2 = _critic_q(critic, critic_params, batch.obs, batch.action, cfg)
-        loss = jnp.mean((q1 - target_q) ** 2)
-        if q2 is not None:
-            loss = loss + jnp.mean((q2 - target_q) ** 2)
-        return loss, jnp.mean(q1)
+        q1, q2 = critic.apply(critic_params, batch.obs, batch.action)
+        return jnp.mean((q1 - target_q) ** 2) + jnp.mean((q2 - target_q) ** 2), (
+            jnp.mean(q1)
+        )
 
-    def actor_loss_fn(actor_params, critic_params, obs):
-        a = actor.apply(actor_params, obs)
-        q1, _ = _critic_q(critic, critic_params, obs, a, cfg)
-        return -jnp.mean(q1)
+    def actor_loss_fn(actor_params, critic_params, alpha, obs, key):
+        dist = actor.apply(actor_params, obs)
+        a, logp = dist.sample_and_log_prob(key)
+        q1, q2 = critic.apply(critic_params, obs, a)
+        q = jnp.minimum(q1, q2)
+        return jnp.mean(alpha * logp - q), logp
 
     def select(mask, new, old):
         return jax.tree.map(lambda n, o: jnp.where(mask, n, o), new, old)
 
-    def one_update(ls: LearnerState, do_update: jax.Array):
-        key, skey, tkey = jax.random.split(ls.key, 3)
+    def one_update(ls: SACLearnerState, do_update: jax.Array):
+        key, skey, tkey, akey = jax.random.split(ls.key, 4)
         batch: OffPolicyTransition = replay.sample(ls.replay, skey, cfg.batch_size)
+        alpha = jnp.exp(ls.log_alpha)
 
-        # --- TD target from target nets (+TD3 smoothing) ---
-        next_a = actor.apply(ls.target_actor, batch.next_obs)
-        if cfg.target_noise > 0.0:
-            noise = jnp.clip(
-                cfg.target_noise * jax.random.normal(tkey, next_a.shape),
-                -cfg.target_noise_clip,
-                cfg.target_noise_clip,
-            )
-            next_a = jnp.clip(next_a + noise, -1.0, 1.0)
-        tq1, tq2 = _critic_q(critic, ls.target_critic, batch.next_obs, next_a, cfg)
-        next_q = tq1 if tq2 is None else jnp.minimum(tq1, tq2)
+        # --- soft TD target ---
+        next_dist = actor.apply(ls.actor_params, batch.next_obs)
+        next_a, next_logp = next_dist.sample_and_log_prob(tkey)
+        tq1, tq2 = critic.apply(ls.target_critic, batch.next_obs, next_a)
+        next_v = jnp.minimum(tq1, tq2) - alpha * next_logp
         target_q = jax.lax.stop_gradient(
-            batch.reward + cfg.gamma * (1.0 - batch.terminated) * next_q
+            batch.reward + cfg.gamma * (1.0 - batch.terminated) * next_v
         )
 
-        # --- critic step (every update) ---
+        # --- critic step ---
         (closs, q_mean), cgrads = jax.value_and_grad(critic_loss_fn, has_aux=True)(
             ls.critic_params, target_q, batch
         )
@@ -241,36 +221,46 @@ def make_update_loop(
         critic_params = select(do_update, critic_params, ls.critic_params)
         critic_opt = select(do_update, critic_opt, ls.critic_opt)
 
-        # --- actor step + Polyak (every policy_delay-th update) ---
-        do_actor = jnp.logical_and(
-            do_update, (ls.update_count % cfg.policy_delay) == 0
-        )
-        aloss, agrads = jax.value_and_grad(actor_loss_fn)(
-            ls.actor_params, critic_params, batch.obs
+        # --- actor step (fresh reparameterized sample, updated critic) ---
+        (aloss, logp), agrads = jax.value_and_grad(actor_loss_fn, has_aux=True)(
+            ls.actor_params, critic_params, alpha, batch.obs, akey
         )
         agrads = pmesh.pmean_tree(agrads, axis_name)
         aupd, actor_opt = optax.adam(cfg.actor_lr).update(agrads, ls.actor_opt)
         actor_params = optax.apply_updates(ls.actor_params, aupd)
-        actor_params = select(do_actor, actor_params, ls.actor_params)
-        actor_opt = select(do_actor, actor_opt, ls.actor_opt)
-        target_actor = select(
-            do_actor,
-            polyak_update(actor_params, ls.target_actor, cfg.tau),
-            ls.target_actor,
-        )
+        actor_params = select(do_update, actor_params, ls.actor_params)
+        actor_opt = select(do_update, actor_opt, ls.actor_opt)
+
+        # --- temperature step (skipped entirely with fixed_alpha) ---
+        if cfg.fixed_alpha is None:
+            entropy_gap = jax.lax.stop_gradient(logp + h_target)
+            alpha_grad = jnp.mean(-entropy_gap) * jnp.exp(ls.log_alpha)
+            # d/d(log α) of E[−exp(log α)·(log π + H_t)] — scalar, no AD
+            # needed; pmean'd for identical α across the dp axis.
+            alpha_grad = pmesh.pmean(alpha_grad, axis_name)
+            alupd, alpha_opt = optax.adam(cfg.alpha_lr).update(
+                alpha_grad, ls.alpha_opt
+            )
+            log_alpha = optax.apply_updates(ls.log_alpha, alupd)
+            log_alpha = jnp.where(do_update, log_alpha, ls.log_alpha)
+            alpha_opt = select(do_update, alpha_opt, ls.alpha_opt)
+        else:
+            log_alpha, alpha_opt = ls.log_alpha, ls.alpha_opt
+
         target_critic = select(
-            do_actor,
+            do_update,
             polyak_update(critic_params, ls.target_critic, cfg.tau),
             ls.target_critic,
         )
 
-        new_ls = LearnerState(
+        new_ls = SACLearnerState(
             actor_params=actor_params,
             critic_params=critic_params,
-            target_actor=target_actor,
             target_critic=target_critic,
             actor_opt=actor_opt,
             critic_opt=critic_opt,
+            log_alpha=log_alpha,
+            alpha_opt=alpha_opt,
             replay=ls.replay,
             key=key,
             update_count=ls.update_count + do_update.astype(jnp.int32),
@@ -279,10 +269,12 @@ def make_update_loop(
             "critic_loss": closs,
             "actor_loss": aloss,
             "q_mean": q_mean,
+            "alpha": jnp.exp(log_alpha),
+            "entropy_est": -jnp.mean(logp),
         }
         return new_ls, metrics
 
-    def update_loop(ls: LearnerState, do_update: jax.Array):
+    def update_loop(ls: SACLearnerState, do_update: jax.Array):
         def body(carry, _):
             return one_update(carry, do_update)
 
@@ -294,18 +286,17 @@ def make_update_loop(
 
 def make_train_step(
     env: JaxEnv,
-    cfg: DDPGConfig,
+    cfg: SACConfig,
     axis_name: Optional[str] = None,
-) -> Callable[[OffPolicyState], tuple[OffPolicyState, dict[str, jax.Array]]]:
+) -> Callable[[SACState], tuple[SACState, dict[str, jax.Array]]]:
     """The fused collect→insert→update program (one jit dispatch)."""
     explore = make_explore_fn(env.spec.action_dim, cfg)
     update_loop = make_update_loop(env.spec.action_dim, cfg, axis_name)
 
-    def train_step(state: OffPolicyState):
+    def train_step(state: SACState):
         ls = state.learner
         key, rkey = jax.random.split(ls.key)
 
-        # --- collect K steps with the behavior policy ---
         rollout, env_steps, traj = offpolicy_rollout(
             env, explore, ls.actor_params, state.rollout, rkey,
             cfg.steps_per_iter, state.env_steps,
@@ -313,15 +304,11 @@ def make_train_step(
         flat = jax.tree.map(lambda x: x.reshape(-1, *x.shape[2:]), traj)
         rbuf = replay.add_batch(ls.replay, flat)
 
-        # --- J gradient updates (gated until warmup + one batch in ring) ---
         do_update = jnp.logical_and(
             env_steps >= cfg.warmup_steps, rbuf.size >= cfg.batch_size
         )
-        ls, metrics = update_loop(
-            ls._replace(replay=rbuf, key=key), do_update
-        )
+        ls, metrics = update_loop(ls._replace(replay=rbuf, key=key), do_update)
 
-        # --- accounting ---
         ep_ret, ep_len, avg_ret, ep_metrics = episode_metrics_update(
             state.ep_return, state.ep_length, state.avg_return, traj
         )
@@ -329,7 +316,7 @@ def make_train_step(
         ep_metrics["avg_return_ema"] = avg_ret
         metrics = aggregate_metrics(metrics, ep_metrics, axis_name)
 
-        new_state = OffPolicyState(
+        new_state = SACState(
             learner=ls,
             rollout=rollout,
             env_steps=env_steps,
@@ -345,14 +332,14 @@ def make_train_step(
 
 def train(
     env: JaxEnv,
-    cfg: DDPGConfig,
+    cfg: SACConfig,
     num_iterations: int,
     seed: int = 0,
-    state: Optional[OffPolicyState] = None,
+    state: Optional[SACState] = None,
     log_every: int = 0,
     log_fn: Optional[Callable[[int, dict], None]] = None,
-) -> tuple[OffPolicyState, dict[str, jax.Array]]:
-    """Host loop around the fused step (single device), like a2c.train."""
+) -> tuple[SACState, dict[str, jax.Array]]:
+    """Host loop around the fused step (single device)."""
     if state is None:
         state = init_state(env, cfg, jax.random.key(seed))
     step = jax.jit(make_train_step(env, cfg), donate_argnums=0)
@@ -365,24 +352,19 @@ def train(
 
 
 # --------------------------------------------------------------------------
-# Host-env path (MuJoCo Walker2d etc. — BASELINE.json:9)
+# Host-env path (MuJoCo Humanoid etc. — BASELINE.json:10)
 # --------------------------------------------------------------------------
 
-def make_host_act_fn(action_dim: int, cfg: DDPGConfig):
-    """Jitted (params, obs, key, env_steps) → exploration action."""
+def make_host_act_fn(action_dim: int, cfg: SACConfig):
     return jax.jit(make_explore_fn(action_dim, cfg))
 
 
-def make_host_ingest_update(action_dim: int, cfg: DDPGConfig):
-    """Jitted (learner, [K,E] transition block) → (learner, metrics).
-
-    One host→device transfer per iteration; replay insert and the whole
-    update loop stay on-device.
-    """
+def make_host_ingest_update(action_dim: int, cfg: SACConfig):
+    """Jitted (learner, [K,E] block, env_steps) → (learner, metrics)."""
     update_loop = make_update_loop(action_dim, cfg)
 
     @partial(jax.jit, donate_argnums=0)
-    def ingest_update(ls: LearnerState, traj: OffPolicyTransition, env_steps):
+    def ingest_update(ls: SACLearnerState, traj: OffPolicyTransition, env_steps):
         flat = jax.tree.map(lambda x: x.reshape(-1, *x.shape[2:]), traj)
         rbuf = replay.add_batch(ls.replay, flat)
         do_update = jnp.logical_and(
@@ -395,18 +377,14 @@ def make_host_ingest_update(action_dim: int, cfg: DDPGConfig):
 
 def train_host(
     pool,
-    cfg: DDPGConfig,
+    cfg: SACConfig,
     num_iterations: int,
     seed: int = 0,
     log_every: int = 10,
     log_fn: Optional[Callable[[int, dict], None]] = None,
 ):
-    """DDPG/TD3 on a HostEnvPool (host rollout, device learner).
-
-    Recommended pool settings for off-policy MuJoCo: normalize_obs=True,
-    normalize_reward=False (TD targets want raw reward scale).
-    Returns (learner, history).
-    """
+    """SAC on a HostEnvPool (host rollout, device learner). Use
+    normalize_reward=False on the pool (TD targets want raw rewards)."""
     import numpy as np
 
     from actor_critic_tpu.algos.host_loop import (

@@ -68,10 +68,15 @@ def add_batch(state: ReplayState, batch: Any) -> ReplayState:
 
     B is static (leaf shape). Indices are computed mod capacity so a
     batch can straddle the wrap point; XLA lowers the `.at[idx].set` to an
-    in-place scatter when the state is donated.
+    in-place scatter when the state is donated. A batch larger than the
+    ring keeps only its newest `capacity` rows — mod-indices would
+    otherwise scatter duplicates in undefined order.
     """
     capacity = capacity_of(state)
     b = jax.tree.leaves(batch)[0].shape[0]
+    if b > capacity:
+        batch = jax.tree.map(lambda x: x[-capacity:], batch)
+        b = capacity
     idx = (state.insert_pos + jnp.arange(b, dtype=jnp.int32)) % capacity
     storage = jax.tree.map(
         lambda s, x: s.at[idx].set(x.astype(s.dtype)), state.storage, batch

@@ -1,0 +1,138 @@
+"""SAC tests: soft-update mechanics, alpha auto-tuning, learning on the
+analytic point-mass env (SURVEY.md §4; BASELINE.json:10)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from actor_critic_tpu import replay
+from actor_critic_tpu.algos import sac
+from actor_critic_tpu.algos.common import OffPolicyTransition, evaluate
+from actor_critic_tpu.envs import make_point_mass
+
+
+def _small_cfg(**kw):
+    base = dict(
+        num_envs=16,
+        steps_per_iter=4,
+        updates_per_iter=2,
+        buffer_capacity=32768,
+        batch_size=64,
+        hidden=(32, 32),
+        actor_lr=1e-3,
+        critic_lr=1e-3,
+        alpha_lr=1e-3,
+        warmup_steps=128,
+    )
+    base.update(kw)
+    return sac.SACConfig(**base)
+
+
+def _filled_learner(cfg, key=0, n_items=512, obs_dim=1, act_dim=1):
+    k = jax.random.key(key)
+    k, lk, dk = jax.random.split(k, 3)
+    learner = sac.init_learner((obs_dim,), act_dim, cfg, lk)
+    ks = jax.random.split(dk, 4)
+    batch = OffPolicyTransition(
+        obs=jax.random.normal(ks[0], (n_items, obs_dim)),
+        action=jax.random.uniform(ks[1], (n_items, act_dim), minval=-1, maxval=1),
+        reward=jax.random.normal(ks[2], (n_items,)),
+        next_obs=jax.random.normal(ks[3], (n_items, obs_dim)),
+        terminated=jnp.zeros((n_items,)),
+        done=jnp.zeros((n_items,)),
+    )
+    return learner._replace(replay=replay.add_batch(learner.replay, batch))
+
+
+def _params_equal(a, b):
+    return all(
+        bool(jnp.all(x == y)) for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+    )
+
+
+class TestUpdateMechanics:
+    def test_warmup_blocks_learning(self):
+        cfg = _small_cfg(updates_per_iter=1)
+        learner = _filled_learner(cfg)
+        new, _ = sac.make_update_loop(1, cfg)(learner, jnp.asarray(False))
+        assert _params_equal(new.actor_params, learner.actor_params)
+        assert float(new.log_alpha) == float(learner.log_alpha)
+        assert int(new.update_count) == 0
+
+    def test_update_moves_everything(self):
+        cfg = _small_cfg(updates_per_iter=1)
+        learner = _filled_learner(cfg)
+        new, metrics = sac.make_update_loop(1, cfg)(learner, jnp.asarray(True))
+        assert not _params_equal(new.critic_params, learner.critic_params)
+        assert not _params_equal(new.actor_params, learner.actor_params)
+        assert float(new.log_alpha) != float(learner.log_alpha)
+        # target critic moved slightly, not copied
+        assert not _params_equal(new.target_critic, learner.target_critic)
+        assert not _params_equal(new.target_critic, new.critic_params)
+        for v in metrics.values():
+            assert np.isfinite(float(v))
+
+    def test_fixed_alpha_stays_fixed(self):
+        cfg = _small_cfg(updates_per_iter=4, fixed_alpha=0.2)
+        learner = _filled_learner(cfg)
+        new, metrics = sac.make_update_loop(1, cfg)(learner, jnp.asarray(True))
+        np.testing.assert_allclose(float(jnp.exp(new.log_alpha)), 0.2, rtol=1e-6)
+        np.testing.assert_allclose(float(metrics["alpha"]), 0.2, rtol=1e-6)
+
+    def test_alpha_tunes_toward_target_entropy(self):
+        """Entropy above target → α should decay (and vice versa); with a
+        fresh (high-entropy) policy α must come down from 1.0."""
+        cfg = _small_cfg(updates_per_iter=32, init_alpha=1.0, alpha_lr=1e-2)
+        learner = _filled_learner(cfg)
+        new, metrics = sac.make_update_loop(1, cfg)(learner, jnp.asarray(True))
+        entropy = float(metrics["entropy_est"])
+        if entropy > sac._target_entropy(1, cfg) * -1.0:
+            assert float(new.log_alpha) < float(learner.log_alpha)
+
+
+class TestFusedTrainer:
+    def test_smoke_and_accounting(self):
+        env = make_point_mass()
+        cfg = _small_cfg()
+        state, metrics = sac.train(env, cfg, num_iterations=3, seed=0)
+        assert int(state.update_step) == 3
+        assert int(state.env_steps) == 3 * cfg.steps_per_iter * cfg.num_envs
+        for v in metrics.values():
+            assert np.isfinite(float(v))
+
+    def test_sac_learns_point_mass(self):
+        env = make_point_mass()
+        cfg = _small_cfg(updates_per_iter=4, warmup_steps=256)
+        state, _ = sac.train(env, cfg, num_iterations=250, seed=0)
+        actor, _ = sac._modules(env.spec.action_dim, cfg)
+        ret = evaluate(
+            env,
+            lambda p, o: actor.apply(p, o).mode(),
+            state.learner.actor_params,
+            jax.random.key(9),
+            num_envs=32,
+            num_steps=16,
+        )
+        # Optimal 0; random ≈ −6. Entropy bonus keeps it off exact optimum.
+        assert float(ret) > -1.0, float(ret)
+
+
+class TestHostPath:
+    def test_host_ingest_update(self):
+        cfg = _small_cfg(updates_per_iter=1, warmup_steps=0, batch_size=32)
+        learner = sac.init_learner((3,), 2, cfg, jax.random.key(0))
+        ingest = sac.make_host_ingest_update(2, cfg)
+        K, E = 4, 8
+        k = jax.random.key(1)
+        traj = OffPolicyTransition(
+            obs=jax.random.normal(k, (K, E, 3)),
+            action=jnp.zeros((K, E, 2)),
+            reward=jnp.ones((K, E)),
+            next_obs=jax.random.normal(k, (K, E, 3)),
+            terminated=jnp.zeros((K, E)),
+            done=jnp.zeros((K, E)),
+        )
+        learner, metrics = ingest(learner, traj, jnp.asarray(K * E, jnp.int32))
+        assert int(learner.replay.size) == K * E
+        assert int(learner.update_count) == 1
+        assert np.isfinite(float(metrics["critic_loss"]))
