@@ -353,15 +353,12 @@ def train(
     log_fn: Optional[Callable[[int, dict], None]] = None,
 ) -> tuple[OffPolicyState, dict[str, jax.Array]]:
     """Host loop around the fused step (single device), like a2c.train."""
-    if state is None:
-        state = init_state(env, cfg, jax.random.key(seed))
-    step = jax.jit(make_train_step(env, cfg), donate_argnums=0)
-    metrics: dict[str, jax.Array] = {}
-    for it in range(num_iterations):
-        state, metrics = step(state)
-        if log_fn is not None and log_every > 0 and (it + 1) % log_every == 0:
-            log_fn(it + 1, {k: float(v) for k, v in metrics.items()})
-    return state, metrics
+    from actor_critic_tpu.algos.host_loop import fused_train_loop
+
+    return fused_train_loop(
+        make_train_step, init_state, env, cfg, num_iterations,
+        seed=seed, state=state, log_every=log_every, log_fn=log_fn,
+    )
 
 
 # --------------------------------------------------------------------------
@@ -407,55 +404,12 @@ def train_host(
     normalize_reward=False (TD targets want raw reward scale).
     Returns (learner, history).
     """
-    import numpy as np
+    from actor_critic_tpu.algos.host_loop import off_policy_train_host
 
-    from actor_critic_tpu.algos.host_loop import (
-        EpisodeTracker,
-        host_collect,
-        maybe_log,
+    return off_policy_train_host(
+        pool, cfg, num_iterations,
+        init_learner=init_learner,
+        make_act_fn=make_host_act_fn,
+        make_ingest_update=make_host_ingest_update,
+        seed=seed, log_every=log_every, log_fn=log_fn,
     )
-
-    key = jax.random.key(seed)
-    key, lkey = jax.random.split(key)
-    learner = init_learner(pool.spec.obs_shape, pool.spec.action_dim, cfg, lkey)
-    act = make_host_act_fn(pool.spec.action_dim, cfg)
-    ingest_update = make_host_ingest_update(pool.spec.action_dim, cfg)
-
-    obs = pool.reset()
-    E = pool.num_envs
-    env_steps = 0
-    tracker = EpisodeTracker(E)
-    history: list = []
-    metrics: dict[str, jax.Array] = {}
-
-    for it in range(num_iterations):
-
-        def explore_act(o):
-            nonlocal key, env_steps
-            key, akey = jax.random.split(key)
-            action = np.asarray(
-                act(learner.actor_params, jnp.asarray(o), akey,
-                    jnp.asarray(env_steps, jnp.int32))
-            )
-            env_steps += E
-            return action, {}
-
-        obs, block = host_collect(
-            pool, obs, cfg.steps_per_iter, explore_act, tracker
-        )
-        traj = OffPolicyTransition(
-            obs=jnp.asarray(block["obs"]),
-            action=jnp.asarray(block["action"]),
-            reward=jnp.asarray(block["reward"]),
-            next_obs=jnp.asarray(block["final_obs"]),
-            terminated=jnp.asarray(block["terminated"]),
-            done=jnp.asarray(block["done"]),
-        )
-        learner, metrics = ingest_update(
-            learner, traj, jnp.asarray(env_steps, jnp.int32)
-        )
-        maybe_log(
-            it, log_every, metrics, tracker, history, log_fn,
-            extra={"env_steps": env_steps},
-        )
-    return learner, history

@@ -75,6 +75,108 @@ def host_collect(
     return obs, {k: np.stack(v) for k, v in block.items()}
 
 
+def off_policy_train_host(
+    pool,
+    cfg,
+    num_iterations: int,
+    *,
+    init_learner: Callable,
+    make_act_fn: Callable,
+    make_ingest_update: Callable,
+    seed: int = 0,
+    log_every: int = 10,
+    log_fn: Optional[Callable[[int, dict], None]] = None,
+):
+    """Shared host-env loop for the off-policy trainers (DDPG/TD3, SAC).
+
+    Both algorithms drive a `HostEnvPool` identically — explore-act on
+    device, stack a [K, E] block host-side, one transfer into the jitted
+    ingest+update — and differ only in the three factory callables:
+      init_learner(obs_shape, action_dim, cfg, key) -> learner
+      make_act_fn(action_dim, cfg) -> jitted (actor_params, obs, key,
+                                              env_steps) -> action
+      make_ingest_update(action_dim, cfg) -> jitted (learner, block,
+                                              env_steps) -> (learner, metrics)
+    The learner state must expose `.actor_params`. Returns
+    (learner, history).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from actor_critic_tpu.algos.common import OffPolicyTransition
+
+    key = jax.random.key(seed)
+    key, lkey = jax.random.split(key)
+    learner = init_learner(pool.spec.obs_shape, pool.spec.action_dim, cfg, lkey)
+    act = make_act_fn(pool.spec.action_dim, cfg)
+    ingest_update = make_ingest_update(pool.spec.action_dim, cfg)
+
+    obs = pool.reset()
+    E = pool.num_envs
+    env_steps = 0
+    tracker = EpisodeTracker(E)
+    history: list = []
+    metrics: dict = {}
+
+    for it in range(num_iterations):
+
+        def explore_act(o):
+            nonlocal key, env_steps
+            key, akey = jax.random.split(key)
+            action = np.asarray(
+                act(learner.actor_params, jnp.asarray(o), akey,
+                    jnp.asarray(env_steps, jnp.int32))
+            )
+            env_steps += E
+            return action, {}
+
+        obs, block = host_collect(
+            pool, obs, cfg.steps_per_iter, explore_act, tracker
+        )
+        traj = OffPolicyTransition(
+            obs=jnp.asarray(block["obs"]),
+            action=jnp.asarray(block["action"]),
+            reward=jnp.asarray(block["reward"]),
+            next_obs=jnp.asarray(block["final_obs"]),
+            terminated=jnp.asarray(block["terminated"]),
+            done=jnp.asarray(block["done"]),
+        )
+        learner, metrics = ingest_update(
+            learner, traj, jnp.asarray(env_steps, jnp.int32)
+        )
+        maybe_log(
+            it, log_every, metrics, tracker, history, log_fn,
+            extra={"env_steps": env_steps},
+        )
+    return learner, history
+
+
+def fused_train_loop(
+    make_train_step: Callable,
+    init_state: Callable,
+    env,
+    cfg,
+    num_iterations: int,
+    seed: int = 0,
+    state=None,
+    log_every: int = 0,
+    log_fn: Optional[Callable[[int, dict], None]] = None,
+):
+    """Shared host loop around a fused (single-device) train step — the
+    common body of ddpg.train and sac.train."""
+    import jax
+
+    if state is None:
+        state = init_state(env, cfg, jax.random.key(seed))
+    step = jax.jit(make_train_step(env, cfg), donate_argnums=0)
+    metrics: dict = {}
+    for it in range(num_iterations):
+        state, metrics = step(state)
+        if log_fn is not None and log_every > 0 and (it + 1) % log_every == 0:
+            log_fn(it + 1, {k: float(v) for k, v in metrics.items()})
+    return state, metrics
+
+
 def maybe_log(
     it: int,
     log_every: int,

@@ -1,0 +1,303 @@
+"""IMPALA / A3C — decoupled-actor semantics, reformulated for TPU.
+
+Capability parity with the reference's fifth config: "A3C / IMPALA on
+Atari Pong (CNN encoder, N parallel actors, V-trace)" (BASELINE.json:11;
+reference mount empty at survey, SURVEY.md §0).
+
+The reference's genre runs N async host workers feeding a learner over
+IPC queues (SURVEY.md §3.3); the off-policyness that V-trace corrects is
+an *accident* of that asynchrony.  The TPU-native reformulation
+(SURVEY.md §2.3 "Async actor-learner") keeps the semantics and drops the
+host machinery:
+
+- the N parallel actors become a vmapped env axis inside one jitted
+  program (the same fused rollout as A2C);
+- the actor policy is a deliberately STALE copy of the learner params,
+  refreshed every `actor_refresh_every` learner steps — reproducing
+  IMPALA's k-step policy lag explicitly and deterministically;
+- behaviour log-probs are recorded at rollout time and V-trace
+  (ops/returns.py) corrects the lag at the learner, exactly as IMPALA's
+  importance weights correct queue-induced lag.
+
+`correction="vtrace"` is IMPALA; `correction="none"` computes plain
+λ-return advantages under the learner's critic with no importance
+weighting — the A3C update rule (which simply tolerates the small bias
+that staleness introduces), so both reference algorithms are covered by
+one trainer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from actor_critic_tpu.algos.common import (
+    RolloutState,
+    init_rollout,
+    rollout_scan,
+    episode_metrics_update,
+    truncation_bootstrap_rewards,
+)
+from actor_critic_tpu.algos.metrics import aggregate_metrics
+from actor_critic_tpu.envs.jax_env import JaxEnv
+from actor_critic_tpu.models.networks import ActorCriticDiscrete, ActorCriticGaussian
+from actor_critic_tpu.ops.returns import gae, vtrace
+from actor_critic_tpu.parallel import mesh as pmesh
+
+
+@dataclasses.dataclass(frozen=True)
+class ImpalaConfig:
+    num_envs: int = 32          # the reference's "N parallel actors"
+    rollout_steps: int = 20     # IMPALA's unroll length
+    gamma: float = 0.99
+    lr: float = 6e-4
+    value_coef: float = 0.5
+    entropy_coef: float = 0.01
+    rho_bar: float = 1.0        # V-trace ρ̄ clip
+    c_bar: float = 1.0          # V-trace c̄ clip
+    lam: float = 1.0            # V-trace λ (1.0 = canonical IMPALA)
+    actor_refresh_every: int = 1  # k-step policy lag (1 = on-policy)
+    correction: str = "vtrace"  # "vtrace" (IMPALA) | "none" (A3C)
+    max_grad_norm: float = 40.0
+    hidden: tuple[int, ...] = (64, 64)
+    # RMSProp epsilon/decay follow the IMPALA paper's published settings.
+    rms_decay: float = 0.99
+    rms_eps: float = 0.1
+    bf16_compute: bool = False
+
+    def __post_init__(self):
+        if self.correction not in ("vtrace", "none"):
+            raise ValueError(f"unknown correction: {self.correction!r}")
+        if self.actor_refresh_every < 1:
+            raise ValueError("actor_refresh_every must be >= 1")
+
+
+class ImpalaTrainState(NamedTuple):
+    params: Any           # learner params
+    actor_params: Any     # stale behaviour-policy params
+    opt_state: Any
+    rollout: RolloutState
+    key: jax.Array
+    update_step: jax.Array
+    ep_return: jax.Array
+    ep_length: jax.Array
+    avg_return: jax.Array
+
+
+def make_network(env: JaxEnv, cfg: ImpalaConfig):
+    dtype = jnp.bfloat16 if cfg.bf16_compute else jnp.float32
+    if env.spec.discrete:
+        return ActorCriticDiscrete(
+            num_actions=env.spec.action_dim,
+            hidden=cfg.hidden,
+            pixel_obs=len(env.spec.obs_shape) == 3,
+            compute_dtype=dtype,
+        )
+    return ActorCriticGaussian(
+        action_dim=env.spec.action_dim, hidden=cfg.hidden, compute_dtype=dtype
+    )
+
+
+def make_optimizer(cfg: ImpalaConfig) -> optax.GradientTransformation:
+    return optax.chain(
+        optax.clip_by_global_norm(cfg.max_grad_norm),
+        optax.rmsprop(cfg.lr, decay=cfg.rms_decay, eps=cfg.rms_eps),
+    )
+
+
+def init_state(env: JaxEnv, cfg: ImpalaConfig, key: jax.Array) -> ImpalaTrainState:
+    net = make_network(env, cfg)
+    opt = make_optimizer(cfg)
+    key, pkey, rkey = jax.random.split(key, 3)
+    dummy = jnp.zeros((1, *env.spec.obs_shape), env.spec.obs_dtype)
+    params = net.init(pkey, dummy)
+    E = cfg.num_envs
+    return ImpalaTrainState(
+        params=params,
+        # In sync until the first refresh boundary; materialized as a
+        # distinct buffer so donating the whole state never aliases the
+        # same array twice (donation is how the fused loops avoid copies).
+        actor_params=jax.tree.map(jnp.copy, params),
+        opt_state=opt.init(params),
+        rollout=init_rollout(env, rkey, E),
+        key=key,
+        update_step=jnp.zeros((), jnp.int32),
+        ep_return=jnp.zeros((E,)),
+        ep_length=jnp.zeros((E,)),
+        avg_return=jnp.zeros(()),
+    )
+
+
+def impala_loss(
+    params: Any,
+    apply_fn: Callable,
+    traj,
+    bootstrap_obs: jax.Array,
+    cfg: ImpalaConfig,
+    can_truncate: bool = True,
+) -> tuple[jax.Array, dict[str, jax.Array]]:
+    """V-trace (or A3C λ-return) actor-critic loss on a [T, E] trajectory.
+
+    The learner re-evaluates π/V at the stored observations; `traj.log_prob`
+    holds the BEHAVIOUR policy's log-probs from rollout time, so the
+    ρ = π/μ importance ratios are exact even under parameter staleness.
+    """
+    T, E = traj.reward.shape
+    obs = traj.obs.reshape(T * E, *traj.obs.shape[2:])
+    actions = traj.action.reshape(T * E, *traj.action.shape[2:])
+
+    dist, values = apply_fn(params, obs)
+    target_log_probs = dist.log_prob(actions).reshape(T, E)
+    values = values.reshape(T, E)
+    entropy = jnp.mean(dist.entropy())
+    _, bootstrap_value = apply_fn(params, bootstrap_obs)
+
+    if can_truncate:
+        # Truncation bootstrap under the LEARNER's critic.
+        flat_final = traj.final_obs.reshape(T * E, *traj.final_obs.shape[2:])
+        _, final_values = apply_fn(params, flat_final)
+        rewards = truncation_bootstrap_rewards(
+            traj, final_values.reshape(T, E), cfg.gamma
+        )
+    else:
+        rewards = traj.reward
+
+    values_ng = jax.lax.stop_gradient(values)
+    bootstrap_ng = jax.lax.stop_gradient(bootstrap_value)
+    if cfg.correction == "vtrace":
+        vt = vtrace(
+            jax.lax.stop_gradient(target_log_probs),
+            traj.log_prob,
+            rewards,
+            values_ng,
+            traj.done,
+            bootstrap_ng,
+            cfg.gamma,
+            rho_bar=cfg.rho_bar,
+            c_bar=cfg.c_bar,
+            lam=cfg.lam,
+        )
+        value_targets = vt.vs
+        pg_advantages = vt.pg_advantages
+        mean_rho = jnp.mean(vt.clipped_rhos)
+    else:  # A3C: λ-return advantages, no importance correction
+        pg_advantages, value_targets = gae(
+            rewards, values_ng, traj.done, bootstrap_ng, cfg.gamma, cfg.lam
+        )
+        mean_rho = jnp.ones(())
+
+    pg_loss = -jnp.mean(jax.lax.stop_gradient(pg_advantages) * target_log_probs)
+    v_loss = 0.5 * jnp.mean((values - jax.lax.stop_gradient(value_targets)) ** 2)
+    loss = pg_loss + cfg.value_coef * v_loss - cfg.entropy_coef * entropy
+    return loss, {
+        "loss": loss,
+        "pg_loss": pg_loss,
+        "v_loss": v_loss,
+        "entropy": entropy,
+        "mean_rho": mean_rho,
+    }
+
+
+def make_train_step(
+    env: JaxEnv,
+    cfg: ImpalaConfig,
+    axis_name: Optional[str] = None,
+) -> Callable[[ImpalaTrainState], tuple[ImpalaTrainState, dict[str, jax.Array]]]:
+    """Fused rollout(stale actor) → V-trace → update → k-step actor refresh."""
+    net = make_network(env, cfg)
+    opt = make_optimizer(cfg)
+    apply_fn = net.apply
+
+    def train_step(state: ImpalaTrainState):
+        key, rkey = jax.random.split(state.key)
+
+        # Actors run the STALE params; behaviour log-probs are recorded.
+        new_rollout, traj = rollout_scan(
+            env, apply_fn, state.actor_params, state.rollout, rkey,
+            cfg.rollout_steps,
+        )
+
+        grad_fn = jax.value_and_grad(impala_loss, has_aux=True)
+        (_, metrics), grads = grad_fn(
+            state.params, apply_fn, traj, new_rollout.obs, cfg,
+            env.spec.can_truncate,
+        )
+        grads = pmesh.pmean_tree(grads, axis_name)
+        updates, new_opt_state = opt.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+
+        # k-step policy lag: actors pick up the learner params only at
+        # refresh boundaries (k=1 degrades gracefully to on-policy, where
+        # every ρ is exactly 1 — tested in tests/test_impala.py).
+        new_step = state.update_step + 1
+        refresh = (new_step % cfg.actor_refresh_every) == 0
+        new_actor_params = jax.tree.map(
+            lambda n, o: jnp.where(refresh, n, o), new_params, state.actor_params
+        )
+
+        ep_ret, ep_len, avg_ret, ep_metrics = episode_metrics_update(
+            state.ep_return, state.ep_length, state.avg_return, traj
+        )
+        avg_ret = pmesh.pmean(avg_ret, axis_name)
+        ep_metrics["avg_return_ema"] = avg_ret
+        metrics = aggregate_metrics(metrics, ep_metrics, axis_name)
+
+        new_state = ImpalaTrainState(
+            params=new_params,
+            actor_params=new_actor_params,
+            opt_state=new_opt_state,
+            rollout=new_rollout,
+            key=key,
+            update_step=new_step,
+            ep_return=ep_ret,
+            ep_length=ep_len,
+            avg_return=avg_ret,
+        )
+        return new_state, metrics
+
+    return train_step
+
+
+def train(
+    env: JaxEnv,
+    cfg: ImpalaConfig,
+    num_iterations: int,
+    seed: int = 0,
+    state: Optional[ImpalaTrainState] = None,
+    log_every: int = 0,
+    log_fn: Optional[Callable[[int, dict], None]] = None,
+) -> tuple[ImpalaTrainState, dict[str, jax.Array]]:
+    """Host loop around the fused step; `log_every=0` scans all iterations
+    on-device in a single dispatch (same pattern as a2c.train)."""
+    if state is None:
+        state = init_state(env, cfg, jax.random.key(seed))
+    step = make_train_step(env, cfg)
+
+    if log_every <= 0:
+        if num_iterations < 1:
+            raise ValueError("num_iterations must be >= 1")
+
+        @jax.jit
+        def run(state):
+            def body(s, _):
+                s, _m = step(s)
+                return s, None
+
+            s, _ = jax.lax.scan(body, state, None, length=num_iterations - 1)
+            s, m = step(s)
+            return s, m
+
+        state, metrics = run(state)
+        return state, metrics
+
+    jit_step = jax.jit(step, donate_argnums=0)
+    metrics = {}
+    for it in range(num_iterations):
+        state, metrics = jit_step(state)
+        if log_fn is not None and (it + 1) % log_every == 0:
+            log_fn(it + 1, {k: float(v) for k, v in metrics.items()})
+    return state, metrics

@@ -11,7 +11,9 @@ Per update (Haarnoja et al. 2018, soft policy iteration):
   critic:  y = r + γ(1−term)·[min(Q̄₁,Q̄₂)(s', a') − α·log π(a'|s')],
            a' ~ π(·|s')  (fresh sample, tanh-Gaussian)
   actor:   min E[α·log π(a|s) − min(Q₁,Q₂)(s, a)]  (reparameterized)
-  alpha:   min E[−log α·(log π(a|s) + H_target)],  H_target = −action_dim
+  alpha:   min_α E[−α·(log π(a|s) + H_target)],  H_target = −action_dim
+           (optimized in log α; the update uses the analytic gradient
+           d/d(log α) = −α·E[log π + H_target])
   targets: Polyak on the twin critic only (no target actor in SAC).
 """
 
@@ -60,6 +62,12 @@ class SACConfig:
     fixed_alpha: Optional[float] = None
     target_entropy: Optional[float] = None
     bf16_compute: bool = False
+
+    def __post_init__(self):
+        if self.init_alpha <= 0.0:
+            raise ValueError("init_alpha must be > 0 (α is parameterized in log)")
+        if self.fixed_alpha is not None and self.fixed_alpha <= 0.0:
+            raise ValueError("fixed_alpha must be > 0 (α is parameterized in log)")
 
 
 class SACLearnerState(NamedTuple):
@@ -340,15 +348,12 @@ def train(
     log_fn: Optional[Callable[[int, dict], None]] = None,
 ) -> tuple[SACState, dict[str, jax.Array]]:
     """Host loop around the fused step (single device)."""
-    if state is None:
-        state = init_state(env, cfg, jax.random.key(seed))
-    step = jax.jit(make_train_step(env, cfg), donate_argnums=0)
-    metrics: dict[str, jax.Array] = {}
-    for it in range(num_iterations):
-        state, metrics = step(state)
-        if log_fn is not None and log_every > 0 and (it + 1) % log_every == 0:
-            log_fn(it + 1, {k: float(v) for k, v in metrics.items()})
-    return state, metrics
+    from actor_critic_tpu.algos.host_loop import fused_train_loop
+
+    return fused_train_loop(
+        make_train_step, init_state, env, cfg, num_iterations,
+        seed=seed, state=state, log_every=log_every, log_fn=log_fn,
+    )
 
 
 # --------------------------------------------------------------------------
@@ -385,55 +390,12 @@ def train_host(
 ):
     """SAC on a HostEnvPool (host rollout, device learner). Use
     normalize_reward=False on the pool (TD targets want raw rewards)."""
-    import numpy as np
+    from actor_critic_tpu.algos.host_loop import off_policy_train_host
 
-    from actor_critic_tpu.algos.host_loop import (
-        EpisodeTracker,
-        host_collect,
-        maybe_log,
+    return off_policy_train_host(
+        pool, cfg, num_iterations,
+        init_learner=init_learner,
+        make_act_fn=make_host_act_fn,
+        make_ingest_update=make_host_ingest_update,
+        seed=seed, log_every=log_every, log_fn=log_fn,
     )
-
-    key = jax.random.key(seed)
-    key, lkey = jax.random.split(key)
-    learner = init_learner(pool.spec.obs_shape, pool.spec.action_dim, cfg, lkey)
-    act = make_host_act_fn(pool.spec.action_dim, cfg)
-    ingest_update = make_host_ingest_update(pool.spec.action_dim, cfg)
-
-    obs = pool.reset()
-    E = pool.num_envs
-    env_steps = 0
-    tracker = EpisodeTracker(E)
-    history: list = []
-    metrics: dict[str, jax.Array] = {}
-
-    for it in range(num_iterations):
-
-        def explore_act(o):
-            nonlocal key, env_steps
-            key, akey = jax.random.split(key)
-            action = np.asarray(
-                act(learner.actor_params, jnp.asarray(o), akey,
-                    jnp.asarray(env_steps, jnp.int32))
-            )
-            env_steps += E
-            return action, {}
-
-        obs, block = host_collect(
-            pool, obs, cfg.steps_per_iter, explore_act, tracker
-        )
-        traj = OffPolicyTransition(
-            obs=jnp.asarray(block["obs"]),
-            action=jnp.asarray(block["action"]),
-            reward=jnp.asarray(block["reward"]),
-            next_obs=jnp.asarray(block["final_obs"]),
-            terminated=jnp.asarray(block["terminated"]),
-            done=jnp.asarray(block["done"]),
-        )
-        learner, metrics = ingest_update(
-            learner, traj, jnp.asarray(env_steps, jnp.int32)
-        )
-        maybe_log(
-            it, log_every, metrics, tracker, history, log_fn,
-            extra={"env_steps": env_steps},
-        )
-    return learner, history

@@ -1,0 +1,179 @@
+"""Pure-JAX Pong-like pixel environment for the IMPALA/A3C config.
+
+The reference's fifth config runs A3C/IMPALA on Atari Pong through the ALE
+C++ emulator (BASELINE.json:11; reference mount empty at survey, SURVEY.md
+§0).  `ale-py` is not installed in this environment (SURVEY.md §7.0), so —
+as prescribed by SURVEY.md §2.2 — the TPU build ships a pure-JAX pixel env
+of Pong-like shape instead: two paddles, a bouncing ball, ±1 scoring
+rewards, and stacked-frame uint8 observations that feed the Nature-CNN
+encoder exactly like preprocessed Atari frames would.
+
+Being pure JAX, thousands of instances vmap onto one device and fuse into
+the training step — the same on-device rollout design as cartpole.py,
+which is what lets the IMPALA config report steps/sec on TPU at all
+(a host-stepped ALE on this 1-CPU machine could not).
+
+Game rules:
+- The agent is the RIGHT paddle: actions {0: stay, 1: up, 2: down}.
+- The LEFT paddle is a scripted opponent tracking the ball with capped
+  speed (slower than the ball's max vertical speed, so it is beatable).
+- Ball bounces off top/bottom walls; paddle hits reflect it and add
+  "english" proportional to the hit offset, so rallies vary.
+- Reward +1 when the opponent misses, −1 when the agent misses.  First to
+  `points_to_win` points terminates the episode; `max_steps` truncates.
+- Observation: [size, size, 2] uint8 — previous and current rendered
+  frame stacked on the channel axis (the frame-stack preprocessing the
+  reference applies host-side, done here in the env itself).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from actor_critic_tpu.envs.jax_env import EnvSpec, JaxEnv, auto_reset
+
+
+class PongState(NamedTuple):
+    ball_x: jax.Array
+    ball_y: jax.Array
+    vel_x: jax.Array
+    vel_y: jax.Array
+    player_y: jax.Array  # agent paddle center (right side)
+    opp_y: jax.Array     # scripted paddle center (left side)
+    player_score: jax.Array
+    opp_score: jax.Array
+    t: jax.Array
+    prev_frame: jax.Array  # last rendered frame, for the 2-frame stack
+    key: jax.Array
+
+
+def make_pong(
+    size: int = 84,
+    points_to_win: int = 5,
+    max_steps: int = 1000,
+) -> JaxEnv:
+    """Build the Pong-like env. `size` ≥ 36 keeps the Nature CNN's VALID
+    conv stack non-degenerate (84 is the canonical Atari shape)."""
+    if size < 36:
+        raise ValueError("size must be >= 36 for the Nature-CNN conv stack")
+    scale = size / 84.0
+    hh = 6.0 * scale            # paddle half-height (pixels)
+    paddle_speed = 2.0 * scale
+    opp_speed = 1.1 * scale     # < max |vel_y| ⇒ opponent is beatable
+    serve_speed_x = 1.8 * scale
+    vy_max = 2.2 * scale
+    english = 1.2 * scale       # vy gain per unit of paddle-hit offset
+    player_x = float(size - 3)  # paddle planes
+    opp_x = 2.0
+    lo, hi = hh, float(size - 1) - hh  # paddle-center travel range
+
+    ys = jnp.arange(size, dtype=jnp.float32)[:, None]
+    xs = jnp.arange(size, dtype=jnp.float32)[None, :]
+
+    def render(ball_x, ball_y, player_y, opp_y) -> jax.Array:
+        ball = (jnp.abs(ys - ball_y) <= 1.0) & (jnp.abs(xs - ball_x) <= 1.0)
+        player = (jnp.abs(ys - player_y) <= hh) & (jnp.abs(xs - player_x) <= 1.0)
+        opp = (jnp.abs(ys - opp_y) <= hh) & (jnp.abs(xs - opp_x) <= 1.0)
+        return jnp.where(ball | player | opp, jnp.uint8(255), jnp.uint8(0))
+
+    def serve(key):
+        """Center the ball with a random direction (both axes)."""
+        kx, ky = jax.random.split(key)
+        dir_x = jnp.where(jax.random.bernoulli(kx), 1.0, -1.0)
+        vy = jax.random.uniform(ky, (), jnp.float32, -1.0, 1.0) * scale
+        c = (size - 1) / 2.0
+        return (
+            jnp.float32(c), jnp.float32(c),
+            dir_x * serve_speed_x, vy,
+        )
+
+    def reset(key):
+        key, skey = jax.random.split(key)
+        ball_x, ball_y, vel_x, vel_y = serve(skey)
+        c = jnp.float32((size - 1) / 2.0)
+        frame = render(ball_x, ball_y, c, c)
+        state = PongState(
+            ball_x=ball_x, ball_y=ball_y, vel_x=vel_x, vel_y=vel_y,
+            player_y=c, opp_y=c,
+            player_score=jnp.zeros((), jnp.int32),
+            opp_score=jnp.zeros((), jnp.int32),
+            t=jnp.zeros((), jnp.int32),
+            prev_frame=frame, key=key,
+        )
+        obs = jnp.stack([frame, frame], axis=-1)
+        return state, obs
+
+    def raw_step(state: PongState, action: jax.Array):
+        move = jnp.where(action == 1, -1.0, jnp.where(action == 2, 1.0, 0.0))
+        player_y = jnp.clip(state.player_y + move * paddle_speed, lo, hi)
+        opp_y = jnp.clip(
+            state.opp_y + jnp.clip(state.ball_y - state.opp_y, -opp_speed, opp_speed),
+            lo, hi,
+        )
+
+        ball_x = state.ball_x + state.vel_x
+        ball_y = state.ball_y + state.vel_y
+        vel_x, vel_y = state.vel_x, state.vel_y
+
+        # Top/bottom wall bounce (positions reflect, vy flips).
+        top = jnp.float32(size - 1)
+        bounced = (ball_y < 0.0) | (ball_y > top)
+        ball_y = jnp.where(ball_y < 0.0, -ball_y, ball_y)
+        ball_y = jnp.where(ball_y > top, 2.0 * top - ball_y, ball_y)
+        vel_y = jnp.where(bounced, -vel_y, vel_y)
+
+        # Paddle hits: reflect off the paddle plane, add english.
+        hit_player = (ball_x >= player_x) & (jnp.abs(ball_y - player_y) <= hh + 1.0)
+        hit_opp = (ball_x <= opp_x) & (jnp.abs(ball_y - opp_y) <= hh + 1.0)
+        ball_x = jnp.where(hit_player, 2.0 * player_x - ball_x, ball_x)
+        ball_x = jnp.where(hit_opp, 2.0 * opp_x - ball_x, ball_x)
+        vel_x = jnp.where(hit_player | hit_opp, -vel_x, vel_x)
+        offset = jnp.where(
+            hit_player, (ball_y - player_y) / hh,
+            jnp.where(hit_opp, (ball_y - opp_y) / hh, 0.0),
+        )
+        vel_y = jnp.clip(
+            vel_y + jnp.where(hit_player | hit_opp, english * offset, 0.0),
+            -vy_max, vy_max,
+        )
+
+        # Scoring: ball got past a paddle plane without a hit.
+        player_point = ball_x < 0.0          # opponent missed
+        opp_point = ball_x > jnp.float32(size - 1)  # agent missed
+        reward = jnp.where(player_point, 1.0, jnp.where(opp_point, -1.0, 0.0))
+        player_score = state.player_score + player_point.astype(jnp.int32)
+        opp_score = state.opp_score + opp_point.astype(jnp.int32)
+
+        key, skey = jax.random.split(state.key)
+        sx, sy, svx, svy = serve(skey)
+        scored = player_point | opp_point
+        ball_x = jnp.where(scored, sx, ball_x)
+        ball_y = jnp.where(scored, sy, ball_y)
+        vel_x = jnp.where(scored, svx, vel_x)
+        vel_y = jnp.where(scored, svy, vel_y)
+
+        t = state.t + 1
+        terminated = (
+            (player_score >= points_to_win) | (opp_score >= points_to_win)
+        ).astype(jnp.float32)
+        truncated = (t >= max_steps).astype(jnp.float32) * (1.0 - terminated)
+
+        frame = render(ball_x, ball_y, player_y, opp_y)
+        nstate = PongState(
+            ball_x=ball_x, ball_y=ball_y, vel_x=vel_x, vel_y=vel_y,
+            player_y=player_y, opp_y=opp_y,
+            player_score=player_score, opp_score=opp_score,
+            t=t, prev_frame=frame, key=key,
+        )
+        obs = jnp.stack([state.prev_frame, frame], axis=-1)
+        return nstate, obs, reward, terminated, truncated
+
+    spec = EnvSpec(
+        obs_shape=(size, size, 2), action_dim=3, discrete=True,
+        obs_dtype=jnp.uint8,
+    )
+    step = auto_reset(reset, raw_step, key_of_state=lambda s: s.key)
+    return JaxEnv(spec=spec, reset=reset, step=step)

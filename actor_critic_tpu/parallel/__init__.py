@@ -10,6 +10,7 @@ from actor_critic_tpu.parallel.mesh import (
 )
 from actor_critic_tpu.parallel.dp import (
     distribute_state,
+    impala_state_specs,
     make_dp_train_step,
     train_state_specs,
 )
@@ -19,6 +20,7 @@ __all__ = [
     "MODEL_AXIS",
     "MeshConfig",
     "distribute_state",
+    "impala_state_specs",
     "make_dp_train_step",
     "make_mesh",
     "multihost_init",

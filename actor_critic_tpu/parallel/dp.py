@@ -42,19 +42,40 @@ def train_state_specs() -> TrainState:
     )
 
 
-def distribute_state(state: TrainState, mesh: Mesh) -> TrainState:
-    """Place a host-built TrainState onto the mesh.
+def impala_state_specs():
+    """PartitionSpecs for the IMPALA trainer state: same dp layout, with
+    the stale actor params replicated alongside the learner params."""
+    from actor_critic_tpu.algos.impala import ImpalaTrainState
+
+    return ImpalaTrainState(
+        params=P(),
+        actor_params=P(),
+        opt_state=P(),
+        rollout=P(DP_AXIS),
+        key=P(DP_AXIS),
+        update_step=P(),
+        ep_return=P(DP_AXIS),
+        ep_length=P(DP_AXIS),
+        avg_return=P(),
+    )
+
+
+def distribute_state(state, mesh: Mesh, specs=None):
+    """Place a host-built trainer state onto the mesh.
 
     The scalar PRNG key becomes a [ndev] batch (one independent stream per
     device); env-batch leaves are sharded over dp (num_envs must divide by
-    the dp size); everything else is replicated.
+    the dp size); everything else is replicated. `specs` defaults to the
+    on-policy TrainState layout; pass `impala_state_specs()` (or any
+    matching prefix-tree of PartitionSpecs) for other state shapes.
     """
     ndev = mesh.shape[DP_AXIS]
     num_envs = state.ep_return.shape[0]
     if num_envs % ndev != 0:
         raise ValueError(f"num_envs={num_envs} not divisible by dp={ndev}")
     state = state._replace(key=jax.random.split(state.key, ndev))
-    specs = train_state_specs()
+    if specs is None:
+        specs = train_state_specs()
 
     def expand(spec, subtree):
         return jax.tree.map(lambda _: NamedSharding(mesh, spec), subtree)
@@ -68,16 +89,19 @@ def distribute_state(state: TrainState, mesh: Mesh) -> TrainState:
 def make_dp_train_step(
     train_step: Callable[[TrainState], tuple[TrainState, dict]],
     mesh: Mesh,
+    specs=None,
 ) -> Callable[[TrainState], tuple[TrainState, dict]]:
     """shard_map + jit the fused train step over the dp axis (built once).
 
     `train_step` must be built with `axis_name=DP_AXIS` so its gradient
     pmean becomes the cross-device all-reduce. The per-device view of
     `key` is a [1] slice of the [ndev] key batch; the wrapper unwraps it.
+    `specs` defaults to the on-policy TrainState layout.
     """
     shard_map = jax.shard_map
 
-    specs = train_state_specs()
+    if specs is None:
+        specs = train_state_specs()
 
     def local_step(state: TrainState):
         state = state._replace(key=state.key[0])

@@ -1,0 +1,194 @@
+"""IMPALA/A3C trainer tests: Pong env mechanics, staleness semantics,
+V-trace on-policy degradation, and learning on analytic MDPs (SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from actor_critic_tpu.algos import impala
+from actor_critic_tpu.envs import make_pong, make_two_state_mdp
+from actor_critic_tpu.envs.pong import PongState
+
+
+# ---------------------------------------------------------------- Pong env
+
+
+def test_pong_reset_shapes_and_dtype():
+    env = make_pong(size=42)
+    state, obs = env.reset(jax.random.key(0))
+    assert obs.shape == (42, 42, 2)
+    assert obs.dtype == jnp.uint8
+    assert env.spec.obs_shape == (42, 42, 2)
+    assert env.spec.discrete and env.spec.action_dim == 3
+    # Ball + both paddles rendered.
+    assert int(jnp.sum(obs[..., 1] > 0)) > 0
+
+
+def test_pong_step_runs_vmapped_and_jitted():
+    env = make_pong(size=42)
+    keys = jax.random.split(jax.random.key(0), 4)
+    state, obs = jax.vmap(env.reset)(keys)
+    actions = jnp.array([0, 1, 2, 0])
+    out = jax.jit(jax.vmap(env.step))(state, actions)
+    assert out.obs.shape == (4, 42, 42, 2)
+    assert out.obs.dtype == jnp.uint8
+    assert out.reward.shape == (4,)
+    np.testing.assert_array_equal(np.asarray(out.done), 0.0)
+
+
+def test_pong_wall_bounce_reflects_vy():
+    env = make_pong(size=42)
+    state, _ = env.reset(jax.random.key(0))
+    # Place the ball about to cross the top wall, moving up.
+    state = state._replace(
+        ball_x=jnp.float32(21.0), ball_y=jnp.float32(0.5),
+        vel_x=jnp.float32(0.0), vel_y=jnp.float32(-1.5),
+    )
+    out = env.step(state, jnp.int32(0))
+    assert float(out.state.vel_y) > 0  # reflected downward
+    assert float(out.state.ball_y) >= 0
+
+
+def test_pong_scoring_and_termination():
+    env = make_pong(size=42, points_to_win=1)
+    state, _ = env.reset(jax.random.key(0))
+    # Ball sailing past the LEFT edge far from the opponent paddle ⇒ the
+    # agent scores; with points_to_win=1 the episode terminates (and
+    # auto-resets).
+    state = state._replace(
+        ball_x=jnp.float32(1.5), ball_y=jnp.float32(40.0),
+        vel_x=jnp.float32(-2.0), vel_y=jnp.float32(0.0),
+        opp_y=jnp.float32(6.0),  # far from the ball
+    )
+    out = env.step(state, jnp.int32(0))
+    assert float(out.reward) == 1.0
+    assert float(out.done) == 1.0
+    assert float(out.info["terminated"]) == 1.0
+    # Auto-reset: fresh episode state (scores back to zero).
+    assert int(out.state.player_score) == 0
+
+
+def test_pong_agent_miss_negative_reward():
+    env = make_pong(size=42, points_to_win=5)
+    state, _ = env.reset(jax.random.key(0))
+    state = state._replace(
+        ball_x=jnp.float32(40.5), ball_y=jnp.float32(40.0),
+        vel_x=jnp.float32(2.0), vel_y=jnp.float32(0.0),
+        player_y=jnp.float32(6.0),
+    )
+    out = env.step(state, jnp.int32(0))
+    assert float(out.reward) == -1.0
+    assert float(out.done) == 0.0  # game to 5 continues
+    assert int(out.state.opp_score) == 1
+
+
+def test_pong_paddle_hit_reflects_vx():
+    env = make_pong(size=42)
+    state, _ = env.reset(jax.random.key(0))
+    state = state._replace(
+        ball_x=jnp.float32(38.0), ball_y=jnp.float32(21.0),
+        vel_x=jnp.float32(2.0), vel_y=jnp.float32(0.0),
+        player_y=jnp.float32(21.0),
+    )
+    out = env.step(state, jnp.int32(0))
+    assert float(out.state.vel_x) < 0  # bounced back toward the opponent
+    assert float(out.reward) == 0.0
+
+
+# ---------------------------------------------------------- IMPALA trainer
+
+
+def test_impala_on_policy_rhos_are_one():
+    """With actor_refresh_every=1 the behaviour policy equals the learner
+    policy at rollout time, so every clipped ρ is exactly 1."""
+    env = make_two_state_mdp()
+    cfg = impala.ImpalaConfig(
+        num_envs=4, rollout_steps=8, hidden=(16,), actor_refresh_every=1
+    )
+    state = impala.init_state(env, cfg, jax.random.key(0))
+    step = jax.jit(impala.make_train_step(env, cfg))
+    state, metrics = step(state)
+    np.testing.assert_allclose(float(metrics["mean_rho"]), 1.0, rtol=1e-6)
+    state, metrics = step(state)  # still in sync after the refresh
+    np.testing.assert_allclose(float(metrics["mean_rho"]), 1.0, rtol=1e-6)
+
+
+def test_impala_staleness_refresh_schedule():
+    """actor_refresh_every=3: actor params lag the learner until step 3."""
+    env = make_two_state_mdp()
+    cfg = impala.ImpalaConfig(
+        num_envs=4, rollout_steps=4, hidden=(16,), actor_refresh_every=3
+    )
+    state = impala.init_state(env, cfg, jax.random.key(0))
+    step = jax.jit(impala.make_train_step(env, cfg))
+
+    def params_equal(a, b):
+        return all(
+            bool(jnp.all(x == y))
+            for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+        )
+
+    state, _ = step(state)  # step 1: no refresh
+    assert not params_equal(state.params, state.actor_params)
+    state, _ = step(state)  # step 2: no refresh
+    assert not params_equal(state.params, state.actor_params)
+    state, _ = step(state)  # step 3: refresh boundary
+    assert params_equal(state.params, state.actor_params)
+
+
+def test_impala_learns_two_state_mdp():
+    """IMPALA with a 2-step policy lag still converges on the analytic MDP
+    (V-trace corrects the off-policyness)."""
+    env = make_two_state_mdp()
+    cfg = impala.ImpalaConfig(
+        num_envs=16, rollout_steps=8, hidden=(32,), lr=3e-3,
+        actor_refresh_every=2, entropy_coef=0.001,
+    )
+    state, _ = impala.train(env, cfg, num_iterations=800, seed=0)
+    net = impala.make_network(env, cfg)
+    obs = jnp.eye(2, dtype=jnp.float32)  # both one-hot states
+    dist, values = net.apply(state.params, obs)
+    probs = jax.nn.softmax(dist.logits, axis=-1)
+    # Action 1 is optimal in both states (reward 1 forever).
+    assert float(probs[0, 1]) > 0.8
+    assert float(probs[1, 1]) > 0.8
+    # Critic heads toward V* = 1/(1-γ) = 100 (exact fixed point takes far
+    # longer than the test budget; assert it is well on the way).
+    assert 50.0 < float(values[0]) <= 110.0
+
+
+def test_a3c_mode_learns_two_state_mdp():
+    env = make_two_state_mdp()
+    cfg = impala.ImpalaConfig(
+        num_envs=16, rollout_steps=8, hidden=(32,), lr=3e-3,
+        correction="none", actor_refresh_every=2, entropy_coef=0.001,
+        lam=0.95,
+    )
+    state, _ = impala.train(env, cfg, num_iterations=400, seed=0)
+    net = impala.make_network(env, cfg)
+    obs = jnp.eye(2, dtype=jnp.float32)
+    dist, _ = net.apply(state.params, obs)
+    probs = jax.nn.softmax(dist.logits, axis=-1)
+    assert float(probs[0, 1]) > 0.8
+    assert float(probs[1, 1]) > 0.8
+
+
+def test_impala_pixel_smoke():
+    """CNN path: a few fused steps on the Pong env produce finite losses."""
+    env = make_pong(size=42, points_to_win=1, max_steps=64)
+    cfg = impala.ImpalaConfig(num_envs=2, rollout_steps=4)
+    state = impala.init_state(env, cfg, jax.random.key(0))
+    step = jax.jit(impala.make_train_step(env, cfg), donate_argnums=0)
+    for _ in range(2):
+        state, metrics = step(state)
+    assert np.isfinite(float(metrics["loss"]))
+    assert np.isfinite(float(metrics["entropy"]))
+    assert int(state.update_step) == 2
+
+
+def test_impala_config_validation():
+    with pytest.raises(ValueError):
+        impala.ImpalaConfig(correction="bogus")
+    with pytest.raises(ValueError):
+        impala.ImpalaConfig(actor_refresh_every=0)

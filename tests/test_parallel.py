@@ -138,3 +138,40 @@ def test_distribute_state_rejects_indivisible():
     state = a2c.init_state(env, cfg, jax.random.key(0))
     with pytest.raises(ValueError, match="not divisible"):
         distribute_state(state, _mesh())
+
+
+def test_dp_impala_train_step_runs_and_replicates():
+    """IMPALA's state (with stale actor params) shards and stays replicated
+    across the dp mesh; staleness refresh happens identically per device."""
+    from actor_critic_tpu.algos import impala
+    from actor_critic_tpu.parallel import impala_state_specs
+
+    env = make_two_state_mdp()
+    cfg = impala.ImpalaConfig(
+        num_envs=16, rollout_steps=4, hidden=(16,), actor_refresh_every=2
+    )
+    mesh = _mesh()
+    state = impala.init_state(env, cfg, jax.random.key(0))
+    state = distribute_state(state, mesh, impala_state_specs())
+    step = make_dp_train_step(
+        impala.make_train_step(env, cfg, axis_name=DP_AXIS),
+        mesh,
+        impala_state_specs(),
+    )
+    state, metrics = step(state)
+    jax.block_until_ready(state)  # see note in test_dp_learning_two_state
+    state, metrics = step(state)
+    jax.block_until_ready(state)
+
+    for tree in (state.params, state.actor_params):
+        leaf = jax.tree.leaves(tree)[0]
+        shards = [np.asarray(s.data) for s in leaf.addressable_shards]
+        for s in shards[1:]:
+            np.testing.assert_array_equal(shards[0], s)
+    # Step 2 is a refresh boundary ⇒ actor == learner params.
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        state.params,
+        state.actor_params,
+    )
+    assert np.isfinite(float(metrics["loss"]))

@@ -4,6 +4,7 @@ analytic point-mass env (SURVEY.md §4; BASELINE.json:10)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from actor_critic_tpu import replay
 from actor_critic_tpu.algos import sac
@@ -80,14 +81,24 @@ class TestUpdateMechanics:
         np.testing.assert_allclose(float(metrics["alpha"]), 0.2, rtol=1e-6)
 
     def test_alpha_tunes_toward_target_entropy(self):
-        """Entropy above target → α should decay (and vice versa); with a
-        fresh (high-entropy) policy α must come down from 1.0."""
+        """α must move opposite the entropy gap: entropy above target ⇒
+        α decays, entropy below target ⇒ α grows. Either branch asserts."""
         cfg = _small_cfg(updates_per_iter=32, init_alpha=1.0, alpha_lr=1e-2)
         learner = _filled_learner(cfg)
         new, metrics = sac.make_update_loop(1, cfg)(learner, jnp.asarray(True))
         entropy = float(metrics["entropy_est"])
-        if entropy > sac._target_entropy(1, cfg) * -1.0:
+        target = sac._target_entropy(1, cfg)
+        assert abs(entropy - target) > 1e-3, "gap too small to test direction"
+        if entropy > target:
             assert float(new.log_alpha) < float(learner.log_alpha)
+        else:
+            assert float(new.log_alpha) > float(learner.log_alpha)
+
+    def test_config_rejects_nonpositive_alpha(self):
+        with pytest.raises(ValueError):
+            sac.SACConfig(init_alpha=0.0)
+        with pytest.raises(ValueError):
+            sac.SACConfig(fixed_alpha=-0.1)
 
 
 class TestFusedTrainer:
