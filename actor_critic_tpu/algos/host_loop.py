@@ -161,17 +161,42 @@ def fused_train_loop(
     state=None,
     log_every: int = 0,
     log_fn: Optional[Callable[[int, dict], None]] = None,
+    scan_when_silent: bool = False,
 ):
     """Shared host loop around a fused (single-device) train step — the
-    common body of ddpg.train and sac.train."""
+    single body behind a2c/impala/ddpg/sac `.train`.
+
+    With `scan_when_silent` and `log_every<=0` the whole loop is itself
+    scanned on-device so the host dispatches O(1) programs (the a2c/
+    impala fast path); otherwise each iteration is one donated jit call
+    with optional periodic logging.
+    """
     import jax
 
     if state is None:
         state = init_state(env, cfg, jax.random.key(seed))
-    step = jax.jit(make_train_step(env, cfg), donate_argnums=0)
+    step = make_train_step(env, cfg)
+
+    if scan_when_silent and log_every <= 0:
+        if num_iterations < 1:
+            raise ValueError("num_iterations must be >= 1")
+
+        @jax.jit
+        def run(state):
+            def body(s, _):
+                s, _m = step(s)
+                return s, None
+
+            s, _ = jax.lax.scan(body, state, None, length=num_iterations - 1)
+            # exactly num_iterations updates; last one returns the metrics
+            return step(s)
+
+        return run(state)
+
+    jit_step = jax.jit(step, donate_argnums=0)
     metrics: dict = {}
     for it in range(num_iterations):
-        state, metrics = step(state)
+        state, metrics = jit_step(state)
         if log_fn is not None and log_every > 0 and (it + 1) % log_every == 0:
             log_fn(it + 1, {k: float(v) for k, v in metrics.items()})
     return state, metrics

@@ -273,31 +273,10 @@ def train(
 ) -> tuple[ImpalaTrainState, dict[str, jax.Array]]:
     """Host loop around the fused step; `log_every=0` scans all iterations
     on-device in a single dispatch (same pattern as a2c.train)."""
-    if state is None:
-        state = init_state(env, cfg, jax.random.key(seed))
-    step = make_train_step(env, cfg)
+    from actor_critic_tpu.algos.host_loop import fused_train_loop
 
-    if log_every <= 0:
-        if num_iterations < 1:
-            raise ValueError("num_iterations must be >= 1")
-
-        @jax.jit
-        def run(state):
-            def body(s, _):
-                s, _m = step(s)
-                return s, None
-
-            s, _ = jax.lax.scan(body, state, None, length=num_iterations - 1)
-            s, m = step(s)
-            return s, m
-
-        state, metrics = run(state)
-        return state, metrics
-
-    jit_step = jax.jit(step, donate_argnums=0)
-    metrics = {}
-    for it in range(num_iterations):
-        state, metrics = jit_step(state)
-        if log_fn is not None and (it + 1) % log_every == 0:
-            log_fn(it + 1, {k: float(v) for k, v in metrics.items()})
-    return state, metrics
+    return fused_train_loop(
+        make_train_step, init_state, env, cfg, num_iterations,
+        seed=seed, state=state, log_every=log_every, log_fn=log_fn,
+        scan_when_silent=True,
+    )

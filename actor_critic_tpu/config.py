@@ -1,0 +1,213 @@
+"""Config system: named presets + `key=value` overrides (SURVEY.md §5.6).
+
+The reference genre configures each algorithm through per-script argparse
+flags (reference mount empty at survey, SURVEY.md §0). The TPU build
+replaces that with frozen dataclass configs (each algorithm module owns
+its own) plus this registry of named presets — one per reference config
+in BASELINE.json:7-11 — and a typed `--set key=value` override parser, so
+one `train.py` CLI drives every algorithm.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import typing
+from typing import Any, Optional, Union
+
+from actor_critic_tpu.algos import a2c, ddpg, impala, ppo, sac
+
+
+@dataclasses.dataclass(frozen=True)
+class Preset:
+    """A runnable training setup: algorithm + environment + config."""
+
+    algo: str        # a2c | ppo | ddpg | td3 | sac | impala | a3c
+    env: str         # "jax:<name>" (pure-JAX, fused) or "host:<gym id>"
+    config: Any      # the algorithm's frozen config dataclass
+    iterations: int  # default --iterations
+    description: str
+
+
+PRESETS: dict[str, Preset] = {
+    # BASELINE.json:7 — the ≥1M env-steps/sec north-star config.
+    "a2c_cartpole": Preset(
+        algo="a2c",
+        env="jax:cartpole",
+        config=a2c.A2CConfig(num_envs=4096, rollout_steps=32, lr=1e-3),
+        iterations=500,
+        description="A2C on pure-JAX CartPole-v1, fully fused (BASELINE.json:7)",
+    ),
+    # BASELINE.json:8 — continuous control via the host-env pool.
+    "ppo_halfcheetah": Preset(
+        algo="ppo",
+        env="host:HalfCheetah-v5",
+        config=ppo.PPOConfig(
+            num_envs=8, rollout_steps=256, epochs=10, num_minibatches=32,
+            entropy_coef=0.0, lr=3e-4,
+        ),
+        iterations=500,
+        description="PPO-clip on MuJoCo HalfCheetah-v5 (BASELINE.json:8)",
+    ),
+    # BASELINE.json:9 — off-policy with the HBM replay ring.
+    "ddpg_walker2d": Preset(
+        algo="ddpg",
+        env="host:Walker2d-v5",
+        config=ddpg.DDPGConfig(num_envs=1, steps_per_iter=64, updates_per_iter=64),
+        iterations=2000,
+        description="DDPG on MuJoCo Walker2d-v5 (BASELINE.json:9)",
+    ),
+    "td3_walker2d": Preset(
+        algo="td3",
+        env="host:Walker2d-v5",
+        config=ddpg.td3_config(num_envs=1, steps_per_iter=64, updates_per_iter=64),
+        iterations=2000,
+        description="TD3 on MuJoCo Walker2d-v5 (BASELINE.json:9)",
+    ),
+    # BASELINE.json:10.
+    "sac_humanoid": Preset(
+        algo="sac",
+        env="host:Humanoid-v5",
+        config=sac.SACConfig(num_envs=1, steps_per_iter=64, updates_per_iter=64),
+        iterations=4000,
+        description="SAC on MuJoCo Humanoid-v5 (BASELINE.json:10)",
+    ),
+    # BASELINE.json:11 — ale-py is unavailable; the JAX-native Pong-like
+    # pixel env stands in (SURVEY.md §2.2, envs/pong.py docstring).
+    "impala_pong": Preset(
+        algo="impala",
+        env="jax:pong",
+        config=impala.ImpalaConfig(
+            num_envs=64, rollout_steps=20, actor_refresh_every=4
+        ),
+        iterations=2000,
+        description="IMPALA/V-trace on JAX Pong-like pixels (BASELINE.json:11)",
+    ),
+    "a3c_pong": Preset(
+        algo="a3c",
+        env="jax:pong",
+        config=impala.ImpalaConfig(
+            num_envs=64, rollout_steps=20, actor_refresh_every=4,
+            correction="none", lam=0.95,
+        ),
+        iterations=2000,
+        description="A3C-style (no IS correction) on JAX Pong (BASELINE.json:11)",
+    ),
+}
+
+# Algorithm name → config dataclass type, for --algo without --preset.
+ALGO_CONFIGS: dict[str, Any] = {
+    "a2c": a2c.A2CConfig,
+    "ppo": ppo.PPOConfig,
+    "ddpg": ddpg.DDPGConfig,
+    "td3": ddpg.DDPGConfig,
+    "sac": sac.SACConfig,
+    "impala": impala.ImpalaConfig,
+    "a3c": impala.ImpalaConfig,
+}
+
+
+def _coerce(raw: str, typ: Any) -> Any:
+    """Parse a CLI string into the annotated field type."""
+    origin = typing.get_origin(typ)
+    if origin is Union:  # Optional[T]
+        args = [a for a in typing.get_args(typ) if a is not type(None)]
+        if raw.lower() in ("none", "null"):
+            return None
+        return _coerce(raw, args[0])
+    if origin is tuple:
+        elem = typing.get_args(typ)[0]
+        if raw.strip() == "":
+            return ()
+        return tuple(_coerce(p.strip(), elem) for p in raw.split(","))
+    if typ is bool:
+        if raw.lower() in ("1", "true", "yes", "on"):
+            return True
+        if raw.lower() in ("0", "false", "no", "off"):
+            return False
+        raise ValueError(f"not a bool: {raw!r}")
+    if typ is int:
+        return int(raw)
+    if typ is float:
+        return float(raw)
+    if typ is str:
+        return raw
+    raise ValueError(f"unsupported field type {typ} for value {raw!r}")
+
+
+def apply_overrides(config: Any, overrides: dict[str, str]) -> Any:
+    """`dataclasses.replace` with string values coerced to field types.
+
+    Unknown keys raise with the list of valid fields (typo safety).
+    """
+    if not overrides:
+        return config
+    hints = typing.get_type_hints(type(config))
+    fields = {f.name for f in dataclasses.fields(config)}
+    updates = {}
+    for key, raw in overrides.items():
+        if key not in fields:
+            raise KeyError(
+                f"{type(config).__name__} has no field {key!r}; "
+                f"valid: {sorted(fields)}"
+            )
+        updates[key] = _coerce(raw, hints[key])
+    return dataclasses.replace(config, **updates)
+
+
+def parse_set_args(pairs: list[str]) -> dict[str, str]:
+    """['lr=1e-3', 'hidden=64,64'] → {'lr': '1e-3', 'hidden': '64,64'}."""
+    out: dict[str, str] = {}
+    for pair in pairs:
+        if "=" not in pair:
+            raise ValueError(f"--set expects key=value, got {pair!r}")
+        key, _, value = pair.partition("=")
+        out[key.strip()] = value.strip()
+    return out
+
+
+def default_config(algo: str) -> Any:
+    """The algorithm's default config, with variant specialization applied
+    (td3 → twin-Q/delay/smoothing; a3c → no importance correction)."""
+    if algo not in ALGO_CONFIGS:
+        raise KeyError(f"unknown algo {algo!r}; valid: {sorted(ALGO_CONFIGS)}")
+    if algo == "td3":
+        return ddpg.td3_config()
+    cfg = ALGO_CONFIGS[algo]()
+    if algo == "a3c":
+        cfg = dataclasses.replace(cfg, correction="none")
+    return cfg
+
+
+def resolve(
+    preset: Optional[str],
+    algo: Optional[str],
+    env: Optional[str],
+    overrides: dict[str, str],
+) -> Preset:
+    """Resolve CLI selections into a concrete Preset.
+
+    Either `--preset name` (optionally overridden by --algo/--env) or
+    `--algo` + `--env` from scratch with that algorithm's default config.
+    """
+    if preset is not None:
+        if preset not in PRESETS:
+            raise KeyError(f"unknown preset {preset!r}; valid: {sorted(PRESETS)}")
+        base = PRESETS[preset]
+        algo = algo or base.algo
+        env = env or base.env
+        # Changing the algo drops the preset's config (it belongs to the
+        # preset's algorithm) in favor of the new algo's specialized
+        # defaults — so e.g. `--preset ddpg_walker2d --algo td3` really
+        # runs TD3, not vanilla DDPG under a td3 label.
+        cfg = base.config if algo == base.algo else default_config(algo)
+        return Preset(
+            algo=algo, env=env, config=apply_overrides(cfg, overrides),
+            iterations=base.iterations, description=base.description,
+        )
+    if algo is None or env is None:
+        raise ValueError("need --preset, or both --algo and --env")
+    cfg = default_config(algo)
+    return Preset(
+        algo=algo, env=env, config=apply_overrides(cfg, overrides),
+        iterations=1000, description=f"{algo} on {env}",
+    )

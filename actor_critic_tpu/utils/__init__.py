@@ -1,0 +1,13 @@
+from actor_critic_tpu.utils.checkpoint import (
+    Checkpointer,
+    checkpointed_train,
+    resume_or_init,
+)
+from actor_critic_tpu.utils.logging import JsonlLogger
+
+__all__ = [
+    "Checkpointer",
+    "JsonlLogger",
+    "checkpointed_train",
+    "resume_or_init",
+]

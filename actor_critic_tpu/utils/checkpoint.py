@@ -1,0 +1,158 @@
+"""Checkpoint / resume via orbax (SURVEY.md §5.3-5.4).
+
+The reference genre saves with `tf.train.Saver` periodically and dies on
+failure (reference mount empty at survey, SURVEY.md §0); the TPU build's
+recovery story is checkpoint-restart: every K iterations the FULL
+trainer state pytree — params, optimizer state, env/rollout state, PRNG
+keys, step counters, normalizer stats — is saved asynchronously, and
+`resume_or_init` restores the exact state so a restarted run is
+bitwise-identical to an uninterrupted one (the trainers are pure
+functions of their state; tested in tests/test_checkpoint.py).
+
+JAX typed PRNG keys are packed to their raw uint32 `key_data` on save
+and re-wrapped on restore (orbax stores plain arrays), keyed off the
+template state's leaf types, so any trainer state NamedTuple works
+unmodified.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import orbax.checkpoint as ocp
+
+
+def _is_typed_key(x) -> bool:
+    return hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jax.dtypes.prng_key)
+
+
+def pack_keys(state: Any) -> Any:
+    """Replace typed PRNG key leaves with their raw uint32 key data."""
+    return jax.tree.map(
+        lambda x: jax.random.key_data(x) if _is_typed_key(x) else x, state
+    )
+
+
+def unpack_keys(restored: Any, template: Any) -> Any:
+    """Re-wrap raw key data wherever `template` holds a typed key."""
+    return jax.tree.map(
+        lambda t, r: (
+            jax.random.wrap_key_data(r, impl=jax.random.key_impl(t))
+            if _is_typed_key(t)
+            else r
+        ),
+        template,
+        restored,
+    )
+
+
+class Checkpointer:
+    """Thin wrapper over `ocp.CheckpointManager` for trainer states.
+
+    Saves are async (the train loop keeps running while the write
+    completes); `wait()` blocks, and `close()` waits + releases.
+    """
+
+    def __init__(
+        self,
+        directory: str | os.PathLike,
+        max_to_keep: int = 3,
+        save_interval_steps: int = 1,
+    ):
+        self._mgr = ocp.CheckpointManager(
+            os.path.abspath(os.fspath(directory)),
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                save_interval_steps=save_interval_steps,
+            ),
+        )
+
+    def save(self, step: int, state: Any, force: bool = False) -> bool:
+        """Persist `state` under `step`. Returns True if a save happened
+        (the manager skips steps closer than `save_interval_steps`)."""
+        return self._mgr.save(
+            step, args=ocp.args.StandardSave(pack_keys(state)), force=force
+        )
+
+    def restore(self, template: Any, step: Optional[int] = None) -> Any:
+        """Restore the checkpoint at `step` (default: latest) into the
+        structure/shardings of `template` (a concrete or abstract state)."""
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError("no checkpoint to restore")
+        packed = pack_keys(template)
+        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, packed)
+        restored = self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+        return unpack_keys(restored, template)
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def all_steps(self) -> list[int]:
+        return list(self._mgr.all_steps())
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def resume_or_init(ckpt: Checkpointer, init_state: Any) -> tuple[Any, int]:
+    """(state, completed_iterations): the latest checkpoint if one exists,
+    else the freshly-initialized state at iteration 0."""
+    step = ckpt.latest_step()
+    if step is None:
+        return init_state, 0
+    return ckpt.restore(init_state, step), step
+
+
+def checkpointed_train(
+    step_fn: Callable[[Any], tuple[Any, dict]],
+    init_state: Any,
+    num_iterations: int,
+    ckpt: Optional[Checkpointer] = None,
+    save_every: int = 0,
+    log_fn: Optional[Callable[[int, dict], None]] = None,
+    resume: bool = True,
+) -> tuple[Any, dict]:
+    """Restart-idempotent train loop (SURVEY.md §5.3).
+
+    Resumes from the latest checkpoint (if any, and `resume`), runs the
+    remaining iterations with `step_fn` (a jitted `(state) → (state,
+    metrics)`), saving every `save_every` iterations (plus once at the
+    end; `save_every<=0` means end-only) and calling `log_fn(it,
+    metrics)` each iteration. Re-running after a mid-loop kill produces
+    the same final state as an uninterrupted run, because the state
+    pytree carries everything. With `ckpt=None` it is a plain train
+    loop — the single implementation every caller shares.
+    """
+    if ckpt is not None and resume:
+        state, done = resume_or_init(ckpt, init_state)
+    else:
+        state, done = init_state, 0
+    metrics: dict = {}
+    for it in range(done + 1, num_iterations + 1):
+        state, metrics = step_fn(state)
+        if ckpt is not None and (
+            (save_every > 0 and it % save_every == 0) or it == num_iterations
+        ):
+            # Sync before handing buffers to the async saver: donation
+            # would otherwise let the next step overwrite in-flight reads.
+            jax.block_until_ready(state)
+            ckpt.save(it, state, force=True)
+        if log_fn is not None:
+            log_fn(it, metrics)
+    if ckpt is not None:
+        ckpt.wait()
+    return state, metrics

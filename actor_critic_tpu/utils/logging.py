@@ -1,0 +1,87 @@
+"""JSONL metrics logging (SURVEY.md §5.5).
+
+The reference genre prints episode returns and writes TensorBoard
+scalars via `tf.summary` (reference mount empty at survey, SURVEY.md
+§0). The TPU build's primary sink is a machine-readable `metrics.jsonl`:
+one JSON object per logging step with the iteration, wall-clock, env
+steps, and every scalar the trainer reported. Metric values arrive as
+device arrays already aggregated on-device (algos/metrics.py) — exactly
+one host transfer per logged iteration.
+
+TensorBoard export stays available by pointing the installed
+`tensorboard` at these JSONL files via `scripts/` tooling, or by passing
+`tensorboard_dir` here (uses tf.summary lazily; gated so the framework
+never hard-depends on TF).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import IO, Optional
+
+
+class JsonlLogger:
+    """Append-only JSONL metrics writer with optional stdout echo."""
+
+    def __init__(
+        self,
+        path: Optional[str | os.PathLike] = "metrics.jsonl",
+        echo: bool = False,
+        tensorboard_dir: Optional[str] = None,
+    ):
+        self._fh: Optional[IO[str]] = None
+        if path is not None:
+            parent = os.path.dirname(os.fspath(path))
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+            self._fh = open(path, "a", buffering=1)
+        self._echo = echo
+        self._t0 = time.time()
+        self._tb = None
+        if tensorboard_dir is not None:
+            import tensorflow as tf  # installed; only imported on request
+
+            self._tb = tf.summary.create_file_writer(tensorboard_dir)
+
+    def log(self, iteration: int, metrics: dict, **extra) -> None:
+        row = {
+            "iter": int(iteration),
+            "wall_s": round(time.time() - self._t0, 3),
+        }
+        for k, v in {**metrics, **extra}.items():
+            try:
+                row[k] = float(v)
+            except (TypeError, ValueError):
+                row[k] = str(v)
+        if self._fh is not None:
+            self._fh.write(json.dumps(row) + "\n")
+        if self._echo:
+            short = ", ".join(
+                f"{k}={v:.4g}" if isinstance(v, float) else f"{k}={v}"
+                for k, v in row.items()
+                if k != "wall_s"
+            )
+            print(f"[{row['wall_s']:9.1f}s] {short}", flush=True)
+        if self._tb is not None:
+            import tensorflow as tf
+
+            with self._tb.as_default():
+                for k, v in row.items():
+                    if isinstance(v, float):
+                        tf.summary.scalar(k, v, step=int(iteration))
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+        if self._tb is not None:
+            self._tb.close()
+            self._tb = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,121 @@
+"""Checkpoint/resume tests (SURVEY.md §4-§5.3): exact-state roundtrip,
+kill-resume equivalence with an uninterrupted run, and retention."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from actor_critic_tpu.algos import a2c
+from actor_critic_tpu.envs import make_two_state_mdp
+from actor_critic_tpu.utils.checkpoint import (
+    Checkpointer,
+    checkpointed_train,
+    resume_or_init,
+)
+
+
+def _setup():
+    env = make_two_state_mdp()
+    cfg = a2c.A2CConfig(num_envs=8, rollout_steps=4, hidden=(16,))
+    state = a2c.init_state(env, cfg, jax.random.key(0))
+    step = jax.jit(a2c.make_train_step(env, cfg))
+    return env, cfg, state, step
+
+
+def _assert_states_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(
+            np.asarray(jax.random.key_data(x) if jnp.issubdtype(x.dtype, jax.dtypes.prng_key) else x),
+            np.asarray(jax.random.key_data(y) if jnp.issubdtype(y.dtype, jax.dtypes.prng_key) else y),
+        )
+
+
+def test_roundtrip_exact(tmp_path):
+    _, _, state, step = _setup()
+    state, _ = step(state)
+    with Checkpointer(tmp_path / "ckpt") as ck:
+        ck.save(1, state, force=True)
+        ck.wait()
+        restored = ck.restore(state)
+    _assert_states_equal(state, restored)
+
+
+def test_kill_resume_matches_uninterrupted(tmp_path):
+    """Run 3 steps, 'die', restore, run 3 more == 6 uninterrupted steps."""
+    _, _, state0, step = _setup()
+
+    full = state0
+    for _ in range(6):
+        full, full_metrics = step(full)
+
+    half = state0
+    for _ in range(3):
+        half, _ = step(half)
+    with Checkpointer(tmp_path / "ckpt") as ck:
+        ck.save(3, half, force=True)
+        ck.wait()
+        # "New process": restore into a freshly-initialized template.
+        _, _, fresh, _ = _setup()
+        resumed = ck.restore(fresh, 3)
+    for _ in range(3):
+        resumed, resumed_metrics = step(resumed)
+
+    _assert_states_equal(full, resumed)
+    for k in full_metrics:
+        np.testing.assert_array_equal(
+            np.asarray(full_metrics[k]), np.asarray(resumed_metrics[k])
+        )
+
+
+def test_checkpointed_train_resumes(tmp_path):
+    """checkpointed_train killed mid-run completes to the same final state."""
+    _, _, state0, step = _setup()
+
+    with Checkpointer(tmp_path / "a") as ck:
+        ref, _ = checkpointed_train(step, state0, 8, ck, save_every=3)
+
+    # Interrupted: first call only gets through 4 iterations ("kill" = we
+    # stop calling); checkpoint exists at 3. Second call resumes at 3.
+    with Checkpointer(tmp_path / "b") as ck:
+        s = state0
+        for it in range(1, 5):
+            s, _ = step(s)
+            if it % 3 == 0:
+                jax.block_until_ready(s)
+                ck.save(it, s, force=True)
+        ck.wait()
+        assert ck.latest_step() == 3
+        resumed, _ = checkpointed_train(step, state0, 8, ck, save_every=3)
+
+    _assert_states_equal(ref, resumed)
+
+
+def test_resume_or_init_fresh(tmp_path):
+    _, _, state0, _ = _setup()
+    with Checkpointer(tmp_path / "empty") as ck:
+        state, done = resume_or_init(ck, state0)
+    assert done == 0
+    _assert_states_equal(state, state0)
+
+
+def test_retention_and_latest(tmp_path):
+    _, _, state, step = _setup()
+    with Checkpointer(tmp_path / "ckpt", max_to_keep=2) as ck:
+        for it in (1, 2, 3):
+            state, _ = step(state)
+            jax.block_until_ready(state)
+            ck.save(it, state, force=True)
+        ck.wait()
+        assert ck.latest_step() == 3
+        kept = ck.all_steps()
+    assert 3 in kept and len(kept) <= 2
+
+
+def test_restore_missing_raises(tmp_path):
+    _, _, state0, _ = _setup()
+    with Checkpointer(tmp_path / "none") as ck:
+        with pytest.raises(FileNotFoundError):
+            ck.restore(state0)

@@ -1,0 +1,124 @@
+"""Config/preset system + train.py CLI tests (SURVEY.md §5.6)."""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from actor_critic_tpu.config import (
+    ALGO_CONFIGS,
+    PRESETS,
+    apply_overrides,
+    parse_set_args,
+    resolve,
+)
+
+
+def test_presets_cover_all_baseline_configs():
+    """One preset per BASELINE.json:7-11 config (+ TD3 and A3C variants)."""
+    algos = {p.algo for p in PRESETS.values()}
+    assert {"a2c", "ppo", "ddpg", "td3", "sac", "impala", "a3c"} <= algos
+    assert "a2c_cartpole" in PRESETS
+    assert "ppo_halfcheetah" in PRESETS
+    assert "sac_humanoid" in PRESETS
+    assert "impala_pong" in PRESETS
+
+
+def test_apply_overrides_coercion():
+    from actor_critic_tpu.algos import a2c
+
+    cfg = a2c.A2CConfig()
+    out = apply_overrides(
+        cfg,
+        {"lr": "1e-4", "num_envs": "128", "hidden": "32,32,32",
+         "normalize_adv": "true"},
+    )
+    assert out.lr == 1e-4
+    assert out.num_envs == 128
+    assert out.hidden == (32, 32, 32)
+    assert out.normalize_adv is True
+    assert cfg.lr != out.lr  # frozen original untouched
+
+
+def test_apply_overrides_optional_and_errors():
+    from actor_critic_tpu.algos import sac
+
+    cfg = sac.SACConfig()
+    out = apply_overrides(cfg, {"fixed_alpha": "0.2"})
+    assert out.fixed_alpha == 0.2
+    out = apply_overrides(out, {"fixed_alpha": "none"})
+    assert out.fixed_alpha is None
+    with pytest.raises(KeyError, match="no field"):
+        apply_overrides(cfg, {"ler": "1e-4"})
+
+
+def test_parse_set_args():
+    assert parse_set_args(["a=1", "b=x=y"]) == {"a": "1", "b": "x=y"}
+    with pytest.raises(ValueError):
+        parse_set_args(["oops"])
+
+
+def test_resolve_preset_with_override():
+    pre = resolve("a2c_cartpole", None, None, {"num_envs": "64"})
+    assert pre.algo == "a2c"
+    assert pre.config.num_envs == 64
+
+
+def test_resolve_algo_env_from_scratch():
+    pre = resolve(None, "td3", "jax:point_mass", {})
+    assert pre.config.twin_q is True  # td3_config applied
+    pre = resolve(None, "a3c", "jax:pong", {})
+    assert pre.config.correction == "none"
+    with pytest.raises(ValueError):
+        resolve(None, "a2c", None, {})
+    with pytest.raises(KeyError):
+        resolve("nope", None, None, {})
+
+
+def test_algo_configs_constructible():
+    for name, cls in ALGO_CONFIGS.items():
+        cls()  # defaults must be valid
+
+
+@pytest.mark.slow
+def test_cli_end_to_end(tmp_path):
+    """train.py runs a tiny fused job, writes JSONL + summary, resumes."""
+    metrics = tmp_path / "m.jsonl"
+    ckpt = tmp_path / "ck"
+    cmd = [
+        sys.executable, "train.py",
+        "--algo", "a2c", "--env", "jax:two_state",
+        "--iterations", "6", "--log-every", "2", "--quiet",
+        "--set", "num_envs=8", "--set", "rollout_steps=4", "--set", "hidden=16",
+        "--metrics", str(metrics),
+        "--ckpt-dir", str(ckpt), "--save-every", "3",
+    ]
+    env = {"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin"}
+    import os
+
+    env.update({k: v for k, v in os.environ.items() if k not in env})
+    r = subprocess.run(cmd, capture_output=True, text=True, env=env, cwd="/root/repo")
+    assert r.returncode == 0, r.stderr[-2000:]
+    rows = [json.loads(l) for l in metrics.read_text().splitlines()]
+    assert rows and rows[-1]["iter"] == 6
+    summary = json.loads(r.stdout.strip().splitlines()[-1])
+    assert summary["env_steps"] == 6 * 8 * 4
+
+    # Resume: checkpoint at 6 exists, asking for 8 runs only 7..8.
+    assert cmd[6] == "--iterations"
+    r2 = subprocess.run(
+        cmd[:7] + ["8"] + cmd[8:] + ["--resume"],
+        capture_output=True, text=True, env=env, cwd="/root/repo",
+    )
+    assert r2.returncode == 0, r2.stderr[-2000:]
+    assert "resumed from iteration 6" in r2.stdout
+
+
+def test_resolve_preset_with_different_algo_specializes():
+    """--preset X --algo Y must swap in Y's *specialized* defaults, not the
+    base dataclass (td3 without twin_q would silently run DDPG)."""
+    pre = resolve("ddpg_walker2d", "td3", None, {})
+    assert pre.config.twin_q is True
+    pre = resolve("impala_pong", "a3c", None, {})
+    assert pre.config.correction == "none"

@@ -1,0 +1,199 @@
+"""Unified training CLI (SURVEY.md §5.6).
+
+One entry point for every algorithm/config the framework supports —
+the TPU build's replacement for the reference genre's per-script
+argparse mains (reference mount empty at survey, SURVEY.md §0):
+
+    python train.py --preset a2c_cartpole
+    python train.py --preset ppo_halfcheetah --set lr=1e-4 --iterations 200
+    python train.py --algo sac --env jax:point_mass --set num_envs=16
+    python train.py --preset impala_pong --ckpt-dir runs/pong --resume
+    python train.py --list-presets
+
+Environments: `jax:<name>` runs the fused on-device trainer (rollout +
+update in one XLA program); `host:<gym id>` steps a gymnasium/MuJoCo
+pool on the host with the learner on device. Metrics stream to a JSONL
+file; checkpoints (orbax) make the run restart-idempotent.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+import time
+
+
+def build_env(spec: str, algo: str, cfg, seed: int):
+    """'jax:<name>' → (JaxEnv, fused=True); 'host:<id>' → (pool, False)."""
+    kind, _, name = spec.partition(":")
+    if kind == "jax":
+        from actor_critic_tpu import envs as E
+
+        makers = {
+            "cartpole": E.make_cartpole,
+            "pong": E.make_pong,
+            "two_state": E.make_two_state_mdp,
+            "point_mass": E.make_point_mass,
+            "bandit": E.make_bandit,
+        }
+        if name not in makers:
+            raise SystemExit(f"unknown jax env {name!r}; valid: {sorted(makers)}")
+        return makers[name](), True
+    if kind == "host":
+        from actor_critic_tpu.envs.host_pool import HostEnvPool
+
+        # Off-policy TD targets want raw reward scale (ddpg/sac docstrings).
+        return (
+            HostEnvPool(
+                name,
+                num_envs=cfg.num_envs,
+                seed=seed,
+                normalize_obs=True,
+                normalize_reward=(algo == "ppo"),
+            ),
+            False,
+        )
+    raise SystemExit(f"env must be jax:<name> or host:<gym id>, got {spec!r}")
+
+
+def fused_module(algo: str):
+    from actor_critic_tpu.algos import a2c, ddpg, impala, ppo, sac
+
+    return {
+        "a2c": a2c, "ppo": ppo, "ddpg": ddpg, "td3": ddpg,
+        "sac": sac, "impala": impala, "a3c": impala,
+    }[algo]
+
+
+def steps_per_iteration(algo: str, cfg) -> int:
+    if hasattr(cfg, "rollout_steps"):
+        return cfg.rollout_steps * cfg.num_envs
+    return cfg.steps_per_iter * cfg.num_envs
+
+
+def run_fused(env, preset, args, logger) -> dict:
+    import jax
+
+    from actor_critic_tpu.utils.checkpoint import Checkpointer, checkpointed_train
+
+    mod = fused_module(preset.algo)
+    cfg = preset.config
+    state = mod.init_state(env, cfg, jax.random.key(args.seed))
+    step = jax.jit(mod.make_train_step(env, cfg), donate_argnums=0)
+    spi = steps_per_iteration(preset.algo, cfg)
+
+    ckpt = Checkpointer(args.ckpt_dir) if args.ckpt_dir else None
+    if ckpt is not None and args.resume and ckpt.latest_step() is not None:
+        print(f"resumed from iteration {ckpt.latest_step()}", flush=True)
+
+    def log_fn(it, metrics):
+        # log_every<=0 ⇒ only the final iteration.
+        if (args.log_every > 0 and it % args.log_every == 0) or it == args.iterations:
+            logger.log(it, metrics, env_steps=it * spi)
+
+    state, metrics = checkpointed_train(
+        step, state, args.iterations,
+        ckpt=ckpt, save_every=args.save_every, log_fn=log_fn,
+        resume=args.resume,
+    )
+    if ckpt is not None:
+        ckpt.close()
+    return {k: float(v) for k, v in metrics.items()}
+
+
+def run_host(pool, preset, args, logger) -> dict:
+    from actor_critic_tpu.algos import ddpg, ppo, sac
+
+    last: dict = {}
+
+    def log_fn(it, m):
+        last.clear()
+        last.update(m)
+        logger.log(it, m)
+
+    common = dict(
+        num_iterations=args.iterations, seed=args.seed,
+        log_every=args.log_every, log_fn=log_fn,
+    )
+    if preset.algo == "ppo":
+        ppo.train_host(pool, preset.config, **common)
+    elif preset.algo in ("ddpg", "td3"):
+        ddpg.train_host(pool, preset.config, **common)
+    elif preset.algo == "sac":
+        sac.train_host(pool, preset.config, **common)
+    else:
+        raise SystemExit(
+            f"{preset.algo} needs a pure-JAX env (fused trainer); "
+            "pick env jax:<name>"
+        )
+    return last
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
+    )
+    p.add_argument("--preset", help="named preset (see --list-presets)")
+    p.add_argument("--algo", help="a2c|ppo|ddpg|td3|sac|impala|a3c")
+    p.add_argument("--env", help="jax:<name> or host:<gym id>")
+    p.add_argument("--iterations", type=int, help="train-step iterations")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--set", action="append", default=[], metavar="KEY=VALUE",
+        help="config override (repeatable), e.g. --set lr=1e-4 --set hidden=64,64",
+    )
+    p.add_argument("--metrics", default="metrics.jsonl", help="JSONL output path")
+    p.add_argument("--log-every", type=int, default=10)
+    p.add_argument("--quiet", action="store_true", help="no stdout metric echo")
+    p.add_argument("--ckpt-dir", help="orbax checkpoint dir (fused envs)")
+    p.add_argument("--save-every", type=int, default=100)
+    p.add_argument("--resume", action="store_true", help="resume from --ckpt-dir")
+    p.add_argument("--list-presets", action="store_true")
+    args = p.parse_args(argv)
+
+    from actor_critic_tpu.config import PRESETS, parse_set_args, resolve
+    from actor_critic_tpu.utils.logging import JsonlLogger
+
+    if args.list_presets:
+        for name, pre in PRESETS.items():
+            print(f"{name:18s} {pre.algo:7s} {pre.env:22s} {pre.description}")
+        return 0
+
+    preset = resolve(args.preset, args.algo, args.env, parse_set_args(args.set))
+    if args.iterations is None:
+        args.iterations = preset.iterations
+
+    print(
+        f"algo={preset.algo} env={preset.env} iterations={args.iterations} "
+        f"config={dataclasses.asdict(preset.config)}",
+        flush=True,
+    )
+    env, fused = build_env(preset.env, preset.algo, preset.config, args.seed)
+
+    t0 = time.time()
+    with JsonlLogger(args.metrics, echo=not args.quiet) as logger:
+        if fused:
+            final = run_fused(env, preset, args, logger)
+        else:
+            final = run_host(env, preset, args, logger)
+    wall = time.time() - t0
+    print(
+        json.dumps(
+            {
+                "algo": preset.algo,
+                "env": preset.env,
+                "iterations": args.iterations,
+                "env_steps": args.iterations
+                * steps_per_iteration(preset.algo, preset.config),
+                "wall_s": round(wall, 2),
+                **{k: round(v, 5) for k, v in final.items()},
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
