@@ -147,6 +147,7 @@ def off_policy_train_host(
         maybe_log(
             it, log_every, metrics, tracker, history, log_fn,
             extra={"env_steps": env_steps},
+            num_iterations=num_iterations,
         )
     return learner, history
 
@@ -191,15 +192,28 @@ def fused_train_loop(
             # exactly num_iterations updates; last one returns the metrics
             return step(s)
 
-        return run(state)
+        state, metrics = run(state)
+        if log_fn is not None:  # should_log: final iteration always logs
+            log_fn(num_iterations, {k: float(v) for k, v in metrics.items()})
+        return state, metrics
 
     jit_step = jax.jit(step, donate_argnums=0)
     metrics: dict = {}
     for it in range(num_iterations):
         state, metrics = jit_step(state)
-        if log_fn is not None and log_every > 0 and (it + 1) % log_every == 0:
+        if log_fn is not None and should_log(it + 1, log_every, num_iterations):
             log_fn(it + 1, {k: float(v) for k, v in metrics.items()})
     return state, metrics
+
+
+def should_log(it: int, log_every: int, num_iterations: int) -> bool:
+    """THE logging-cadence policy, shared by every loop and the CLI:
+    every `log_every` iterations (when > 0) plus always the run's final
+    iteration; `log_every <= 0` means final-iteration only. `it` is
+    1-based."""
+    if it == num_iterations:
+        return True
+    return log_every > 0 and it % log_every == 0
 
 
 def maybe_log(
@@ -210,10 +224,12 @@ def maybe_log(
     history: list,
     log_fn: Optional[Callable[[int, dict], None]],
     extra: Optional[dict] = None,
+    num_iterations: int = 0,
 ) -> None:
-    """Append host-side metrics to `history` (and `log_fn`) every
-    `log_every` iterations."""
-    if (it + 1) % max(log_every, 1) != 0:
+    """Append host-side metrics to `history` (and `log_fn`) on the shared
+    `should_log` cadence (pass `num_iterations` so the final iteration is
+    always logged)."""
+    if not should_log(it + 1, log_every, num_iterations):
         return
     m = {k: float(v) for k, v in metrics.items()}
     m.update(tracker.report())

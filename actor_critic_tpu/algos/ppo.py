@@ -316,7 +316,10 @@ def train_host(
             arrays["terminated"], arrays["final_obs"],
             jnp.asarray(obs), ukey,
         )
-        maybe_log(it, log_every, metrics, tracker, history, log_fn)
+        maybe_log(
+            it, log_every, metrics, tracker, history, log_fn,
+            num_iterations=num_iterations,
+        )
     return params, opt_state, history
 
 

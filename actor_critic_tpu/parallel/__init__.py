@@ -14,10 +14,24 @@ from actor_critic_tpu.parallel.dp import (
     make_dp_train_step,
     train_state_specs,
 )
+from actor_critic_tpu.parallel.seqpar import (
+    SP_AXIS,
+    make_seqpar_fn,
+    make_sp_mesh,
+    seqpar_discounted_returns,
+    seqpar_gae,
+    seqpar_vtrace,
+)
 
 __all__ = [
     "DP_AXIS",
     "MODEL_AXIS",
+    "SP_AXIS",
+    "make_seqpar_fn",
+    "make_sp_mesh",
+    "seqpar_discounted_returns",
+    "seqpar_gae",
+    "seqpar_vtrace",
     "MeshConfig",
     "distribute_state",
     "impala_state_specs",

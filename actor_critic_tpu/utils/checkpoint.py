@@ -70,11 +70,29 @@ class Checkpointer:
             ),
         )
 
-    def save(self, step: int, state: Any, force: bool = False) -> bool:
-        """Persist `state` under `step`. Returns True if a save happened
-        (the manager skips steps closer than `save_interval_steps`)."""
+    def save(
+        self,
+        step: int,
+        state: Any,
+        metrics: Optional[dict] = None,
+        force: bool = False,
+    ) -> bool:
+        """Persist `state` (and optionally the latest scalar `metrics`)
+        under `step`. Returns True if a save happened (the manager skips
+        steps closer than `save_interval_steps`).
+
+        Metrics ride along as a JSON item so a resume that finds nothing
+        left to run can still report the run's final metrics instead of
+        an empty dict (see `checkpointed_train`).
+        """
+        m = {k: float(v) for k, v in (metrics or {}).items()}
         return self._mgr.save(
-            step, args=ocp.args.StandardSave(pack_keys(state)), force=force
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardSave(pack_keys(state)),
+                metrics=ocp.args.JsonSave(m),
+            ),
+            force=force,
         )
 
     def restore(self, template: Any, step: Optional[int] = None) -> Any:
@@ -86,8 +104,27 @@ class Checkpointer:
                 raise FileNotFoundError("no checkpoint to restore")
         packed = pack_keys(template)
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, packed)
-        restored = self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+        restored = self._mgr.restore(
+            step, args=ocp.args.Composite(state=ocp.args.StandardRestore(abstract))
+        )["state"]
         return unpack_keys(restored, template)
+
+    def restore_metrics(self, step: Optional[int] = None) -> dict:
+        """The scalar metrics saved alongside the checkpoint at `step`
+        (default: latest); {} if none were recorded."""
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                return {}
+        try:
+            out = self._mgr.restore(
+                step, args=ocp.args.Composite(metrics=ocp.args.JsonRestore())
+            )["metrics"]
+            return dict(out or {})
+        except (FileNotFoundError, KeyError):
+            # Checkpoint predates the metrics item — legitimately absent.
+            # Real IO/corruption errors propagate.
+            return {}
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
@@ -141,7 +178,14 @@ def checkpointed_train(
         state, done = resume_or_init(ckpt, init_state)
     else:
         state, done = init_state, 0
-    metrics: dict = {}
+    # A resume that finds the run already complete would otherwise return
+    # {} and the caller's summary would silently lose all metrics. (Only
+    # hit that case — a mid-run resume overwrites metrics on step one.)
+    metrics: dict = (
+        ckpt.restore_metrics(done)
+        if (ckpt is not None and done and done >= num_iterations)
+        else {}
+    )
     for it in range(done + 1, num_iterations + 1):
         state, metrics = step_fn(state)
         if ckpt is not None and (
@@ -150,7 +194,7 @@ def checkpointed_train(
             # Sync before handing buffers to the async saver: donation
             # would otherwise let the next step overwrite in-flight reads.
             jax.block_until_ready(state)
-            ckpt.save(it, state, force=True)
+            ckpt.save(it, state, metrics=metrics, force=True)
         if log_fn is not None:
             log_fn(it, metrics)
     if ckpt is not None:

@@ -1,0 +1,102 @@
+"""Sequence-parallel scans vs. the single-device golden scans.
+
+Runs on the fake 8-device CPU mesh (conftest.py; SURVEY.md §4). The
+time-sharded implementations in `parallel/seqpar.py` must reproduce the
+plain `lax.scan` results of `ops/returns.py` bitwise-closely for every
+recurrence, including across-segment episode terminations.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from actor_critic_tpu.ops import returns
+from actor_critic_tpu.parallel import seqpar
+
+T, E = 64, 5  # T divides the 8-device mesh; E exercises batch broadcast
+GAMMA, LAM = 0.99, 0.95
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return seqpar.make_sp_mesh()
+
+
+@pytest.fixture(scope="module")
+def traj():
+    rng = np.random.default_rng(0)
+    rewards = jnp.asarray(rng.normal(size=(T, E)), jnp.float32)
+    values = jnp.asarray(rng.normal(size=(T, E)), jnp.float32)
+    # ~15% terminations, scattered so several land on segment boundaries.
+    dones = jnp.asarray(rng.random(size=(T, E)) < 0.15, jnp.float32)
+    bootstrap = jnp.asarray(rng.normal(size=(E,)), jnp.float32)
+    return rewards, values, dones, bootstrap
+
+
+def test_discounted_returns_matches_scan(mesh, traj):
+    rewards, _, dones, bootstrap = traj
+    golden = returns.discounted_returns(rewards, dones, bootstrap, GAMMA)
+    fn = seqpar.make_seqpar_fn(
+        seqpar.seqpar_discounted_returns, mesh, n_time_sharded_args=2
+    )
+    got = fn(rewards, dones, bootstrap, GAMMA)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(golden), rtol=1e-5, atol=1e-5)
+
+
+def test_gae_matches_scan(mesh, traj):
+    rewards, values, dones, bootstrap = traj
+    adv_g, ret_g = returns.gae(rewards, values, dones, bootstrap, GAMMA, LAM)
+    fn = seqpar.make_seqpar_fn(seqpar.seqpar_gae, mesh, n_time_sharded_args=3)
+    adv, ret = fn(rewards, values, dones, bootstrap, GAMMA, LAM)
+    np.testing.assert_allclose(np.asarray(adv), np.asarray(adv_g), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(ret), np.asarray(ret_g), rtol=1e-5, atol=1e-5)
+
+
+def test_vtrace_matches_scan(mesh, traj):
+    rewards, values, dones, bootstrap = traj
+    rng = np.random.default_rng(1)
+    target_lp = jnp.asarray(rng.normal(size=(T, E)) * 0.3, jnp.float32)
+    behav_lp = jnp.asarray(rng.normal(size=(T, E)) * 0.3, jnp.float32)
+
+    golden = returns.vtrace(
+        target_lp, behav_lp, rewards, values, dones, bootstrap,
+        GAMMA, rho_bar=1.0, c_bar=1.0, lam=0.9,
+    )
+    fn = seqpar.make_seqpar_fn(seqpar.seqpar_vtrace, mesh, n_time_sharded_args=5)
+    got = fn(target_lp, behav_lp, rewards, values, dones, bootstrap, GAMMA, 1.0, 1.0, 0.9)
+
+    for name in ("vs", "pg_advantages", "clipped_rhos"):
+        np.testing.assert_allclose(
+            np.asarray(getattr(got, name)),
+            np.asarray(getattr(golden, name)),
+            rtol=1e-5, atol=1e-5, err_msg=name,
+        )
+
+
+def test_gae_no_dones_boundary(mesh):
+    """All-zero dones: segment products are maximal, stressing the chain."""
+    rewards = jnp.ones((T, 1), jnp.float32)
+    values = jnp.zeros((T, 1), jnp.float32)
+    dones = jnp.zeros((T, 1), jnp.float32)
+    bootstrap = jnp.zeros((1,), jnp.float32)
+    adv_g, _ = returns.gae(rewards, values, dones, bootstrap, GAMMA, LAM)
+    fn = seqpar.make_seqpar_fn(seqpar.seqpar_gae, mesh, n_time_sharded_args=3)
+    adv, _ = fn(rewards, values, dones, bootstrap, GAMMA, LAM)
+    np.testing.assert_allclose(np.asarray(adv), np.asarray(adv_g), rtol=1e-5, atol=1e-5)
+
+
+def test_long_trajectory_many_segments(mesh):
+    """A long (T=4096) trajectory — the long-context case the sharding is
+    for — still matches the golden scan."""
+    Tl = 4096
+    rng = np.random.default_rng(2)
+    rewards = jnp.asarray(rng.normal(size=(Tl,)), jnp.float32)
+    values = jnp.asarray(rng.normal(size=(Tl,)), jnp.float32)
+    dones = jnp.asarray(rng.random(size=(Tl,)) < 0.01, jnp.float32)
+    bootstrap = jnp.asarray(0.3, jnp.float32)
+    adv_g, ret_g = returns.gae(rewards, values, dones, bootstrap, GAMMA, LAM)
+    fn = seqpar.make_seqpar_fn(seqpar.seqpar_gae, mesh, n_time_sharded_args=3)
+    adv, ret = fn(rewards, values, dones, bootstrap, GAMMA, LAM)
+    np.testing.assert_allclose(np.asarray(adv), np.asarray(adv_g), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(ret), np.asarray(ret_g), rtol=1e-4, atol=1e-4)

@@ -88,9 +88,10 @@ def run_fused(env, preset, args, logger) -> dict:
     if ckpt is not None and args.resume and ckpt.latest_step() is not None:
         print(f"resumed from iteration {ckpt.latest_step()}", flush=True)
 
+    from actor_critic_tpu.algos.host_loop import should_log
+
     def log_fn(it, metrics):
-        # log_every<=0 ⇒ only the final iteration.
-        if (args.log_every > 0 and it % args.log_every == 0) or it == args.iterations:
+        if should_log(it, args.log_every, args.iterations):
             logger.log(it, metrics, env_steps=it * spi)
 
     state, metrics = checkpointed_train(
