@@ -45,7 +45,7 @@ from actor_critic_tpu.algos.common import (
 from actor_critic_tpu.algos.metrics import aggregate_metrics
 from actor_critic_tpu.envs.jax_env import JaxEnv
 from actor_critic_tpu.models.networks import ActorCriticDiscrete, ActorCriticGaussian
-from actor_critic_tpu.ops.returns import gae, vtrace
+from actor_critic_tpu.ops.pallas_scan import gae_auto as gae, vtrace_auto as vtrace
 from actor_critic_tpu.parallel import mesh as pmesh
 
 

@@ -104,9 +104,17 @@ class Checkpointer:
                 raise FileNotFoundError("no checkpoint to restore")
         packed = pack_keys(template)
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, packed)
-        restored = self._mgr.restore(
-            step, args=ocp.args.Composite(state=ocp.args.StandardRestore(abstract))
-        )["state"]
+        try:
+            restored = self._mgr.restore(
+                step, args=ocp.args.Composite(state=ocp.args.StandardRestore(abstract))
+            )["state"]
+        except ValueError:
+            # Legacy layout: a bare StandardSave with no named items
+            # (written before metrics rode along). Orbax refuses Composite
+            # args on those; retry the unnamed form.
+            restored = self._mgr.restore(
+                step, args=ocp.args.StandardRestore(abstract)
+            )
         return unpack_keys(restored, template)
 
     def restore_metrics(self, step: Optional[int] = None) -> dict:
@@ -121,9 +129,10 @@ class Checkpointer:
                 step, args=ocp.args.Composite(metrics=ocp.args.JsonRestore())
             )["metrics"]
             return dict(out or {})
-        except (FileNotFoundError, KeyError):
-            # Checkpoint predates the metrics item — legitimately absent.
-            # Real IO/corruption errors propagate.
+        except (FileNotFoundError, KeyError, ValueError):
+            # Legitimately absent: checkpoint predates the metrics item
+            # (legacy bare-StandardSave layouts raise ValueError on
+            # Composite args). Other IO/corruption errors propagate.
             return {}
 
     def latest_step(self) -> Optional[int]:

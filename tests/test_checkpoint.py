@@ -114,6 +114,23 @@ def test_retention_and_latest(tmp_path):
     assert 3 in kept and len(kept) <= 2
 
 
+def test_restore_legacy_bare_layout(tmp_path):
+    """Checkpoints written by the pre-metrics bare-StandardSave layout
+    must still restore (and restore_metrics must return {})."""
+    import orbax.checkpoint as ocp
+
+    from actor_critic_tpu.utils.checkpoint import Checkpointer, pack_keys
+
+    _, _, state, _ = _setup()
+    with ocp.CheckpointManager(tmp_path / "ck") as mgr:
+        mgr.save(2, args=ocp.args.StandardSave(pack_keys(state)), force=True)
+        mgr.wait_until_finished()
+    with Checkpointer(tmp_path / "ck") as ck:
+        restored = ck.restore(state)
+        assert ck.restore_metrics(2) == {}
+    _assert_states_equal(state, restored)
+
+
 def test_restore_missing_raises(tmp_path):
     _, _, state0, _ = _setup()
     with Checkpointer(tmp_path / "none") as ck:

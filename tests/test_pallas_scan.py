@@ -1,0 +1,90 @@
+"""Pallas scan kernels vs. the lax.scan golden implementations
+(interpret mode on the CPU test backend; compiled path exercised on TPU
+by bench/ and the fused trainers)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from actor_critic_tpu.ops import pallas_scan, returns
+
+GAMMA, LAM = 0.99, 0.95
+
+
+@pytest.fixture(scope="module")
+def traj():
+    rng = np.random.default_rng(0)
+    T, E = 17, 512  # odd T; E hits one full block
+    rewards = jnp.asarray(rng.normal(size=(T, E)), jnp.float32)
+    values = jnp.asarray(rng.normal(size=(T, E)), jnp.float32)
+    dones = jnp.asarray(rng.random(size=(T, E)) < 0.1, jnp.float32)
+    bootstrap = jnp.asarray(rng.normal(size=(E,)), jnp.float32)
+    return rewards, values, dones, bootstrap
+
+
+def test_gae_matches_golden(traj):
+    rewards, values, dones, bootstrap = traj
+    adv_g, ret_g = returns.gae(rewards, values, dones, bootstrap, GAMMA, LAM)
+    adv, ret = pallas_scan.gae(rewards, values, dones, bootstrap, GAMMA, LAM)
+    np.testing.assert_allclose(np.asarray(adv), np.asarray(adv_g), rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(ret), np.asarray(ret_g), rtol=1e-6, atol=1e-6)
+
+
+def test_gae_multi_block(traj):
+    """E larger than one block → grid > 1, blocks must not interact."""
+    rewards, values, dones, bootstrap = traj
+    r2 = jnp.concatenate([rewards, rewards * 2.0], axis=1)
+    v2 = jnp.concatenate([values, values * -1.0], axis=1)
+    d2 = jnp.concatenate([dones, dones], axis=1)
+    b2 = jnp.concatenate([bootstrap, bootstrap], axis=0)
+    adv_g, _ = returns.gae(r2, v2, d2, b2, GAMMA, LAM)
+    adv, _ = pallas_scan.gae(r2, v2, d2, b2, GAMMA, LAM)
+    np.testing.assert_allclose(np.asarray(adv), np.asarray(adv_g), rtol=1e-6, atol=1e-6)
+
+
+def test_gae_small_batch_fallback_block(traj):
+    """E not divisible by the default block → smaller power-of-two block."""
+    rewards, values, dones = (a[:, :96] for a in traj[:3])
+    bootstrap = traj[3][:96]
+    adv_g, _ = returns.gae(rewards, values, dones, bootstrap, GAMMA, LAM)
+    adv, _ = pallas_scan.gae(rewards, values, dones, bootstrap, GAMMA, LAM)
+    np.testing.assert_allclose(np.asarray(adv), np.asarray(adv_g), rtol=1e-6, atol=1e-6)
+
+
+def test_gae_non_2d_falls_back(traj):
+    rewards, values, dones, bootstrap = traj
+    adv, _ = pallas_scan.gae(rewards[:, 0], values[:, 0], dones[:, 0],
+                             bootstrap[0], GAMMA, LAM)
+    adv_g, _ = returns.gae(rewards[:, 0], values[:, 0], dones[:, 0],
+                           bootstrap[0], GAMMA, LAM)
+    np.testing.assert_allclose(np.asarray(adv), np.asarray(adv_g), rtol=1e-6, atol=1e-6)
+
+
+def test_gae_long_T_shrinks_block_or_falls_back(traj):
+    """T large enough to force a narrow block (or the lax.scan fallback)
+    still produces golden results."""
+    rng = np.random.default_rng(3)
+    T, E = 4096, 128
+    rewards = jnp.asarray(rng.normal(size=(T, E)), jnp.float32)
+    values = jnp.asarray(rng.normal(size=(T, E)), jnp.float32)
+    dones = jnp.asarray(rng.random(size=(T, E)) < 0.02, jnp.float32)
+    bootstrap = jnp.asarray(rng.normal(size=(E,)), jnp.float32)
+    adv_g, _ = returns.gae(rewards, values, dones, bootstrap, GAMMA, LAM)
+    adv, _ = pallas_scan.gae(rewards, values, dones, bootstrap, GAMMA, LAM)
+    np.testing.assert_allclose(np.asarray(adv), np.asarray(adv_g), rtol=1e-5, atol=1e-5)
+
+
+def test_vtrace_matches_golden(traj):
+    rewards, values, dones, bootstrap = traj
+    rng = np.random.default_rng(1)
+    tlp = jnp.asarray(rng.normal(size=rewards.shape) * 0.3, jnp.float32)
+    blp = jnp.asarray(rng.normal(size=rewards.shape) * 0.3, jnp.float32)
+    golden = returns.vtrace(tlp, blp, rewards, values, dones, bootstrap,
+                            GAMMA, rho_bar=1.0, c_bar=1.0, lam=0.9)
+    got = pallas_scan.vtrace(tlp, blp, rewards, values, dones, bootstrap,
+                             GAMMA, rho_bar=1.0, c_bar=1.0, lam=0.9)
+    for name in ("vs", "pg_advantages", "clipped_rhos"):
+        np.testing.assert_allclose(
+            np.asarray(getattr(got, name)), np.asarray(getattr(golden, name)),
+            rtol=1e-5, atol=1e-6, err_msg=name,
+        )
