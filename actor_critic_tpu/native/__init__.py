@@ -1,0 +1,71 @@
+"""Build + ctypes bindings for the native batched env engine (vecenv.cpp).
+
+The shared library is compiled on first import with the system g++
+(`-O3 -march=native`, autovectorized; no pybind11 in this image, so the
+boundary is a plain C ABI over NumPy buffers — SURVEY.md §2.2) and cached
+next to the source; it is rebuilt whenever vecenv.cpp is newer than the
+cached .so. If no compiler is available, `load()` raises ImportError and
+callers (envs/native_pool.py) surface a clear message — the gymnasium
+backend remains the fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from functools import lru_cache
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "vecenv.cpp")
+_LIB = os.path.join(_DIR, "_vecenv.so")
+
+_u8p = ctypes.POINTER(ctypes.c_uint8)
+_i32p = ctypes.POINTER(ctypes.c_int32)
+_i64p = ctypes.POINTER(ctypes.c_int64)
+_u64p = ctypes.POINTER(ctypes.c_uint64)
+_f32p = ctypes.POINTER(ctypes.c_float)
+_f64p = ctypes.POINTER(ctypes.c_double)
+
+
+def _build() -> None:
+    cmd = [
+        "g++", "-O3", "-march=native", "-shared", "-fPIC",
+        _SRC, "-o", _LIB,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    except FileNotFoundError as e:
+        raise ImportError(f"native vecenv needs g++ to build: {e}") from e
+    except subprocess.CalledProcessError as e:
+        raise ImportError(f"native vecenv build failed:\n{e.stderr}") from e
+
+
+@lru_cache(maxsize=1)
+def load() -> ctypes.CDLL:
+    """The compiled engine, building (or rebuilding on source change)
+    first if needed."""
+    if (
+        not os.path.exists(_LIB)
+        or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
+    ):
+        _build()
+    lib = ctypes.CDLL(_LIB)
+
+    lib.cartpole_reset.argtypes = [_f64p, _f32p, ctypes.c_int, _u64p, _i32p]
+    lib.cartpole_step.argtypes = [
+        _f64p, _i64p, ctypes.c_int, _u64p, _i32p, ctypes.c_int32,
+        _f32p, _f32p, _u8p, _u8p, _f32p,
+    ]
+    lib.pendulum_reset.argtypes = [_f64p, _f32p, ctypes.c_int, _u64p, _i32p]
+    lib.pendulum_step.argtypes = [
+        _f64p, _f32p, ctypes.c_int, _u64p, _i32p, ctypes.c_int32,
+        _f32p, _f32p, _u8p, _u8p, _f32p,
+    ]
+    lib.set_state.argtypes = [_f64p, _f64p, ctypes.c_int, ctypes.c_int]
+    for fn in (
+        lib.cartpole_reset, lib.cartpole_step,
+        lib.pendulum_reset, lib.pendulum_step, lib.set_state,
+    ):
+        fn.restype = None
+    return lib

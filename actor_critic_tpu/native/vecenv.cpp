@@ -1,0 +1,197 @@
+// Native batched environment engine (first-party C++ runtime component).
+//
+// The reference's env stepping bottoms out in native dependency code —
+// MuJoCo's C physics and ALE's C++ emulator under gym (SURVEY.md §2.2;
+// reference mount empty at survey, §0). This is the build's first-party
+// equivalent for the classic-control family: the WHOLE env batch steps
+// in one C call (dynamics, reward, termination, SAME_STEP auto-reset),
+// removing the Python per-env loop from the host hot path that matters
+// on this 1-core host (SURVEY.md §7.2 item 2).
+//
+// Dynamics are exact gymnasium semantics (CartPole-v1 Euler integration
+// and 12deg/2.4m termination with 500-step time limit; Pendulum-v1
+// clipped-torque dynamics with 200-step limit) so trainers can swap
+// backends without re-tuning. Layout: row-major; state is float64
+// (gymnasium's precision) and observations float32.
+//
+// Built standalone:  g++ -O3 -shared -fPIC vecenv.cpp -o _vecenv.so
+// (the Python side builds+caches automatically; see native/__init__.py)
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+// splitmix64 — tiny, seedable, good enough for env-reset jitter.
+inline uint64_t next_u64(uint64_t* s) {
+  uint64_t z = (*s += 0x9E3779B97F4A7C15ull);
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ull;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
+  return z ^ (z >> 31);
+}
+
+inline float uniform(uint64_t* s, float lo, float hi) {
+  // 24-bit mantissa uniform in [0,1)
+  float u = (float)(next_u64(s) >> 40) * (1.0f / 16777216.0f);
+  return lo + u * (hi - lo);
+}
+
+constexpr float kPi = 3.14159265358979323846f;
+
+// ---- CartPole-v1 ---------------------------------------------------------
+constexpr double kGravity = 9.8;
+constexpr double kMassCart = 1.0;
+constexpr double kMassPole = 0.1;
+constexpr double kTotalMass = kMassCart + kMassPole;
+constexpr double kLength = 0.5;  // half pole length
+constexpr double kPoleMassLength = kMassPole * kLength;
+constexpr double kForceMag = 10.0;
+constexpr double kTau = 0.02;
+constexpr double kThetaThreshold = 12.0 * 2.0 * 3.14159265358979323846 / 360.0;
+constexpr double kXThreshold = 2.4;
+
+inline void cartpole_reset_one(double* st, uint64_t* rng) {
+  for (int k = 0; k < 4; ++k) st[k] = uniform(rng, -0.05f, 0.05f);
+}
+
+inline void obs_from_state(const double* st, float* obs, int d) {
+  for (int k = 0; k < d; ++k) obs[k] = (float)st[k];
+}
+
+// ---- Pendulum-v1 ---------------------------------------------------------
+constexpr double kPendG = 10.0;
+constexpr double kPendM = 1.0;
+constexpr double kPendL = 1.0;
+constexpr double kPendDt = 0.05;
+constexpr double kMaxSpeed = 8.0;
+constexpr double kMaxTorque = 2.0;
+
+inline double angle_normalize(double x) {
+  const double pi = 3.14159265358979323846;
+  double y = std::fmod(x + pi, 2.0 * pi);
+  if (y < 0) y += 2.0 * pi;
+  return y - pi;
+}
+
+inline void pendulum_reset_one(double* st, uint64_t* rng) {
+  st[0] = uniform(rng, -kPi, kPi);   // theta
+  st[1] = uniform(rng, -1.0f, 1.0f); // theta_dot
+}
+
+inline void pendulum_obs(const double* st, float* obs) {
+  obs[0] = (float)std::cos(st[0]);
+  obs[1] = (float)std::sin(st[0]);
+  obs[2] = (float)st[1];
+}
+
+}  // namespace
+
+extern "C" {
+
+// state: [n,4] float64 (gymnasium precision); obs out: [n,4] float32
+void cartpole_reset(double* state, float* obs, int n, uint64_t* rng,
+                    int32_t* steps) {
+  for (int i = 0; i < n; ++i) {
+    cartpole_reset_one(state + 4 * i, rng);
+    obs_from_state(state + 4 * i, obs + 4 * i, 4);
+    steps[i] = 0;
+  }
+}
+
+// One synchronous batch step with SAME_STEP auto-reset: where an episode
+// ends, final_obs keeps the ending observation and obs/state hold the
+// freshly reset episode (mirrors gymnasium.vector SAME_STEP semantics,
+// which envs/host_pool.py already normalizes trainers against).
+void cartpole_step(double* state, const int64_t* action, int n, uint64_t* rng,
+                   int32_t* steps, int32_t max_steps, float* obs,
+                   float* reward, uint8_t* terminated, uint8_t* truncated,
+                   float* final_obs) {
+  for (int i = 0; i < n; ++i) {
+    double* st = state + 4 * i;
+    const double force = action[i] == 1 ? kForceMag : -kForceMag;
+    const double x = st[0], x_dot = st[1], th = st[2], th_dot = st[3];
+    const double costh = std::cos(th);
+    const double sinth = std::sin(th);
+    const double temp =
+        (force + kPoleMassLength * th_dot * th_dot * sinth) / kTotalMass;
+    const double thetaacc =
+        (kGravity * sinth - costh * temp) /
+        (kLength * (4.0 / 3.0 - kMassPole * costh * costh / kTotalMass));
+    const double xacc = temp - kPoleMassLength * thetaacc * costh / kTotalMass;
+    // Euler, gymnasium order (positions first with OLD velocities),
+    // double math to track gymnasium's float64 trajectories.
+    st[0] = x + kTau * x_dot;
+    st[1] = x_dot + kTau * xacc;
+    st[2] = th + kTau * th_dot;
+    st[3] = th_dot + kTau * thetaacc;
+    steps[i] += 1;
+
+    const bool term = st[0] < -kXThreshold || st[0] > kXThreshold ||
+                      st[2] < -kThetaThreshold || st[2] > kThetaThreshold;
+    const bool trunc = !term && steps[i] >= max_steps;
+    reward[i] = 1.0f;
+    terminated[i] = term;
+    truncated[i] = trunc;
+    obs_from_state(st, final_obs + 4 * i, 4);
+    if (term || trunc) {
+      cartpole_reset_one(st, rng);
+      steps[i] = 0;
+    }
+    obs_from_state(st, obs + 4 * i, 4);
+  }
+}
+
+// state: [n,2] float64; obs out: [n,3] float32 (cos, sin, thetadot)
+void pendulum_reset(double* state, float* obs, int n, uint64_t* rng,
+                    int32_t* steps) {
+  for (int i = 0; i < n; ++i) {
+    pendulum_reset_one(state + 2 * i, rng);
+    pendulum_obs(state + 2 * i, obs + 3 * i);
+    steps[i] = 0;
+  }
+}
+
+void pendulum_step(double* state, const float* action, int n, uint64_t* rng,
+                   int32_t* steps, int32_t max_steps, float* obs,
+                   float* reward, uint8_t* terminated, uint8_t* truncated,
+                   float* final_obs) {
+  for (int i = 0; i < n; ++i) {
+    double* st = state + 2 * i;
+    double u = action[i];
+    if (u > kMaxTorque) u = kMaxTorque;
+    if (u < -kMaxTorque) u = -kMaxTorque;
+    const double th = st[0];
+    const double thdot = st[1];
+    const double an = angle_normalize(th);
+    const double cost = an * an + 0.1 * thdot * thdot + 0.001 * u * u;
+
+    double newthdot =
+        thdot + (3.0 * kPendG / (2.0 * kPendL) * std::sin(th) +
+                 3.0 / (kPendM * kPendL * kPendL) * u) *
+                    kPendDt;
+    if (newthdot > kMaxSpeed) newthdot = kMaxSpeed;
+    if (newthdot < -kMaxSpeed) newthdot = -kMaxSpeed;
+    st[0] = th + newthdot * kPendDt;
+    st[1] = newthdot;
+    steps[i] += 1;
+
+    const bool trunc = steps[i] >= max_steps;
+    reward[i] = -cost;
+    terminated[i] = 0;
+    truncated[i] = trunc;
+    pendulum_obs(st, final_obs + 3 * i);
+    if (trunc) {
+      pendulum_reset_one(st, rng);
+      steps[i] = 0;
+    }
+    pendulum_obs(st, obs + 3 * i);
+  }
+}
+
+// Test hook: deterministic state injection (bypasses RNG).
+void set_state(double* state, const double* values, int n, int dim) {
+  std::memcpy(state, values, (size_t)n * dim * sizeof(double));
+}
+
+}  // extern "C"

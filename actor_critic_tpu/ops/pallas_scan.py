@@ -166,10 +166,11 @@ def _vtrace_kernel(
     def body(i, carry):
         acc, v_next, vs_next = carry
         t = T - 1 - i
-        rho = jnp.minimum(
-            rho_bar, jnp.exp(tlp_ref[pl.ds(t, 1), :] - blp_ref[pl.ds(t, 1), :])
-        )
-        c = lam * jnp.minimum(c_bar, rho)
+        raw_rho = jnp.exp(tlp_ref[pl.ds(t, 1), :] - blp_ref[pl.ds(t, 1), :])
+        rho = jnp.minimum(rho_bar, raw_rho)
+        # c clips the RAW ratio (independent of rho_bar) — matters when
+        # c_bar > rho_bar (golden: ops/returns.vtrace).
+        c = lam * jnp.minimum(c_bar, raw_rho)
         r = r_ref[pl.ds(t, 1), :]
         v = v_ref[pl.ds(t, 1), :]
         disc = gamma * (1.0 - d_ref[pl.ds(t, 1), :])

@@ -129,10 +129,16 @@ class Checkpointer:
                 step, args=ocp.args.Composite(metrics=ocp.args.JsonRestore())
             )["metrics"]
             return dict(out or {})
-        except (FileNotFoundError, KeyError, ValueError):
+        except (FileNotFoundError, KeyError, ValueError) as e:
+            import json
+
+            if isinstance(e, json.JSONDecodeError):
+                # A truncated/corrupt metrics item is NOT "no metrics" —
+                # surface it.
+                raise
             # Legitimately absent: checkpoint predates the metrics item
             # (legacy bare-StandardSave layouts raise ValueError on
-            # Composite args). Other IO/corruption errors propagate.
+            # Composite args).
             return {}
 
     def latest_step(self) -> Optional[int]:

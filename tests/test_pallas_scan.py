@@ -60,6 +60,24 @@ def test_gae_non_2d_falls_back(traj):
     np.testing.assert_allclose(np.asarray(adv), np.asarray(adv_g), rtol=1e-6, atol=1e-6)
 
 
+def test_vtrace_cbar_above_rhobar(traj):
+    """c clips the RAW ratio: c_bar > rho_bar must still match golden
+    (regression: kernel once derived c from the rho_bar-clipped rho)."""
+    rewards, values, dones, bootstrap = traj
+    rng = np.random.default_rng(5)
+    tlp = jnp.asarray(rng.normal(size=rewards.shape), jnp.float32)
+    blp = jnp.asarray(rng.normal(size=rewards.shape), jnp.float32)
+    golden = returns.vtrace(tlp, blp, rewards, values, dones, bootstrap,
+                            GAMMA, rho_bar=1.0, c_bar=2.0, lam=0.9)
+    got = pallas_scan.vtrace(tlp, blp, rewards, values, dones, bootstrap,
+                             GAMMA, rho_bar=1.0, c_bar=2.0, lam=0.9)
+    for name in ("vs", "pg_advantages", "clipped_rhos"):
+        np.testing.assert_allclose(
+            np.asarray(getattr(got, name)), np.asarray(getattr(golden, name)),
+            rtol=1e-5, atol=1e-5, err_msg=name,
+        )
+
+
 def test_gae_long_T_shrinks_block_or_falls_back(traj):
     """T large enough to force a narrow block (or the lax.scan fallback)
     still produces golden results."""

@@ -41,10 +41,12 @@ def build_env(spec: str, algo: str, cfg, seed: int):
         if name not in makers:
             raise SystemExit(f"unknown jax env {name!r}; valid: {sorted(makers)}")
         return makers[name](), True
-    if kind == "host":
+    if kind in ("host", "native"):
         from actor_critic_tpu.envs.host_pool import HostEnvPool
 
         # Off-policy TD targets want raw reward scale (ddpg/sac docstrings).
+        # 'native:<id>' steps the batch in the C++ engine (one C call per
+        # step) instead of the Python SyncVectorEnv loop.
         return (
             HostEnvPool(
                 name,
@@ -52,10 +54,13 @@ def build_env(spec: str, algo: str, cfg, seed: int):
                 seed=seed,
                 normalize_obs=True,
                 normalize_reward=(algo == "ppo"),
+                backend="gym" if kind == "host" else "native",
             ),
             False,
         )
-    raise SystemExit(f"env must be jax:<name> or host:<gym id>, got {spec!r}")
+    raise SystemExit(
+        f"env must be jax:<name>, host:<gym id>, or native:<id>, got {spec!r}"
+    )
 
 
 def fused_module(algo: str):
