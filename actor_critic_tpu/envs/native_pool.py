@@ -113,11 +113,12 @@ class NativeVecEnv:
         trunc = self._trunc.astype(bool)
         info = {}
         if (term | trunc).any():
-            # The engine fills final_obs for EVERY env (== obs where the
-            # episode continued), so pass the whole array — no per-env
-            # Python loop on the hot path. host_pool consumes the array
-            # form directly; `final_obs_list` below adapts to gymnasium's
-            # list-of-Optional convention for any other consumer.
+            # DELIBERATE deviation from gymnasium's list-of-Optional
+            # convention: the engine fills final_obs for EVERY env (== obs
+            # where the episode continued), so the whole dense [E, obs]
+            # array is passed — no per-env Python loop on the hot path.
+            # Consumers must use `terminated|truncated` (NOT row presence)
+            # to know which episodes ended; HostEnvPool does exactly that.
             info["final_obs"] = self._final_obs.copy()
         return (
             self._obs.copy(), self._reward.copy(), term.copy(), trunc.copy(), info,

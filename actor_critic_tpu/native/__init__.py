@@ -29,16 +29,23 @@ _f64p = ctypes.POINTER(ctypes.c_double)
 
 
 def _build() -> None:
+    # Compile to a per-process temp path, then atomically rename: a
+    # concurrent process must never dlopen a half-written .so.
+    tmp = f"{_LIB}.{os.getpid()}.tmp"
     cmd = [
         "g++", "-O3", "-march=native", "-shared", "-fPIC",
-        _SRC, "-o", _LIB,
+        _SRC, "-o", tmp,
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, _LIB)
     except FileNotFoundError as e:
         raise ImportError(f"native vecenv needs g++ to build: {e}") from e
     except subprocess.CalledProcessError as e:
         raise ImportError(f"native vecenv build failed:\n{e.stderr}") from e
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
 
 
 @lru_cache(maxsize=1)

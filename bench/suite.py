@@ -1,0 +1,182 @@
+"""Per-config benchmark suite (BASELINE.json:7-11; BASELINE.md).
+
+Each bench prints one JSON line {"metric", "value", "unit", ...}. The
+headline A2C number is also what repo-root bench.py reports for the
+driver. Usage:
+
+    python bench/suite.py            # all throughput benches
+    python bench/suite.py a2c impala # subset
+
+Throughput benches fuse many train iterations per dispatch (lax.scan) so
+the host<->device tunnel latency is amortized; host-env benches measure
+the real host-stepping path (the wall-clock-limiting one on this 1-core
+host, SURVEY.md §7.0).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _fused_steps_per_sec(mod, env, cfg, steps_per_iter, iters_per_call=20, calls=5):
+    state = mod.init_state(env, cfg, jax.random.key(0))
+    train_step = mod.make_train_step(env, cfg)
+
+    def block(s):
+        def body(c, _):
+            c, _m = train_step(c)
+            return c, None
+
+        s, _ = jax.lax.scan(body, s, None, length=iters_per_call)
+        return s
+
+    run = jax.jit(block, donate_argnums=0)
+    state = run(state)
+    jax.block_until_ready(state)
+    t0 = time.perf_counter()
+    for _ in range(calls):
+        state = run(state)
+    jax.block_until_ready(state)
+    dt = time.perf_counter() - t0
+    return calls * iters_per_call * steps_per_iter / dt
+
+
+def bench_a2c():
+    from actor_critic_tpu.algos import a2c
+    from actor_critic_tpu.envs import make_cartpole
+
+    cfg = a2c.A2CConfig(num_envs=4096, rollout_steps=32)
+    sps = _fused_steps_per_sec(
+        a2c, make_cartpole(), cfg, cfg.num_envs * cfg.rollout_steps,
+        iters_per_call=50,
+    )
+    return {
+        "metric": "a2c_cartpole_fused_throughput",
+        "value": round(sps, 1),
+        "unit": "env-steps/sec/chip",
+        "vs_baseline": round(sps / 1_000_000, 4),
+    }
+
+
+def bench_ppo():
+    from actor_critic_tpu.algos import ppo
+    from actor_critic_tpu.envs import make_cartpole
+
+    cfg = ppo.PPOConfig(num_envs=2048, rollout_steps=32)
+    sps = _fused_steps_per_sec(
+        ppo, make_cartpole(), cfg, cfg.num_envs * cfg.rollout_steps,
+        iters_per_call=10,
+    )
+    return {
+        "metric": "ppo_cartpole_fused_throughput",
+        "value": round(sps, 1),
+        "unit": "env-steps/sec/chip",
+    }
+
+
+def bench_impala():
+    from actor_critic_tpu.algos import impala
+    from actor_critic_tpu.envs import make_pong
+
+    cfg = impala.ImpalaConfig(num_envs=64, rollout_steps=32)
+    sps = _fused_steps_per_sec(
+        impala, make_pong(), cfg, cfg.num_envs * cfg.rollout_steps,
+        iters_per_call=10, calls=3,
+    )
+    return {
+        "metric": "impala_jaxpong_fused_throughput",
+        "value": round(sps, 1),
+        "unit": "env-steps/sec/chip",
+    }
+
+
+def bench_sac_updates():
+    """Off-policy update throughput: HBM replay sample + twin-Q/actor/alpha
+    update, batch 256 (the device-side hot path of BASELINE.json:10)."""
+    from actor_critic_tpu.algos import sac
+    from actor_critic_tpu.envs import make_point_mass
+
+    env = make_point_mass()
+    cfg = sac.SACConfig(num_envs=32, steps_per_iter=4, batch_size=256)
+    sps = _fused_steps_per_sec(
+        sac, env, cfg, cfg.num_envs * cfg.steps_per_iter, iters_per_call=20
+    )
+    # steps/sec of the fused collect+update iteration; updates/sec is the
+    # same rate divided by steps-per-iter.
+    return {
+        "metric": "sac_fused_env_steps",
+        "value": round(sps, 1),
+        "unit": "env-steps/sec/chip",
+        "updates_per_sec": round(sps / (cfg.num_envs * cfg.steps_per_iter), 1),
+    }
+
+
+def bench_ddpg_updates():
+    from actor_critic_tpu.algos import ddpg
+    from actor_critic_tpu.envs import make_point_mass
+
+    env = make_point_mass()
+    cfg = ddpg.DDPGConfig(num_envs=32, steps_per_iter=4, batch_size=256)
+    sps = _fused_steps_per_sec(
+        ddpg, env, cfg, cfg.num_envs * cfg.steps_per_iter, iters_per_call=20
+    )
+    return {
+        "metric": "ddpg_fused_env_steps",
+        "value": round(sps, 1),
+        "unit": "env-steps/sec/chip",
+        "updates_per_sec": round(sps / (cfg.num_envs * cfg.steps_per_iter), 1),
+    }
+
+
+def bench_host_native():
+    from actor_critic_tpu.envs.host_pool import HostEnvPool
+
+    E, T = 256, 300
+    out = {}
+    for backend in ("native", "gym"):
+        pool = HostEnvPool("CartPole-v1", E, backend=backend,
+                           normalize_obs=False, normalize_reward=False)
+        pool.reset()
+        acts = np.zeros(E, np.int64)
+        pool.step(acts)
+        t0 = time.perf_counter()
+        for _ in range(T):
+            pool.step(acts)
+        out[backend] = E * T / (time.perf_counter() - t0)
+    return {
+        "metric": "host_env_stepping",
+        "value": round(out["native"], 1),
+        "unit": "env-steps/sec (native C++)",
+        "gym_baseline": round(out["gym"], 1),
+        "speedup": round(out["native"] / out["gym"], 1),
+    }
+
+
+BENCHES = {
+    "a2c": bench_a2c,
+    "ppo": bench_ppo,
+    "impala": bench_impala,
+    "sac": bench_sac_updates,
+    "ddpg": bench_ddpg_updates,
+    "host": bench_host_native,
+}
+
+
+def main(argv: list[str]) -> None:
+    names = argv or list(BENCHES)
+    for n in names:
+        res = BENCHES[n]()
+        print(json.dumps(res), flush=True)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,43 @@
+"""Profiler harness tests: trace produces artifacts, time_fn fences
+correctly, nan_guard fires exactly on non-finite input."""
+
+import logging
+import os
+
+import jax
+import jax.numpy as jnp
+
+from actor_critic_tpu.utils import profiling
+
+
+def test_time_fn_returns_positive_time():
+    f = jax.jit(lambda x: x @ x)
+    x = jnp.ones((128, 128))
+    dt = profiling.time_fn(f, x, iters=3, warmup=1)
+    assert dt > 0
+
+
+def test_trace_writes_artifacts(tmp_path):
+    logdir = str(tmp_path / "prof")
+    f = jax.jit(lambda x: (x * 2).sum())
+    with profiling.trace(logdir):
+        jax.block_until_ready(f(jnp.ones((64, 64))))
+    files = [os.path.join(r, f_) for r, _, fs in os.walk(logdir) for f_ in fs]
+    assert files, "profiler trace produced no files"
+
+
+def test_nan_guard_warns_only_on_nonfinite(caplog):
+    @jax.jit
+    def step(x):
+        profiling.nan_guard({"loss": x}, name="test-metrics")
+        return x + 1
+
+    with caplog.at_level(logging.WARNING):
+        jax.block_until_ready(step(jnp.ones(4)))
+        jax.effects_barrier()
+    assert "non-finite" not in caplog.text
+
+    with caplog.at_level(logging.WARNING):
+        jax.block_until_ready(step(jnp.array([1.0, jnp.nan, 3.0, 4.0])))
+        jax.effects_barrier()
+    assert "non-finite" in caplog.text
