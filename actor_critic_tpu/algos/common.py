@@ -203,6 +203,23 @@ def evaluate(
     return jnp.mean(returns)
 
 
+def make_greedy_eval(
+    env: JaxEnv,
+    act: Callable[[Any, jax.Array], jax.Array],
+    params_of: Callable[[Any], Any],
+):
+    """THE eval-program factory shared by every algo's `make_eval_fn`:
+    `act(params, obs) → action` is the algo's greedy policy, `params_of`
+    extracts the acting params from its train state. Returns
+    `eval_fn(state, key, num_envs=32, num_steps=512)` (jit with
+    static_argnums=(2, 3))."""
+
+    def eval_fn(state, key, num_envs: int = 32, num_steps: int = 512):
+        return evaluate(env, act, params_of(state), key, num_envs, num_steps)
+
+    return eval_fn
+
+
 def episode_metrics_update(
     ep_return: jax.Array,
     ep_length: jax.Array,

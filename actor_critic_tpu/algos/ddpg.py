@@ -168,6 +168,17 @@ def init_state(env: JaxEnv, cfg: DDPGConfig, key: jax.Array) -> OffPolicyState:
     )
 
 
+def make_eval_fn(env: JaxEnv, cfg: "DDPGConfig"):
+    """Greedy (noiseless actor) eval program (SURVEY.md §3.4); see
+    common.make_greedy_eval for the shared contract."""
+    from actor_critic_tpu.algos.common import make_greedy_eval
+
+    actor, _ = _modules(env.spec.action_dim, cfg)
+    return make_greedy_eval(
+        env, lambda p, o: actor.apply(p, o), lambda s: s.learner.actor_params
+    )
+
+
 def make_explore_fn(action_dim: int, cfg: DDPGConfig):
     """Behavior policy: actor + clipped Gaussian noise; uniform actions
     during warmup (branchless `where` on the env-step counter)."""

@@ -102,6 +102,20 @@ def make_network(env: JaxEnv, cfg: ImpalaConfig):
     )
 
 
+def make_eval_fn(env: JaxEnv, cfg: "ImpalaConfig"):
+    """Greedy (mode-action) eval program (SURVEY.md §3.4); see
+    common.make_greedy_eval for the shared contract."""
+    from actor_critic_tpu.algos.common import make_greedy_eval
+
+    net = make_network(env, cfg)
+
+    def act(params, obs):
+        dist, _ = net.apply(params, obs)
+        return dist.mode()
+
+    return make_greedy_eval(env, act, lambda s: s.params)
+
+
 def make_optimizer(cfg: ImpalaConfig) -> optax.GradientTransformation:
     return optax.chain(
         optax.clip_by_global_norm(cfg.max_grad_norm),

@@ -81,6 +81,20 @@ def make_network(env_spec, cfg: PPOConfig):
     )
 
 
+def make_eval_fn(env: JaxEnv, cfg: "PPOConfig"):
+    """Greedy (mode-action) eval program (SURVEY.md §3.4); see
+    common.make_greedy_eval for the shared contract."""
+    from actor_critic_tpu.algos.common import make_greedy_eval
+
+    net = make_network(env.spec, cfg)
+
+    def act(params, obs):
+        dist, _ = net.apply(params, obs)
+        return dist.mode()
+
+    return make_greedy_eval(env, act, lambda s: s.params)
+
+
 def make_optimizer(cfg: PPOConfig) -> optax.GradientTransformation:
     return optax.chain(
         optax.clip_by_global_norm(cfg.max_grad_norm),

@@ -164,6 +164,18 @@ def init_state(env: JaxEnv, cfg: SACConfig, key: jax.Array) -> SACState:
     )
 
 
+def make_eval_fn(env: JaxEnv, cfg: "SACConfig"):
+    """Greedy (tanh-mean) eval program (SURVEY.md §3.4); see
+    common.make_greedy_eval for the shared contract."""
+    from actor_critic_tpu.algos.common import make_greedy_eval
+
+    actor, _ = _modules(env.spec.action_dim, cfg)
+    return make_greedy_eval(
+        env, lambda p, o: actor.apply(p, o).mode(),
+        lambda s: s.learner.actor_params,
+    )
+
+
 def make_explore_fn(action_dim: int, cfg: SACConfig):
     """Behavior policy: sample the tanh-Gaussian; uniform during warmup."""
     actor, _ = _modules(action_dim, cfg)

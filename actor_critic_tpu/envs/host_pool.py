@@ -89,9 +89,12 @@ class HostEnvPool:
         clip_reward: float = 10.0,
         gamma: float = 0.99,
         backend: str = "gym",
+        pixel_preprocess: bool = False,
     ):
         self.env_id = env_id
         self.num_envs = num_envs
+        if pixel_preprocess and backend != "gym":
+            raise ValueError("pixel_preprocess applies to the gym backend only")
         if backend == "native":
             # First-party C++ batched engine: one C call per batch step
             # (envs/native_pool.py; native/vecenv.cpp).
@@ -102,8 +105,16 @@ class HostEnvPool:
             import gymnasium as gym
             from gymnasium.vector import AutoresetMode, SyncVectorEnv
 
+            def make_one():
+                e = gym.make(env_id)
+                if pixel_preprocess:
+                    from actor_critic_tpu.envs.pixel_wrappers import PixelPreprocess
+
+                    e = PixelPreprocess(e)
+                return e
+
             self._envs = SyncVectorEnv(
-                [lambda: gym.make(env_id) for _ in range(num_envs)],
+                [make_one for _ in range(num_envs)],
                 autoreset_mode=AutoresetMode.SAME_STEP,
             )
         else:

@@ -1,0 +1,105 @@
+"""Host-side pixel preprocessing (the reference's Atari wrapper stack).
+
+The reference genre preprocesses pixels with grayscale → 84x84 resize →
+k-frame stack → reward clipping before the Nature CNN (SURVEY.md §2.1
+"Env wrappers"; reference mount empty at survey, §0). ALE itself is not
+installed in this image (SURVEY.md §7.0) — the IMPALA config uses the
+pure-JAX Pong (envs/pong.py) whose observations are already in this
+format — but the wrapper is provided for ANY host pixel env (e.g.
+Box2D's CarRacing) so the CNN trainers run on real gym pixel tasks
+through `HostEnvPool(..., pixel_preprocess=True)`.
+
+Kept on the host on purpose: this is per-step image munging of data that
+arrives from a host emulator anyway; the device-side analogue for
+synthetic envs lives in the env itself (pong.py renders directly at
+84x84 stacked).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+import numpy as np
+
+try:
+    import cv2
+
+    _HAS_CV2 = True
+except Exception:  # pragma: no cover - cv2 is in the image, but stay safe
+    _HAS_CV2 = False
+
+import gymnasium as gym
+
+
+def _to_gray(frame: np.ndarray) -> np.ndarray:
+    if frame.ndim == 2:
+        return frame
+    # ITU-R 601 luma, same coefficients cv2 uses.
+    return (
+        frame[..., 0] * 0.299 + frame[..., 1] * 0.587 + frame[..., 2] * 0.114
+    ).astype(np.uint8)
+
+
+def _resize(frame: np.ndarray, size: int) -> np.ndarray:
+    if frame.shape[:2] == (size, size):
+        return frame
+    if _HAS_CV2:
+        return cv2.resize(frame, (size, size), interpolation=cv2.INTER_AREA)
+    # Nearest-neighbour fallback (no cv2): index-sample the grid.
+    h, w = frame.shape[:2]
+    ys = (np.arange(size) * h // size).clip(0, h - 1)
+    xs = (np.arange(size) * w // size).clip(0, w - 1)
+    return frame[np.ix_(ys, xs)]
+
+
+class PixelPreprocess(gym.Wrapper):
+    """grayscale → size×size resize → `stack` frames on the channel axis
+    (uint8 [size, size, stack]) → optional sign reward clip + action
+    repeat. Matches the observation contract of envs/pong.py so the same
+    CNN encoder consumes either."""
+
+    def __init__(
+        self,
+        env: gym.Env,
+        size: int = 84,
+        stack: int = 4,
+        action_repeat: int = 1,
+        clip_reward: bool = True,
+    ):
+        super().__init__(env)
+        self.size = size
+        self.stack = stack
+        self.action_repeat = max(action_repeat, 1)
+        self.clip_reward = clip_reward
+        self._frames: deque[np.ndarray] = deque(maxlen=stack)
+        self.observation_space = gym.spaces.Box(
+            0, 255, (size, size, stack), np.uint8
+        )
+
+    def _obs(self) -> np.ndarray:
+        return np.stack(self._frames, axis=-1)
+
+    def _push(self, frame: np.ndarray) -> None:
+        self._frames.append(_resize(_to_gray(np.asarray(frame)), self.size))
+
+    def reset(self, **kwargs):
+        obs, info = self.env.reset(**kwargs)
+        self._frames.clear()
+        self._push(obs)
+        while len(self._frames) < self.stack:
+            self._frames.append(self._frames[-1])
+        return self._obs(), info
+
+    def step(self, action):
+        total = 0.0
+        terminated = truncated = False
+        info: dict = {}
+        for _ in range(self.action_repeat):
+            obs, reward, terminated, truncated, info = self.env.step(action)
+            total += float(reward)
+            if terminated or truncated:
+                break
+        self._push(obs)
+        if self.clip_reward:
+            total = float(np.sign(total))
+        return self._obs(), total, terminated, truncated, info

@@ -173,9 +173,18 @@ BENCHES = {
 
 def main(argv: list[str]) -> None:
     names = argv or list(BENCHES)
-    for n in names:
-        res = BENCHES[n]()
-        print(json.dumps(res), flush=True)
+    if len(names) > 1:
+        # One subprocess per bench: sharing a process lets earlier benches'
+        # device allocations depress later ones (measured 60x on the
+        # replay-path benches when run after the E=4096 A2C bench).
+        import subprocess
+
+        for n in names:
+            subprocess.run(
+                [sys.executable, os.path.abspath(__file__), n], check=True
+            )
+        return
+    print(json.dumps(BENCHES[names[0]]()), flush=True)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,45 @@
+"""Greedy-eval programs: every algo's make_eval_fn runs jitted and a
+trained A2C policy evaluates far above a random one (SURVEY.md §3.4)."""
+
+import jax
+import pytest
+
+from actor_critic_tpu.algos import a2c, ddpg, impala, ppo, sac
+from actor_critic_tpu.envs import make_cartpole, make_point_mass, make_two_state_mdp
+
+
+@pytest.mark.parametrize(
+    "mod,cfg,make_env",
+    [
+        (a2c, a2c.A2CConfig(num_envs=8, rollout_steps=4, hidden=(16,)), make_cartpole),
+        (ppo, ppo.PPOConfig(num_envs=8, rollout_steps=8, num_minibatches=2,
+                            hidden=(16,)), make_cartpole),
+        (impala, impala.ImpalaConfig(num_envs=8, rollout_steps=4,
+                                     hidden=(16,)), make_cartpole),
+        (ddpg, ddpg.DDPGConfig(num_envs=8, steps_per_iter=2, batch_size=32,
+                               buffer_capacity=512, hidden=(16,)), make_point_mass),
+        (sac, sac.SACConfig(num_envs=8, steps_per_iter=2, batch_size=32,
+                            buffer_capacity=512, hidden=(16,)), make_point_mass),
+    ],
+)
+def test_eval_fn_runs(mod, cfg, make_env):
+    env = make_env()
+    state = mod.init_state(env, cfg, jax.random.key(0))
+    eval_fn = jax.jit(mod.make_eval_fn(env, cfg), static_argnums=(2, 3))
+    ret = eval_fn(state, jax.random.key(1), 4, 16)
+    assert ret.shape == ()
+    float(ret)  # materializes; must be finite-ish
+
+
+def test_trained_policy_evals_higher():
+    env = make_two_state_mdp()
+    cfg = a2c.A2CConfig(num_envs=32, rollout_steps=8, lr=3e-3, gamma=0.9,
+                        hidden=(32,))
+    state = a2c.init_state(env, cfg, jax.random.key(0))
+    eval_fn = jax.jit(a2c.make_eval_fn(env, cfg), static_argnums=(2, 3))
+    before = float(eval_fn(state, jax.random.key(1), 16, 32))
+    step = jax.jit(a2c.make_train_step(env, cfg), donate_argnums=0)
+    for _ in range(300):
+        state, _ = step(state)
+    after = float(eval_fn(state, jax.random.key(1), 16, 32))
+    assert after > before + 1.0, (before, after)

@@ -1,0 +1,80 @@
+"""Pixel preprocessing wrapper: shapes, stacking, reward clip, action
+repeat — against a synthetic RGB env (ALE absent in this image,
+SURVEY.md §7.0)."""
+
+import gymnasium as gym
+import numpy as np
+
+from actor_critic_tpu.envs.pixel_wrappers import PixelPreprocess
+
+
+class _SyntheticPixelEnv(gym.Env):
+    """RGB frames whose uniform brightness encodes the step count
+    (30 + 20t, resize-proof); reward 2.5 each step; terminates at step 10."""
+
+    observation_space = gym.spaces.Box(0, 255, (60, 80, 3), np.uint8)
+    action_space = gym.spaces.Discrete(2)
+
+    def __init__(self):
+        self.t = 0
+
+    def _frame(self):
+        return np.full((60, 80, 3), 30 + 20 * self.t, np.uint8)
+
+    def reset(self, seed=None, options=None):
+        self.t = 0
+        return self._frame(), {}
+
+    def step(self, action):
+        self.t += 1
+        return self._frame(), 2.5, self.t >= 10, False, {}
+
+
+def test_obs_contract():
+    env = PixelPreprocess(_SyntheticPixelEnv(), size=84, stack=4)
+    obs, _ = env.reset()
+    assert obs.shape == (84, 84, 4) and obs.dtype == np.uint8
+    assert env.observation_space.shape == (84, 84, 4)
+    # reset replicates the first frame across the stack
+    assert (obs[:, :, 0] == obs[:, :, 3]).all()
+
+
+def test_frame_stack_rolls():
+    env = PixelPreprocess(_SyntheticPixelEnv(), size=60, stack=3)
+    env.reset()
+    obs, *_ = env.step(0)
+    obs, *_ = env.step(0)
+    # channels hold distinct history: brightness 30, 50, 70 for t=0,1,2
+    means = [round(float(obs[:, :, c].mean())) for c in range(3)]
+    assert means == [30, 50, 70], means
+
+
+def test_reward_clip_and_action_repeat():
+    env = PixelPreprocess(_SyntheticPixelEnv(), action_repeat=3, clip_reward=True)
+    env.reset()
+    _, r, term, trunc, _ = env.step(0)
+    assert r == 1.0  # sign(3 * 2.5)
+    env2 = PixelPreprocess(_SyntheticPixelEnv(), action_repeat=3, clip_reward=False)
+    env2.reset()
+    _, r2, *_ = env2.step(0)
+    assert abs(r2 - 7.5) < 1e-6
+
+
+def test_action_repeat_stops_at_termination():
+    env = PixelPreprocess(_SyntheticPixelEnv(), action_repeat=4, clip_reward=False)
+    env.reset()
+    term = False
+    steps = 0
+    while not term:
+        _, r, term, trunc, _ = env.step(0)
+        steps += 1
+        assert steps < 10
+    # terminal step consumed <= action_repeat inner steps, none past done
+    assert env.env.t == 10
+
+
+def test_gray_resize_known_values():
+    env = PixelPreprocess(_SyntheticPixelEnv(), size=30, stack=2)
+    obs, _ = env.reset()
+    # uniform gray 30 everywhere except marker → mean close to 30
+    assert abs(float(obs.mean()) - 30.0) < 1.0

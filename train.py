@@ -95,12 +95,35 @@ def run_fused(env, preset, args, logger) -> dict:
 
     from actor_critic_tpu.algos.host_loop import should_log
 
+    eval_fn = None
+    if getattr(args, "eval_every", 0) > 0:
+        eval_fn = jax.jit(mod.make_eval_fn(env, cfg), static_argnums=(2, 3))
+        eval_key = jax.random.key(args.seed + 1)
+
     def log_fn(it, metrics):
-        if should_log(it, args.log_every, args.iterations):
-            logger.log(it, metrics, env_steps=it * spi)
+        # Eval cadence is INDEPENDENT of the logging cadence; an eval
+        # iteration always emits a log row so the number is never lost.
+        do_log = should_log(it, args.log_every, args.iterations)
+        extra = {}
+        if eval_fn is not None and (
+            it % args.eval_every == 0 or it == args.iterations
+        ):
+            extra["eval_return"] = float(eval_fn(state_box[0], eval_key))
+            do_log = True
+        if do_log:
+            logger.log(it, {**metrics, **extra}, env_steps=it * spi)
+
+    # log_fn needs the CURRENT state for eval; checkpointed_train owns the
+    # loop, so expose it via a one-cell box updated by a wrapped step.
+    state_box = [state]
+
+    def step_tracking(s):
+        out, m = step(s)
+        state_box[0] = out
+        return out, m
 
     state, metrics = checkpointed_train(
-        step, state, args.iterations,
+        step_tracking if eval_fn is not None else step, state, args.iterations,
         ckpt=ckpt, save_every=args.save_every, log_fn=log_fn,
         resume=args.resume,
     )
@@ -152,6 +175,10 @@ def main(argv=None) -> int:
     )
     p.add_argument("--metrics", default="metrics.jsonl", help="JSONL output path")
     p.add_argument("--log-every", type=int, default=10)
+    p.add_argument(
+        "--eval-every", type=int, default=0,
+        help="greedy-eval cadence in iterations (0 = off; fused envs)",
+    )
     p.add_argument("--quiet", action="store_true", help="no stdout metric echo")
     p.add_argument("--ckpt-dir", help="orbax checkpoint dir (fused envs)")
     p.add_argument("--save-every", type=int, default=100)
