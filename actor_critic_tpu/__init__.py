@@ -14,12 +14,16 @@ TPU-first:
 
 Package layout (SURVEY.md §7.1):
     models/    encoders (MLP/CNN), policy/value heads, distributions
-    ops/       pure math: GAE / λ-returns / V-trace scans, polyak, losses
-    parallel/  device mesh, shard_map data-parallel wrapper, collectives
-    envs/      JaxEnv protocol + pure-JAX envs; HostEnvPool for gym/MuJoCo
+    ops/       pure math: GAE / λ-returns / V-trace (lax.scan + Pallas
+               TPU kernels), polyak
+    parallel/  device mesh + collectives (dp), sequence-parallel scans
+               (sp), multi-host init
+    envs/      JaxEnv protocol + pure-JAX envs; HostEnvPool for
+               gym/MuJoCo (+pixel wrappers); native C++ engine bindings
+    native/    first-party C++ batched env engine (ctypes ABI)
     replay/    HBM-resident ring replay buffer
-    algos/     A2C, PPO, DDPG, TD3, SAC, IMPALA trainers
-    utils/     PRNG plumbing, config, logging, checkpointing
+    algos/     A2C, PPO, DDPG, TD3, SAC, IMPALA/A3C trainers + greedy eval
+    utils/     checkpointing (orbax), logging (JSONL/TB), profiling
 """
 
 __version__ = "0.1.0"

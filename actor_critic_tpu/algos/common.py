@@ -220,6 +220,18 @@ def make_greedy_eval(
     return eval_fn
 
 
+def make_mode_eval(env: JaxEnv, net):
+    """`make_greedy_eval` specialization for actor-critic nets whose
+    `apply(params, obs) → (dist, value)`: greedy action = dist.mode(),
+    params live at `state.params` (a2c/ppo/impala)."""
+
+    def act(params, obs):
+        dist, _ = net.apply(params, obs)
+        return dist.mode()
+
+    return make_greedy_eval(env, act, lambda s: s.params)
+
+
 def episode_metrics_update(
     ep_return: jax.Array,
     ep_length: jax.Array,

@@ -82,17 +82,10 @@ def make_network(env_spec, cfg: PPOConfig):
 
 
 def make_eval_fn(env: JaxEnv, cfg: "PPOConfig"):
-    """Greedy (mode-action) eval program (SURVEY.md §3.4); see
-    common.make_greedy_eval for the shared contract."""
-    from actor_critic_tpu.algos.common import make_greedy_eval
+    """Greedy (mode-action) eval program (SURVEY.md §3.4)."""
+    from actor_critic_tpu.algos.common import make_mode_eval
 
-    net = make_network(env.spec, cfg)
-
-    def act(params, obs):
-        dist, _ = net.apply(params, obs)
-        return dist.mode()
-
-    return make_greedy_eval(env, act, lambda s: s.params)
+    return make_mode_eval(env, make_network(env.spec, cfg))
 
 
 def make_optimizer(cfg: PPOConfig) -> optax.GradientTransformation:

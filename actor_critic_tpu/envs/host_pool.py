@@ -134,6 +134,7 @@ class HostEnvPool:
             action_dim=action_dim,
             discrete=self._discrete,
             can_truncate=True,
+            obs_dtype=np.dtype(obs_space.dtype),
         )
         self._seed = seed
         self._normalize_obs = normalize_obs
@@ -147,9 +148,12 @@ class HostEnvPool:
 
     # -- normalization ----------------------------------------------------
     def _norm_obs(self, obs: np.ndarray, update: bool = True) -> np.ndarray:
-        obs = np.asarray(obs, np.float32)
         if not self._normalize_obs:
-            return obs
+            # Preserve the env's native dtype: uint8 pixel obs must reach
+            # the CNN encoder as uint8 so its /255 branch fires
+            # (models/networks.py; same contract as envs/pong.py).
+            return np.asarray(obs)
+        obs = np.asarray(obs, np.float32)
         if update:
             self.obs_rms.update(obs)
         return self.obs_rms.normalize(obs, self._clip_obs)
@@ -184,7 +188,7 @@ class HostEnvPool:
         trunc = np.asarray(trunc)
         done = (term | trunc).astype(np.float32)
 
-        final_obs = np.asarray(obs, np.float32).copy()
+        final_obs = np.asarray(obs).copy()  # dtype-preserving (uint8 pixels)
         if "final_obs" in info:
             fos = info["final_obs"]
             if isinstance(fos, np.ndarray) and fos.dtype != object:
@@ -202,7 +206,7 @@ class HostEnvPool:
         nfinal = (
             self.obs_rms.normalize(final_obs, self._clip_obs)
             if self._normalize_obs
-            else final_obs.astype(np.float32)
+            else final_obs  # dtype-preserving, like _norm_obs
         )
         nreward = self._norm_reward(reward, done)
         return HostStepOutput(

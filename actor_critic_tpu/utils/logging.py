@@ -8,10 +8,10 @@ steps, and every scalar the trainer reported. Metric values arrive as
 device arrays already aggregated on-device (algos/metrics.py) — exactly
 one host transfer per logged iteration.
 
-TensorBoard export stays available by pointing the installed
-`tensorboard` at these JSONL files via `scripts/` tooling, or by passing
-`tensorboard_dir` here (uses tf.summary lazily; gated so the framework
-never hard-depends on TF).
+TensorBoard export stays available two ways: convert JSONL afterwards
+with `scripts/tb_export.py`, or pass `tensorboard_dir` here for live
+writing (uses tf.summary lazily; gated so the framework never
+hard-depends on TF).
 """
 
 from __future__ import annotations

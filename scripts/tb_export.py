@@ -1,0 +1,56 @@
+"""Export a metrics.jsonl run log to TensorBoard event files.
+
+The trainers' primary sink is JSONL (utils/logging.py, SURVEY.md §5.5);
+this converts one or more run logs into `tf.summary` scalars so the
+installed TensorBoard can plot them:
+
+    python scripts/tb_export.py runs/hc_metrics.jsonl --logdir runs/tb
+    tensorboard --logdir runs/tb
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def export(jsonl_path: str, logdir: str) -> int:
+    import tensorflow as tf  # lazy: the framework itself never needs TF
+
+    run = os.path.splitext(os.path.basename(jsonl_path))[0]
+    writer = tf.summary.create_file_writer(os.path.join(logdir, run))
+    n = 0
+    with open(jsonl_path) as f, writer.as_default():
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            # The framework's JsonlLogger writes "iter" (utils/logging.py);
+            # accept the generic spellings too, else fall back to line no.
+            step = int(rec.get("iter", rec.get("iteration", rec.get("step", n))))
+            for k, v in rec.items():
+                if isinstance(v, (int, float)) and k not in (
+                    "iter", "iteration", "step",
+                ):
+                    tf.summary.scalar(k, float(v), step=step)
+            n += 1
+    writer.flush()
+    return n
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("jsonl", nargs="+", help="metrics.jsonl file(s)")
+    p.add_argument("--logdir", default="runs/tb")
+    args = p.parse_args(argv)
+    for path in args.jsonl:
+        n = export(path, args.logdir)
+        print(f"{path}: {n} records -> {args.logdir}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

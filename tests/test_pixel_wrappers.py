@@ -73,6 +73,28 @@ def test_action_repeat_stops_at_termination():
     assert env.env.t == 10
 
 
+def test_uint8_survives_host_pool():
+    """With normalize_obs=False the pool must deliver uint8 pixels so the
+    CNN encoder's /255 branch fires (regression: the pool used to
+    float32-cast every obs)."""
+    import gymnasium.envs.registration as reg
+
+    from actor_critic_tpu.envs.host_pool import HostEnvPool
+
+    if "SynthPx-v0" not in gym.registry:
+        reg.register(id="SynthPx-v0", entry_point=_SyntheticPixelEnv)
+    pool = HostEnvPool(
+        "SynthPx-v0", num_envs=2, pixel_preprocess=True,
+        normalize_obs=False, normalize_reward=False,
+    )
+    obs = pool.reset()
+    assert obs.dtype == np.uint8 and obs.shape == (2, 84, 84, 4)
+    assert pool.spec.obs_dtype == np.uint8
+    out = pool.step(np.zeros(2, np.int64))
+    assert out.obs.dtype == np.uint8
+    assert out.final_obs.dtype == np.uint8
+
+
 def test_gray_resize_known_values():
     env = PixelPreprocess(_SyntheticPixelEnv(), size=30, stack=2)
     obs, _ = env.reset()

@@ -1,0 +1,49 @@
+"""scripts/tb_export.py: JSONL run logs (the JsonlLogger 'iter' key
+format) convert into TensorBoard event files with the right step axis."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+pytest.importorskip("tensorflow")
+
+_SCRIPT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "scripts", "tb_export.py",
+)
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location("tb_export", _SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_export_real_logger_format(tmp_path):
+    """Rows as utils.logging.JsonlLogger writes them ('iter' key)."""
+    from actor_critic_tpu.utils.logging import JsonlLogger
+
+    p = tmp_path / "m.jsonl"
+    logger = JsonlLogger(path=str(p), echo=False)
+    for i in (10, 20, 30):
+        logger.log(i, {"loss": 1.0 / i})
+    logger.close()
+
+    tb_export = _load()
+    n = tb_export.export(str(p), str(tmp_path / "tb"))
+    assert n == 3
+    files = [f for f in (tmp_path / "tb").rglob("*") if f.is_file()]
+    assert files
+
+    # step axis must be the logged iterations, not line numbers
+    from tensorflow.python.summary.summary_iterator import summary_iterator
+
+    steps = set()
+    for f in files:
+        for ev in summary_iterator(str(f)):
+            if ev.summary.value:
+                steps.add(int(ev.step))
+    assert steps == {10, 20, 30}, steps

@@ -135,6 +135,12 @@ def run_fused(env, preset, args, logger) -> dict:
 def run_host(pool, preset, args, logger) -> dict:
     from actor_critic_tpu.algos import ddpg, ppo, sac
 
+    if getattr(args, "eval_every", 0) > 0:
+        print(
+            "note: --eval-every applies to fused (jax:*) envs only; host "
+            "runs report episode returns from the training pool instead.",
+            flush=True,
+        )
     last: dict = {}
 
     def log_fn(it, m):
