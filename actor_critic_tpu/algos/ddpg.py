@@ -401,6 +401,12 @@ def make_host_ingest_update(action_dim: int, cfg: DDPGConfig):
     return ingest_update
 
 
+def make_greedy_act(action_dim: int, cfg: DDPGConfig):
+    """Noiseless actor for host eval (host_loop.host_evaluate)."""
+    actor, _ = _modules(action_dim, cfg)
+    return lambda params, obs: actor.apply(params, obs)
+
+
 def train_host(
     pool,
     cfg: DDPGConfig,
@@ -408,6 +414,7 @@ def train_host(
     seed: int = 0,
     log_every: int = 10,
     log_fn: Optional[Callable[[int, dict], None]] = None,
+    eval_every: int = 0,
 ):
     """DDPG/TD3 on a HostEnvPool (host rollout, device learner).
 
@@ -423,4 +430,5 @@ def train_host(
         make_act_fn=make_host_act_fn,
         make_ingest_update=make_host_ingest_update,
         seed=seed, log_every=log_every, log_fn=log_fn,
+        eval_every=eval_every, make_greedy_act=make_greedy_act,
     )

@@ -75,6 +75,29 @@ def host_collect(
     return obs, {k: np.stack(v) for k, v in block.items()}
 
 
+def host_evaluate(
+    pool,
+    act_fn: Callable[[np.ndarray], np.ndarray],
+    max_steps: int = 1000,
+) -> float:
+    """Greedy host eval: mean RAW return of each env's FIRST episode
+    (host counterpart of common.evaluate; SURVEY.md §3.4). `act_fn(obs)
+    -> action` is the deterministic policy. Stops early once every env
+    has finished an episode."""
+    obs = pool.reset()
+    E = pool.num_envs
+    returns = np.zeros(E)
+    alive = np.ones(E)
+    for _ in range(max_steps):
+        out = pool.step(act_fn(obs))
+        returns += out.raw_reward * alive
+        alive *= 1.0 - out.done
+        obs = out.obs
+        if not alive.any():
+            break
+    return float(returns.mean())
+
+
 def off_policy_train_host(
     pool,
     cfg,
@@ -86,6 +109,10 @@ def off_policy_train_host(
     seed: int = 0,
     log_every: int = 10,
     log_fn: Optional[Callable[[int, dict], None]] = None,
+    eval_every: int = 0,
+    make_greedy_act: Optional[Callable] = None,
+    eval_envs: int = 4,
+    eval_steps: int = 1000,
 ):
     """Shared host-env loop for the off-policy trainers (DDPG/TD3, SAC).
 
@@ -97,7 +124,10 @@ def off_policy_train_host(
                                               env_steps) -> action
       make_ingest_update(action_dim, cfg) -> jitted (learner, block,
                                               env_steps) -> (learner, metrics)
-    The learner state must expose `.actor_params`. Returns
+    The learner state must expose `.actor_params`. With `eval_every > 0`
+    and `make_greedy_act(action_dim, cfg) -> (params, obs) -> action`, a
+    frozen-stats eval pool runs a greedy episode sweep on that cadence
+    and an `eval_return` metric rides the next log row. Returns
     (learner, history).
     """
     import jax
@@ -110,6 +140,11 @@ def off_policy_train_host(
     learner = init_learner(pool.spec.obs_shape, pool.spec.action_dim, cfg, lkey)
     act = make_act_fn(pool.spec.action_dim, cfg)
     ingest_update = make_ingest_update(pool.spec.action_dim, cfg)
+
+    eval_pool = greedy = None
+    if eval_every > 0 and make_greedy_act is not None:
+        eval_pool = pool.eval_pool(eval_envs)
+        greedy = jax.jit(make_greedy_act(pool.spec.action_dim, cfg))
 
     obs = pool.reset()
     E = pool.num_envs
@@ -144,10 +179,18 @@ def off_policy_train_host(
         learner, metrics = ingest_update(
             learner, traj, jnp.asarray(env_steps, jnp.int32)
         )
+        extra = {"env_steps": env_steps}
+        if eval_pool is not None and (it + 1) % eval_every == 0:
+            extra["eval_return"] = host_evaluate(
+                eval_pool,
+                lambda o: np.asarray(greedy(learner.actor_params, jnp.asarray(o))),
+                max_steps=eval_steps,
+            )
         maybe_log(
             it, log_every, metrics, tracker, history, log_fn,
-            extra={"env_steps": env_steps},
+            extra=extra,
             num_iterations=num_iterations,
+            force="eval_return" in extra,
         )
     return learner, history
 
@@ -225,11 +268,12 @@ def maybe_log(
     log_fn: Optional[Callable[[int, dict], None]],
     extra: Optional[dict] = None,
     num_iterations: int = 0,
+    force: bool = False,
 ) -> None:
     """Append host-side metrics to `history` (and `log_fn`) on the shared
     `should_log` cadence (pass `num_iterations` so the final iteration is
-    always logged)."""
-    if not should_log(it + 1, log_every, num_iterations):
+    always logged; `force` for rows that must never drop, e.g. eval)."""
+    if not (force or should_log(it + 1, log_every, num_iterations)):
         return
     m = {k: float(v) for k, v in metrics.items()}
     m.update(tracker.report())

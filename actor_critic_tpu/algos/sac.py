@@ -392,6 +392,12 @@ def make_host_ingest_update(action_dim: int, cfg: SACConfig):
     return ingest_update
 
 
+def make_greedy_act(action_dim: int, cfg: SACConfig):
+    """Tanh-mean actor for host eval (host_loop.host_evaluate)."""
+    actor, _ = _modules(action_dim, cfg)
+    return lambda params, obs: actor.apply(params, obs).mode()
+
+
 def train_host(
     pool,
     cfg: SACConfig,
@@ -399,6 +405,7 @@ def train_host(
     seed: int = 0,
     log_every: int = 10,
     log_fn: Optional[Callable[[int, dict], None]] = None,
+    eval_every: int = 0,
 ):
     """SAC on a HostEnvPool (host rollout, device learner). Use
     normalize_reward=False on the pool (TD targets want raw rewards)."""
@@ -410,4 +417,5 @@ def train_host(
         make_act_fn=make_host_act_fn,
         make_ingest_update=make_host_ingest_update,
         seed=seed, log_every=log_every, log_fn=log_fn,
+        eval_every=eval_every, make_greedy_act=make_greedy_act,
     )

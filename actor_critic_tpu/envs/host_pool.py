@@ -142,9 +142,27 @@ class HostEnvPool:
         self._clip_obs = clip_obs
         self._clip_reward = clip_reward
         self._gamma = gamma
+        self._frozen_stats = False
         self.obs_rms = RunningMeanStd(tuple(obs_space.shape))
         self.ret_rms = RunningMeanStd(())
         self._returns = np.zeros(num_envs, np.float64)
+        self._backend = backend
+        self._pixel_preprocess = pixel_preprocess
+
+    def eval_pool(self, num_envs: int = 4, seed: int = 1234) -> "HostEnvPool":
+        """A companion pool for greedy evaluation: same env/backend and the
+        SAME obs-normalization statistics (shared by reference, read-only —
+        eval must see the training policy's input distribution), raw
+        rewards (no reward normalization), fresh episodes."""
+        pool = HostEnvPool(
+            self.env_id, num_envs, seed=seed,
+            normalize_obs=self._normalize_obs, normalize_reward=False,
+            clip_obs=self._clip_obs, gamma=self._gamma,
+            backend=self._backend, pixel_preprocess=self._pixel_preprocess,
+        )
+        pool.obs_rms = self.obs_rms  # aliased on purpose; frozen below
+        pool._frozen_stats = True
+        return pool
 
     # -- normalization ----------------------------------------------------
     def _norm_obs(self, obs: np.ndarray, update: bool = True) -> np.ndarray:
@@ -154,7 +172,7 @@ class HostEnvPool:
             # (models/networks.py; same contract as envs/pong.py).
             return np.asarray(obs)
         obs = np.asarray(obs, np.float32)
-        if update:
+        if update and not self._frozen_stats:
             self.obs_rms.update(obs)
         return self.obs_rms.normalize(obs, self._clip_obs)
 
