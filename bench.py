@@ -2,10 +2,30 @@
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "env-steps/sec/chip", "vs_baseline": N}
+or, when the benchmark cannot run (dead/held TPU tunnel, backend error):
+  {"metric": ..., "value": 0.0, ..., "error": "..."}  (exit code 1)
 
 `vs_baseline` is relative to the BASELINE.json:5 north-star target of
 1,000,000 env-steps/sec (the reference publishes no numbers of its own —
 empty mount, SURVEY.md §0 / BASELINE.md).
+
+Robustness contract (VERDICT.md round 1, "What's weak" #1): the axon TPU
+tunnel is single-client and can be dead or held by another process, in
+which case backend initialization hangs *forever* — round 1's official
+record was a 9-minute hang killed by the driver. So this script runs as a
+two-process watchdog:
+
+  parent (this file, default mode)
+    ├─ preflight: `jax.devices()` in a subprocess, killed after
+    │  BENCH_PREFLIGHT_TIMEOUT (default 75s) → fast {"error": ...} JSON
+    │  when the tunnel is dead instead of a hang
+    └─ child (`bench.py --child`): the real benchmark, killed after
+       BENCH_TIMEOUT (default 600s) → {"error": ...} JSON if the tunnel
+       dies mid-run
+
+The child is a fresh process on purpose: earlier device allocations in the
+same process depress later benchmark numbers (see bench/suite.py, which
+subprocess-isolates every case for the same reason).
 
 Design: the entire rollout(T)×E + GAE + update is one jitted program, and
 ITERS_PER_CALL iterations are scanned inside a single dispatch so the
@@ -17,7 +37,153 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
+
+METRIC = "a2c_cartpole_fused_throughput"
+UNIT = "env-steps/sec/chip"
+NORTH_STAR = 1_000_000.0
+
+
+def _error_line(msg: str) -> str:
+    return json.dumps(
+        {
+            "metric": METRIC,
+            "value": 0.0,
+            "unit": UNIT,
+            "vs_baseline": 0.0,
+            "error": msg,
+        }
+    )
+
+
+def _allow_cpu() -> bool:
+    # "0"/"false"/"no"/"" all mean OFF — raw truthiness would treat
+    # BENCH_ALLOW_CPU=0 as enabled and defeat the honest-platform guard.
+    return os.environ.get("BENCH_ALLOW_CPU", "").strip().lower() not in (
+        "", "0", "false", "no",
+    )
+
+
+def _sub_env() -> dict:
+    """Environment for bench subprocesses. With BENCH_ALLOW_CPU the axon
+    site hook must be disarmed alongside JAX_PLATFORMS=cpu (shared
+    `disarm_axon` helper — the cpu-without-disarm combination deadlocks
+    a fresh interpreter inside the hook's plugin registration)."""
+    env = dict(os.environ)
+    if _allow_cpu():
+        from __graft_entry__ import disarm_axon
+
+        disarm_axon(env)
+    return env
+
+
+def _run_sub(code_or_args: list[str], timeout_s: float):
+    """Run a python subprocess; returns (rc_or_None_on_timeout, stdout, stderr)."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, *code_or_args],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            env=_sub_env(),
+        )
+    except subprocess.TimeoutExpired as e:
+        out = e.stdout or b""
+        err = e.stderr or b""
+        if isinstance(out, bytes):
+            out = out.decode(errors="replace")
+        if isinstance(err, bytes):
+            err = err.decode(errors="replace")
+        return None, out, err
+    return proc.returncode, proc.stdout, proc.stderr
+
+
+def supervise() -> int:
+    # Outer-timeout floor for callers: worst case is preflight + bench
+    # ≈ 60 + 420 = 480s; any external kill budget must exceed that or the
+    # watchdog can't emit its structured-error JSON first. (Round 1's TPU
+    # bench completed in <2 min; 420s is generous headroom.)
+    preflight_s = float(os.environ.get("BENCH_PREFLIGHT_TIMEOUT", 60))
+    bench_s = float(os.environ.get("BENCH_TIMEOUT", 420))
+
+    rc, out, err = _run_sub(
+        ["-c", "import jax; print('platform:', jax.devices()[0].platform)"],
+        preflight_s,
+    )
+    if rc is None:
+        print(
+            _error_line(
+                f"backend preflight exceeded {preflight_s:.0f}s — TPU tunnel "
+                "dead or held by another process; no benchmark run"
+            )
+        )
+        return 1
+    if rc != 0:
+        tail = (err or out).strip().splitlines()
+        print(
+            _error_line(
+                "backend preflight failed: " + (tail[-1] if tail else f"rc={rc}")
+            )
+        )
+        return 1
+    platform = next(
+        (
+            ln.split("platform:", 1)[1].strip()
+            for ln in out.splitlines()
+            if "platform:" in ln
+        ),
+        "unknown",
+    )
+    if platform not in ("axon", "tpu") and not _allow_cpu():
+        # Refuse to pass a CPU fallback off as a per-chip TPU number
+        # (VERDICT.md round-1 weakness #2: the perf story must be honest).
+        print(
+            _error_line(
+                f"backend resolved to {platform!r}, not a TPU — set "
+                "BENCH_ALLOW_CPU=1 to benchmark it anyway"
+            )
+        )
+        return 1
+
+    rc, out, err = _run_sub([os.path.abspath(__file__), "--child"], bench_s)
+    if rc is None:
+        print(
+            _error_line(
+                f"benchmark exceeded {bench_s:.0f}s (preflight had passed — "
+                "tunnel died or was claimed mid-run)"
+            )
+        )
+        return 1
+    lines = [ln for ln in out.strip().splitlines() if ln.startswith("{")]
+    if rc != 0 or not lines:
+        tail = (err or out).strip().splitlines()
+        print(
+            _error_line(
+                f"benchmark child rc={rc}: " + (tail[-1] if tail else "no output")
+            )
+        )
+        return 1
+    try:
+        record = json.loads(lines[-1])
+    except json.JSONDecodeError:
+        print(_error_line("benchmark child emitted unparseable JSON"))
+        return 1
+    # Re-check the platform the child ACTUALLY ran on: a tunnel that dies
+    # between preflight and child can silently fall back to CPU, and a CPU
+    # number must never pass as a per-chip TPU figure.
+    child_platform = record.get("platform", "unknown")
+    if child_platform not in ("axon", "tpu") and not _allow_cpu():
+        print(
+            _error_line(
+                f"benchmark ran on {child_platform!r}, not a TPU (backend "
+                "changed after preflight) — set BENCH_ALLOW_CPU=1 to accept"
+            )
+        )
+        return 1
+    print(json.dumps(record))
+    return 0
 
 
 def main() -> None:
@@ -61,14 +227,18 @@ def main() -> None:
     print(
         json.dumps(
             {
-                "metric": "a2c_cartpole_fused_throughput",
+                "metric": METRIC,
                 "value": round(sps, 1),
-                "unit": "env-steps/sec/chip",
-                "vs_baseline": round(sps / 1_000_000, 4),
+                "unit": UNIT,
+                "vs_baseline": round(sps / NORTH_STAR, 4),
+                "platform": jax.default_backend(),
             }
         )
     )
 
 
 if __name__ == "__main__":
-    main()
+    if "--child" in sys.argv[1:]:
+        main()
+    else:
+        sys.exit(supervise())
