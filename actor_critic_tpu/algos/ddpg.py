@@ -415,6 +415,9 @@ def train_host(
     log_every: int = 10,
     log_fn: Optional[Callable[[int, dict], None]] = None,
     eval_every: int = 0,
+    ckpt=None,
+    save_every: int = 0,
+    resume: bool = False,
 ):
     """DDPG/TD3 on a HostEnvPool (host rollout, device learner).
 
@@ -431,4 +434,5 @@ def train_host(
         make_ingest_update=make_host_ingest_update,
         seed=seed, log_every=log_every, log_fn=log_fn,
         eval_every=eval_every, make_greedy_act=make_greedy_act,
+        ckpt=ckpt, save_every=save_every, resume=resume,
     )

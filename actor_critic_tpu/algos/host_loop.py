@@ -98,6 +98,66 @@ def host_evaluate(
     return float(returns.mean())
 
 
+def host_ckpt_state(pool, **device_state) -> dict:
+    """Assemble the host-trainer checkpoint pytree: the device-side state
+    (learner/params/opt/key/env_steps) plus the pool's normalizer stats,
+    every leaf coerced to an array so orbax round-trips it."""
+    return {
+        **device_state,
+        "pool": np_tree(pool.get_state()),
+    }
+
+
+def np_tree(d):
+    """Recursively np.asarray every leaf (python floats → 0-d arrays)."""
+    if isinstance(d, dict):
+        return {k: np_tree(v) for k, v in d.items()}
+    return np.asarray(d)
+
+
+def should_save(it: int, save_every: int, num_iterations: int) -> bool:
+    """THE checkpoint-cadence policy (1-based `it`): every `save_every`
+    iterations (when > 0) plus always the final one."""
+    if it == num_iterations:
+        return True
+    return save_every > 0 and it % save_every == 0
+
+
+def host_maybe_save(
+    ckpt, it: int, save_every: int, num_iterations: int, pool, metrics: dict,
+    **device_state,
+) -> None:
+    """Save the host-trainer state on the `should_save` cadence (`it` is
+    1-based). Syncs the device state first; the orbax device→host fetch
+    is synchronous within save(), so donation in the next iteration is
+    safe, and the disk write completes asynchronously."""
+    if ckpt is None or not should_save(it, save_every, num_iterations):
+        return
+    import jax
+
+    jax.block_until_ready(device_state)
+    ckpt.save(
+        it, host_ckpt_state(pool, **device_state), metrics=metrics, force=True
+    )
+
+
+def host_resume(ckpt, template: dict, pool) -> tuple[Optional[dict], int]:
+    """Restore the latest host checkpoint into `template`'s structure and
+    push the pool state back; (None, 0) when nothing is saved yet.
+
+    Resume semantics on host envs: learner/params/optimizer/PRNG/
+    normalizer stats restore EXACTLY; the env simulator state does not
+    (gymnasium can't serialize it), so the pool restarts fresh episodes —
+    same contract as the reference genre's tf.train.Saver restarts.
+    """
+    step = ckpt.latest_step()
+    if step is None:
+        return None, 0
+    restored = ckpt.restore(template, step)
+    pool.set_state(restored["pool"])
+    return restored, step
+
+
 def off_policy_train_host(
     pool,
     cfg,
@@ -113,6 +173,9 @@ def off_policy_train_host(
     make_greedy_act: Optional[Callable] = None,
     eval_envs: int = 4,
     eval_steps: int = 1000,
+    ckpt=None,
+    save_every: int = 0,
+    resume: bool = False,
 ):
     """Shared host-env loop for the off-policy trainers (DDPG/TD3, SAC).
 
@@ -146,14 +209,28 @@ def off_policy_train_host(
         eval_pool = pool.eval_pool(eval_envs)
         greedy = jax.jit(make_greedy_act(pool.spec.action_dim, cfg))
 
+    env_steps = 0
+    start_it = 0
+    if ckpt is not None and resume:
+        template = host_ckpt_state(
+            pool, learner=learner, key=key, env_steps=np.asarray(0, np.int64)
+        )
+        restored, start_it = host_resume(ckpt, template, pool)
+        if restored is not None:
+            learner = restored["learner"]
+            key = restored["key"]
+            env_steps = int(restored["env_steps"])
+
+    # reset() AFTER set_state: it re-zeroes the reward-normalizer's running
+    # returns (correct — episodes restart on resume) while the restored
+    # obs-normalizer stats absorb the reset batch as one ordinary update.
     obs = pool.reset()
     E = pool.num_envs
-    env_steps = 0
     tracker = EpisodeTracker(E)
     history: list = []
     metrics: dict = {}
 
-    for it in range(num_iterations):
+    for it in range(start_it, num_iterations):
 
         def explore_act(o):
             nonlocal key, env_steps
@@ -190,8 +267,18 @@ def off_policy_train_host(
             it, log_every, metrics, tracker, history, log_fn,
             extra=extra,
             num_iterations=num_iterations,
-            force="eval_return" in extra,
+            # Force-log eval rows AND the first post-resume iteration (a
+            # resumed long run must produce evidence immediately, same
+            # rationale as should_log's it==1 clause).
+            force="eval_return" in extra or it == start_it,
         )
+        host_maybe_save(
+            ckpt, it + 1, save_every, num_iterations, pool, metrics,
+            learner=learner, key=key,
+            env_steps=np.asarray(env_steps, np.int64),
+        )
+    if ckpt is not None:
+        ckpt.wait()  # the final async save must be durable before return
     return learner, history
 
 
@@ -225,19 +312,28 @@ def fused_train_loop(
         if num_iterations < 1:
             raise ValueError("num_iterations must be >= 1")
 
-        @jax.jit
-        def run(state):
-            def body(s, _):
-                s, _m = step(s)
-                return s, None
+        # should_log policy: the FIRST and final iterations always log, so
+        # the first update runs as its own dispatch (early evidence), then
+        # the remaining n-1 are one scanned program — still O(1) dispatches.
+        jit_step = jax.jit(step, donate_argnums=0)
+        state, metrics = jit_step(state)
+        if log_fn is not None:
+            log_fn(1, {k: float(v) for k, v in metrics.items()})
+        if num_iterations > 1:
 
-            s, _ = jax.lax.scan(body, state, None, length=num_iterations - 1)
-            # exactly num_iterations updates; last one returns the metrics
-            return step(s)
+            @jax.jit
+            def run(state):
+                def body(s, _):
+                    s, _m = step(s)
+                    return s, None
 
-        state, metrics = run(state)
-        if log_fn is not None:  # should_log: final iteration always logs
-            log_fn(num_iterations, {k: float(v) for k, v in metrics.items()})
+                s, _ = jax.lax.scan(body, state, None, length=num_iterations - 2)
+                # last of the remaining n-1 updates returns the metrics
+                return step(s)
+
+            state, metrics = run(state)
+            if log_fn is not None:
+                log_fn(num_iterations, {k: float(v) for k, v in metrics.items()})
         return state, metrics
 
     jit_step = jax.jit(step, donate_argnums=0)
@@ -251,10 +347,14 @@ def fused_train_loop(
 
 def should_log(it: int, log_every: int, num_iterations: int) -> bool:
     """THE logging-cadence policy, shared by every loop and the CLI:
-    every `log_every` iterations (when > 0) plus always the run's final
-    iteration; `log_every <= 0` means final-iteration only. `it` is
-    1-based."""
-    if it == num_iterations:
+    every `log_every` iterations (when > 0) plus ALWAYS the first and
+    final iterations; `log_every <= 0` means first+final only. `it` is
+    1-based. Logging iteration 1 unconditionally means a long host run
+    produces evidence within one iteration instead of after
+    `log_every` of them (round-1's 50-minute HalfCheetah attempt left a
+    0-row metrics file precisely because the first row waited for
+    iteration 10)."""
+    if it == 1 or it == num_iterations:
         return True
     return log_every > 0 and it % log_every == 0
 

@@ -270,6 +270,17 @@ def init_host_params(env_spec, cfg: PPOConfig, key: jax.Array):
     return params, opt_state
 
 
+def make_greedy_act(env_spec, cfg: PPOConfig):
+    """Mode-action policy for host eval (host_loop.host_evaluate)."""
+    net = make_network(env_spec, cfg)
+
+    def act(params, obs):
+        dist, _ = net.apply(params, obs)
+        return dist.mode()
+
+    return act
+
+
 def train_host(
     pool,
     cfg: PPOConfig,
@@ -277,17 +288,30 @@ def train_host(
     seed: int = 0,
     log_every: int = 10,
     log_fn: Optional[Callable[[int, dict], None]] = None,
+    eval_every: int = 0,
+    eval_envs: int = 4,
+    eval_steps: int = 1000,
+    ckpt=None,
+    save_every: int = 0,
+    resume: bool = False,
 ):
     """PPO on a HostEnvPool (MuJoCo etc.): host rollout, device update.
 
-    Returns (params, opt_state, history) where history is a list of
-    (iteration, metrics dict incl. raw episode returns).
+    With `eval_every > 0` a frozen-stats eval pool runs a greedy (mode
+    action) episode sweep on that cadence; with `ckpt` the run is
+    restart-idempotent on the device side (params/opt/PRNG/normalizer
+    stats restore exactly; host envs restart fresh episodes — see
+    host_loop.host_resume). Returns (params, opt_state, history).
     """
     import numpy as np
 
     from actor_critic_tpu.algos.host_loop import (
         EpisodeTracker,
+        host_ckpt_state,
         host_collect,
+        host_evaluate,
+        host_maybe_save,
+        host_resume,
         maybe_log,
     )
 
@@ -297,11 +321,27 @@ def train_host(
     policy_step = make_policy_step(pool.spec, cfg)
     update = make_host_update_step(pool.spec, cfg, can_truncate=True)
 
+    eval_pool = greedy = None
+    if eval_every > 0:
+        eval_pool = pool.eval_pool(eval_envs)
+        greedy = jax.jit(make_greedy_act(pool.spec, cfg))
+
+    start_it = 0
+    if ckpt is not None and resume:
+        template = host_ckpt_state(
+            pool, params=params, opt_state=opt_state, key=key
+        )
+        restored, start_it = host_resume(ckpt, template, pool)
+        if restored is not None:
+            params = restored["params"]
+            opt_state = restored["opt_state"]
+            key = restored["key"]
+
     obs = pool.reset()
     tracker = EpisodeTracker(pool.num_envs)
     history: list = []
 
-    for it in range(num_iterations):
+    for it in range(start_it, num_iterations):
 
         def policy_act(o):
             nonlocal key
@@ -324,10 +364,26 @@ def train_host(
             arrays["terminated"], arrays["final_obs"],
             jnp.asarray(obs), ukey,
         )
+        extra = {"env_steps": (it + 1) * cfg.rollout_steps * pool.num_envs}
+        if eval_pool is not None and (it + 1) % eval_every == 0:
+            extra["eval_return"] = host_evaluate(
+                eval_pool,
+                lambda o: np.asarray(greedy(params, jnp.asarray(o))),
+                max_steps=eval_steps,
+            )
         maybe_log(
             it, log_every, metrics, tracker, history, log_fn,
+            extra=extra,
             num_iterations=num_iterations,
+            # eval rows and the first post-resume iteration never drop
+            force="eval_return" in extra or it == start_it,
         )
+        host_maybe_save(
+            ckpt, it + 1, save_every, num_iterations, pool, metrics,
+            params=params, opt_state=opt_state, key=key,
+        )
+    if ckpt is not None:
+        ckpt.wait()  # the final async save must be durable before return
     return params, opt_state, history
 
 

@@ -129,12 +129,17 @@ class HostEnvPool:
             action_dim = int(np.prod(space.shape))
             self._act_low = np.asarray(space.low, np.float32)
             self._act_high = np.asarray(space.high, np.float32)
+        # uint8 pixel obs keep their dtype (the CNN's /255 branch fires on
+        # it); everything else is delivered as float32 regardless of the
+        # env's native dtype — MuJoCo emits float64, and letting that flow
+        # into host buffers/transfers would double memory for no benefit.
+        raw_dtype = np.dtype(obs_space.dtype)
         self.spec = EnvSpec(
             obs_shape=tuple(obs_space.shape),
             action_dim=action_dim,
             discrete=self._discrete,
             can_truncate=True,
-            obs_dtype=np.dtype(obs_space.dtype),
+            obs_dtype=raw_dtype if raw_dtype == np.uint8 else np.dtype(np.float32),
         )
         self._seed = seed
         self._normalize_obs = normalize_obs
@@ -167,10 +172,12 @@ class HostEnvPool:
     # -- normalization ----------------------------------------------------
     def _norm_obs(self, obs: np.ndarray, update: bool = True) -> np.ndarray:
         if not self._normalize_obs:
-            # Preserve the env's native dtype: uint8 pixel obs must reach
-            # the CNN encoder as uint8 so its /255 branch fires
-            # (models/networks.py; same contract as envs/pong.py).
-            return np.asarray(obs)
+            # uint8 pixel obs must reach the CNN encoder as uint8 so its
+            # /255 branch fires (models/networks.py; same contract as
+            # envs/pong.py); any other dtype is cast to float32 to match
+            # spec.obs_dtype (float64 MuJoCo obs must not reach buffers).
+            obs = np.asarray(obs)
+            return obs if obs.dtype == np.uint8 else obs.astype(np.float32)
         obs = np.asarray(obs, np.float32)
         if update and not self._frozen_stats:
             self.obs_rms.update(obs)
@@ -221,11 +228,12 @@ class HostEnvPool:
 
         nobs = self._norm_obs(obs)
         # final_obs normalized with the SAME stats, not updating them twice.
-        nfinal = (
-            self.obs_rms.normalize(final_obs, self._clip_obs)
-            if self._normalize_obs
-            else final_obs  # dtype-preserving, like _norm_obs
-        )
+        if self._normalize_obs:
+            nfinal = self.obs_rms.normalize(final_obs, self._clip_obs)
+        elif final_obs.dtype == np.uint8:  # same dtype policy as _norm_obs
+            nfinal = final_obs
+        else:
+            nfinal = final_obs.astype(np.float32)
         nreward = self._norm_reward(reward, done)
         return HostStepOutput(
             obs=nobs,

@@ -52,14 +52,16 @@ _VMEM_BUDGET_BYTES = 10 * 2**20
 
 
 def _pick_block(E: int, block_e: int, T: int, n_arrays: int) -> int:
-    """Env-lane tile that (a) divides E and (b) keeps n_arrays live
-    (T, be) f32 blocks inside the VMEM budget. Returns 0 if no tile fits
-    (caller falls back to lax.scan)."""
+    """Env-lane tile that (a) divides E, (b) is a multiple of the 128-lane
+    f32 Mosaic tile (narrower/ragged blocks only ever compile on real TPU
+    — CI runs interpret mode — so they'd be untested padding behavior),
+    and (c) keeps n_arrays live (T, be) f32 blocks inside the VMEM budget.
+    Returns 0 if no such tile exists (caller falls back to lax.scan)."""
     max_be = _VMEM_BUDGET_BYTES // (max(T, 1) * 4 * n_arrays)
-    b = min(block_e, E, max(max_be, 0))
-    while b > 0 and E % b:
-        b //= 2
-    return b if b >= 8 else 0
+    b = (min(block_e, E, max(max_be, 0)) // 128) * 128
+    while b >= 128 and E % b:
+        b -= 128
+    return b if b >= 128 else 0
 
 
 def _gae_kernel(gamma, lam, r_ref, v_ref, d_ref, b_ref, adv_ref, ret_ref):

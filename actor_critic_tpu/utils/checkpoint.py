@@ -108,10 +108,16 @@ class Checkpointer:
             restored = self._mgr.restore(
                 step, args=ocp.args.Composite(state=ocp.args.StandardRestore(abstract))
             )["state"]
-        except ValueError:
-            # Legacy layout: a bare StandardSave with no named items
-            # (written before metrics rode along). Orbax refuses Composite
-            # args on those; retry the unnamed form.
+        except ValueError as e:
+            # Legacy layout ONLY: a bare StandardSave with no named items
+            # (written before metrics rode along) makes orbax refuse
+            # Composite args with its "unnamed checkpointable" signature.
+            # Any other ValueError (e.g. template shape/dtype mismatch) is
+            # a genuine failure and must surface as itself, not as a
+            # confusing secondary error from the bare-form retry.
+            msg = str(e)
+            if not ("unnamed" in msg or "Composite" in msg):
+                raise
             restored = self._mgr.restore(
                 step, args=ocp.args.StandardRestore(abstract)
             )
@@ -201,11 +207,11 @@ def checkpointed_train(
         if (ckpt is not None and done and done >= num_iterations)
         else {}
     )
+    from actor_critic_tpu.algos.host_loop import should_save
+
     for it in range(done + 1, num_iterations + 1):
         state, metrics = step_fn(state)
-        if ckpt is not None and (
-            (save_every > 0 and it % save_every == 0) or it == num_iterations
-        ):
+        if ckpt is not None and should_save(it, save_every, num_iterations):
             # Sync before handing buffers to the async saver: donation
             # would otherwise let the next step overwrite in-flight reads.
             jax.block_until_ready(state)

@@ -52,11 +52,14 @@ class JsonlLogger:
         }
         for k, v in {**metrics, **extra}.items():
             try:
-                row[k] = float(v)
+                f = float(v)
+                # NaN/Inf are not valid strict JSON (json.dumps would emit
+                # bare NaN and break downstream parsers) — write null.
+                row[k] = f if (f == f and abs(f) != float("inf")) else None
             except (TypeError, ValueError):
                 row[k] = str(v)
         if self._fh is not None:
-            self._fh.write(json.dumps(row) + "\n")
+            self._fh.write(json.dumps(row, allow_nan=False) + "\n")
         if self._echo:
             short = ", ".join(
                 f"{k}={v:.4g}" if isinstance(v, float) else f"{k}={v}"

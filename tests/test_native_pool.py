@@ -73,7 +73,14 @@ def test_autoreset_same_step_semantics():
         if (term | trunc).any():
             done_seen = True
             i = int(np.argmax(term | trunc))
-            assert info["final_obs"][i] is not None
+            # SAME_STEP contract: final_obs[i] is the PRE-reset terminal
+            # observation. For a true termination that state must violate
+            # the CartPole bounds (|x| > 2.4 or |theta| > 12°) — a reset
+            # state (uniform [-0.05, 0.05]) can never satisfy this, so the
+            # assertion genuinely distinguishes the two.
+            fo = np.asarray(info["final_obs"][i], np.float64)
+            if term[i]:
+                assert abs(fo[0]) > 2.4 or abs(fo[2]) > 12 * np.pi / 180
             # reset obs is near the origin (fresh uniform [-0.05, 0.05])
             assert np.all(np.abs(obs[i]) <= 0.05 + 1e-6)
         if done_seen and t > 20:

@@ -134,13 +134,8 @@ def run_fused(env, preset, args, logger) -> dict:
 
 def run_host(pool, preset, args, logger) -> dict:
     from actor_critic_tpu.algos import ddpg, ppo, sac
+    from actor_critic_tpu.utils.checkpoint import Checkpointer
 
-    if getattr(args, "eval_every", 0) > 0:
-        print(
-            "note: --eval-every applies to fused (jax:*) envs only; host "
-            "runs report episode returns from the training pool instead.",
-            flush=True,
-        )
     last: dict = {}
 
     def log_fn(it, m):
@@ -148,21 +143,35 @@ def run_host(pool, preset, args, logger) -> dict:
         last.update(m)
         logger.log(it, m)
 
+    ckpt = Checkpointer(args.ckpt_dir) if args.ckpt_dir else None
+    if ckpt is not None and args.resume and ckpt.latest_step() is not None:
+        print(f"resuming from iteration {ckpt.latest_step()}", flush=True)
     common = dict(
         num_iterations=args.iterations, seed=args.seed,
         log_every=args.log_every, log_fn=log_fn,
+        eval_every=getattr(args, "eval_every", 0),
+        ckpt=ckpt, save_every=args.save_every, resume=args.resume,
     )
-    if preset.algo == "ppo":
-        ppo.train_host(pool, preset.config, **common)
-    elif preset.algo in ("ddpg", "td3"):
-        ddpg.train_host(pool, preset.config, **common)
-    elif preset.algo == "sac":
-        sac.train_host(pool, preset.config, **common)
-    else:
-        raise SystemExit(
-            f"{preset.algo} needs a pure-JAX env (fused trainer); "
-            "pick env jax:<name>"
-        )
+    try:
+        if preset.algo == "ppo":
+            ppo.train_host(pool, preset.config, **common)
+        elif preset.algo in ("ddpg", "td3"):
+            ddpg.train_host(pool, preset.config, **common)
+        elif preset.algo == "sac":
+            sac.train_host(pool, preset.config, **common)
+        else:
+            raise SystemExit(
+                f"{preset.algo} needs a pure-JAX env (fused trainer); "
+                "pick env jax:<name>"
+            )
+        if not last and ckpt is not None:
+            # Resume found the run already complete: no iteration ran, so
+            # no log row fired — recover the final metrics saved alongside
+            # the checkpoint instead of returning an empty summary.
+            last = ckpt.restore_metrics()
+    finally:
+        if ckpt is not None:
+            ckpt.close()
     return last
 
 
@@ -183,10 +192,10 @@ def main(argv=None) -> int:
     p.add_argument("--log-every", type=int, default=10)
     p.add_argument(
         "--eval-every", type=int, default=0,
-        help="greedy-eval cadence in iterations (0 = off; fused envs)",
+        help="greedy-eval cadence in iterations (0 = off)",
     )
     p.add_argument("--quiet", action="store_true", help="no stdout metric echo")
-    p.add_argument("--ckpt-dir", help="orbax checkpoint dir (fused envs)")
+    p.add_argument("--ckpt-dir", help="orbax checkpoint dir")
     p.add_argument("--save-every", type=int, default=100)
     p.add_argument("--resume", action="store_true", help="resume from --ckpt-dir")
     p.add_argument("--list-presets", action="store_true")
