@@ -418,14 +418,18 @@ def train_host(
     ckpt=None,
     save_every: int = 0,
     resume: bool = False,
+    overlap: bool = True,
 ):
     """DDPG/TD3 on a HostEnvPool (host rollout, device learner).
 
     Recommended pool settings for off-policy MuJoCo: normalize_obs=True,
     normalize_reward=False (TD targets want raw reward scale).
+    `overlap` acts via the numpy host mirror with 1-update-stale params
+    so device updates run during collection (host_loop docstring).
     Returns (learner, history).
     """
     from actor_critic_tpu.algos.host_loop import off_policy_train_host
+    from actor_critic_tpu.models.host_actor import make_ddpg_host_explore
 
     return off_policy_train_host(
         pool, cfg, num_iterations,
@@ -435,4 +439,5 @@ def train_host(
         seed=seed, log_every=log_every, log_fn=log_fn,
         eval_every=eval_every, make_greedy_act=make_greedy_act,
         ckpt=ckpt, save_every=save_every, resume=resume,
+        overlap=overlap, make_host_explore=make_ddpg_host_explore,
     )

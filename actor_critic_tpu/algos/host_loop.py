@@ -115,12 +115,7 @@ def np_tree(d):
     return np.asarray(d)
 
 
-def should_save(it: int, save_every: int, num_iterations: int) -> bool:
-    """THE checkpoint-cadence policy (1-based `it`): every `save_every`
-    iterations (when > 0) plus always the final one."""
-    if it == num_iterations:
-        return True
-    return save_every > 0 and it % save_every == 0
+from actor_critic_tpu.utils.cadence import should_save  # noqa: E402, F401
 
 
 def host_maybe_save(
@@ -176,12 +171,14 @@ def off_policy_train_host(
     ckpt=None,
     save_every: int = 0,
     resume: bool = False,
+    overlap: bool = True,
+    make_host_explore: Optional[Callable] = None,
 ):
     """Shared host-env loop for the off-policy trainers (DDPG/TD3, SAC).
 
-    Both algorithms drive a `HostEnvPool` identically — explore-act on
-    device, stack a [K, E] block host-side, one transfer into the jitted
-    ingest+update — and differ only in the three factory callables:
+    Both algorithms drive a `HostEnvPool` identically — explore-act,
+    stack a [K, E] block host-side, one transfer into the jitted
+    ingest+update — and differ only in the factory callables:
       init_learner(obs_shape, action_dim, cfg, key) -> learner
       make_act_fn(action_dim, cfg) -> jitted (actor_params, obs, key,
                                               env_steps) -> action
@@ -190,7 +187,15 @@ def off_policy_train_host(
     The learner state must expose `.actor_params`. With `eval_every > 0`
     and `make_greedy_act(action_dim, cfg) -> (params, obs) -> action`, a
     frozen-stats eval pool runs a greedy episode sweep on that cadence
-    and an `eval_return` metric rides the next log row. Returns
+    and an `eval_return` metric rides the next log row.
+
+    With `overlap` (default) and a `make_host_explore(spec, cfg) ->
+    (np_params, obs, rng, env_steps) -> action` numpy mirror
+    (models/host_actor.py), collection acts entirely on the host with
+    params one update stale, so the dispatched device update runs WHILE
+    the next rollout is collected — the host/device overlap of SURVEY
+    §7.2 item 2. Without a mirror (or overlap=False) acting round-trips
+    the device each pool step and blocks on the update. Returns
     (learner, history).
     """
     import jax
@@ -230,17 +235,37 @@ def off_policy_train_host(
     history: list = []
     metrics: dict = {}
 
+    host_act = host_params = None
+    if overlap and make_host_explore is not None:
+        from actor_critic_tpu.models import host_actor
+
+        np_params = jax.device_get(learner.actor_params)
+        if host_actor.supports_mirror(np_params):
+            host_act = make_host_explore(pool.spec, cfg)
+            host_params = np_params
+            rng = np.random.default_rng(seed + 0x5EED)
+
     for it in range(start_it, num_iterations):
 
-        def explore_act(o):
-            nonlocal key, env_steps
-            key, akey = jax.random.split(key)
-            action = np.asarray(
-                act(learner.actor_params, jnp.asarray(o), akey,
-                    jnp.asarray(env_steps, jnp.int32))
-            )
-            env_steps += E
-            return action, {}
+        if host_act is not None:
+
+            def explore_act(o):
+                nonlocal env_steps
+                action = host_act(host_params, o, rng, env_steps)
+                env_steps += E
+                return action, {}
+
+        else:
+
+            def explore_act(o):
+                nonlocal key, env_steps
+                key, akey = jax.random.split(key)
+                action = np.asarray(
+                    act(learner.actor_params, jnp.asarray(o), akey,
+                        jnp.asarray(env_steps, jnp.int32))
+                )
+                env_steps += E
+                return action, {}
 
         obs, block = host_collect(
             pool, obs, cfg.steps_per_iter, explore_act, tracker
@@ -253,6 +278,14 @@ def off_policy_train_host(
             terminated=jnp.asarray(block["terminated"]),
             done=jnp.asarray(block["done"]),
         )
+        if host_act is not None:
+            # Acting params for the NEXT rollout: this update's INPUT
+            # params, fetched BEFORE the dispatch (ingest_update donates
+            # the learner) — concrete already (the previous update
+            # finished during this collection), so the fetch doesn't
+            # wait, and the update dispatched below computes on-device
+            # while the next rollout is collected.
+            host_params = jax.device_get(learner.actor_params)
         learner, metrics = ingest_update(
             learner, traj, jnp.asarray(env_steps, jnp.int32)
         )
@@ -345,18 +378,10 @@ def fused_train_loop(
     return state, metrics
 
 
-def should_log(it: int, log_every: int, num_iterations: int) -> bool:
-    """THE logging-cadence policy, shared by every loop and the CLI:
-    every `log_every` iterations (when > 0) plus ALWAYS the first and
-    final iterations; `log_every <= 0` means first+final only. `it` is
-    1-based. Logging iteration 1 unconditionally means a long host run
-    produces evidence within one iteration instead of after
-    `log_every` of them (round-1's 50-minute HalfCheetah attempt left a
-    0-row metrics file precisely because the first row waited for
-    iteration 10)."""
-    if it == 1 or it == num_iterations:
-        return True
-    return log_every > 0 and it % log_every == 0
+# Cadence policies live in utils/cadence.py (a leaf module, so
+# utils/checkpoint.py can share them without importing algos); re-exported
+# here because the loops and their tests address them via this module.
+from actor_critic_tpu.utils.cadence import should_log  # noqa: E402, F401
 
 
 def maybe_log(

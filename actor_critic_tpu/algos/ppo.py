@@ -226,6 +226,13 @@ def make_host_update_step(env_spec, cfg: PPOConfig, can_truncate: bool = True):
     Takes time-major [T, E] arrays (one host→device transfer per
     iteration — SURVEY §3.1 boundary fix), computes truncation-aware GAE
     on-device, and runs the in-jit epoch/minibatch PPO update.
+
+    `final_values`/`bootstrap_value` may be supplied externally (overlap
+    mode computes them with the host mirror so EVERY value estimate in
+    the GAE — per-step, truncation-bootstrap, and rollout bootstrap —
+    comes from the same stale behavior params; passing None recomputes
+    them in-jit with the current params, correct for the synchronous
+    path where behavior == current).
     """
     net = make_network(env_spec, cfg)
     opt = make_optimizer(cfg)
@@ -235,15 +242,19 @@ def make_host_update_step(env_spec, cfg: PPOConfig, can_truncate: bool = True):
     def update(
         params, opt_state, obs, action, log_prob, value, reward, done,
         terminated, final_obs, last_obs, key,
+        final_values=None, bootstrap_value=None,
     ):
         T, E = reward.shape
-        _, bootstrap_value = apply_fn(params, last_obs)
+        if bootstrap_value is None:
+            _, bootstrap_value = apply_fn(params, last_obs)
         if can_truncate:
-            _, final_values = apply_fn(
-                params, final_obs.reshape(T * E, *final_obs.shape[2:])
-            )
+            if final_values is None:
+                _, fv = apply_fn(
+                    params, final_obs.reshape(T * E, *final_obs.shape[2:])
+                )
+                final_values = fv.reshape(T, E)
             truncated = done * (1.0 - terminated)
-            rewards = reward + cfg.gamma * final_values.reshape(T, E) * truncated
+            rewards = reward + cfg.gamma * final_values * truncated
         else:
             rewards = reward
         advantages, returns = gae(
@@ -294,6 +305,7 @@ def train_host(
     ckpt=None,
     save_every: int = 0,
     resume: bool = False,
+    overlap: bool = True,
 ):
     """PPO on a HostEnvPool (MuJoCo etc.): host rollout, device update.
 
@@ -301,7 +313,15 @@ def train_host(
     action) episode sweep on that cadence; with `ckpt` the run is
     restart-idempotent on the device side (params/opt/PRNG/normalizer
     stats restore exactly; host envs restart fresh episodes — see
-    host_loop.host_resume). Returns (params, opt_state, history).
+    host_loop.host_resume).
+
+    With `overlap` (default) collection acts via the numpy host mirror
+    (models/host_actor.py) using params ONE update stale, so the jitted
+    epoch/minibatch update runs on-device while the next rollout is
+    collected. The recorded log_prob/value come from the same (stale)
+    behavior params, so the clipped importance ratio remains a correct
+    off-policy estimator — the same staleness-with-correction design the
+    IMPALA trainer formalizes. Returns (params, opt_state, history).
     """
     import numpy as np
 
@@ -341,28 +361,67 @@ def train_host(
     tracker = EpisodeTracker(pool.num_envs)
     history: list = []
 
+    host_policy = host_params = host_value = None
+    if overlap:
+        from actor_critic_tpu.models import host_actor
+
+        np_params = jax.device_get(params)
+        if host_actor.supports_mirror(np_params):
+            host_policy = host_actor.make_ppo_host_policy(pool.spec, cfg)
+            host_value = host_actor.make_ppo_host_value(pool.spec, cfg)
+            host_params = np_params
+            rng = np.random.default_rng(seed + 0x5EED)
+
     for it in range(start_it, num_iterations):
 
-        def policy_act(o):
-            nonlocal key
-            key, akey = jax.random.split(key)
-            action, logp, value = policy_step(params, jnp.asarray(o), akey)
-            return np.asarray(action), {
-                "log_prob": np.asarray(logp),
-                "value": np.asarray(value),
-            }
+        if host_policy is not None:
+
+            def policy_act(o):
+                action, logp, value = host_policy(host_params, o, rng)
+                return action, {"log_prob": logp, "value": value}
+
+        else:
+
+            def policy_act(o):
+                nonlocal key
+                key, akey = jax.random.split(key)
+                action, logp, value = policy_step(params, jnp.asarray(o), akey)
+                return np.asarray(action), {
+                    "log_prob": np.asarray(logp),
+                    "value": np.asarray(value),
+                }
 
         obs, block = host_collect(
             pool, obs, cfg.rollout_steps, policy_act, tracker
         )
         key, ukey = jax.random.split(key)
         arrays = {k: jnp.asarray(v) for k, v in block.items()}
+        extra_values = {}
+        if host_policy is not None:
+            # All GAE value baselines from the SAME stale behavior params
+            # as the recorded per-step values (mirror-computed host-side);
+            # mixing parameter versions would bias the TD residuals at
+            # truncation boundaries and the value-clip anchor.
+            T_, E_ = block["reward"].shape
+            fv = host_value(
+                host_params,
+                block["final_obs"].reshape(T_ * E_, *block["final_obs"].shape[2:]),
+            ).reshape(T_, E_)
+            extra_values = dict(
+                final_values=jnp.asarray(fv),
+                bootstrap_value=jnp.asarray(host_value(host_params, obs)),
+            )
+            # Next rollout's acting params: this update's INPUT, fetched
+            # before the dispatch (concrete — the previous update finished
+            # during collection — so no wait); the update dispatched below
+            # then overlaps the next rollout.
+            host_params = jax.device_get(params)
         params, opt_state, metrics = update(
             params, opt_state,
             arrays["obs"], arrays["action"], arrays["log_prob"],
             arrays["value"], arrays["reward"], arrays["done"],
             arrays["terminated"], arrays["final_obs"],
-            jnp.asarray(obs), ukey,
+            jnp.asarray(obs), ukey, **extra_values,
         )
         extra = {"env_steps": (it + 1) * cfg.rollout_steps * pool.num_envs}
         if eval_pool is not None and (it + 1) % eval_every == 0:

@@ -1,0 +1,37 @@
+"""Logging/checkpoint cadence policies — THE single definition shared by
+the host loops, the fused loops, `checkpointed_train`, and the CLI.
+A leaf module (no jax, no intra-package imports) so both `utils` and
+`algos` can depend on it without layering inversions."""
+
+from __future__ import annotations
+
+
+def should_log(it: int, log_every: int, num_iterations: int) -> bool:
+    """Every `log_every` iterations (when > 0) plus ALWAYS the first and
+    final iterations; `log_every <= 0` means first+final only. `it` is
+    1-based. Logging iteration 1 unconditionally means a long run
+    produces evidence within one iteration instead of after `log_every`
+    of them (round 1's 50-minute HalfCheetah attempt left a 0-row
+    metrics file precisely because the first row waited for iteration
+    10)."""
+    if it == 1 or it == num_iterations:
+        return True
+    return log_every > 0 and it % log_every == 0
+
+
+def should_save(it: int, save_every: int, num_iterations: int) -> bool:
+    """Checkpoint cadence (1-based `it`): every `save_every` iterations
+    (when > 0) plus always the final one."""
+    if it == num_iterations:
+        return True
+    return save_every > 0 and it % save_every == 0
+
+
+def finite_or_none(v):
+    """float(v) if finite, else None — the strict-JSON scrub for metric
+    values (NaN/Inf are not valid JSON; every sink shares this rule)."""
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return None
+    return f if f == f and abs(f) != float("inf") else None

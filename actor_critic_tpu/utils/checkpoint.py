@@ -207,7 +207,7 @@ def checkpointed_train(
         if (ckpt is not None and done and done >= num_iterations)
         else {}
     )
-    from actor_critic_tpu.algos.host_loop import should_save
+    from actor_critic_tpu.utils.cadence import should_save
 
     for it in range(done + 1, num_iterations + 1):
         state, metrics = step_fn(state)

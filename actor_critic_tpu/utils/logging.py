@@ -50,14 +50,17 @@ class JsonlLogger:
             "iter": int(iteration),
             "wall_s": round(time.time() - self._t0, 3),
         }
+        from actor_critic_tpu.utils.cadence import finite_or_none
+
         for k, v in {**metrics, **extra}.items():
             try:
-                f = float(v)
-                # NaN/Inf are not valid strict JSON (json.dumps would emit
-                # bare NaN and break downstream parsers) — write null.
-                row[k] = f if (f == f and abs(f) != float("inf")) else None
+                float(v)
             except (TypeError, ValueError):
-                row[k] = str(v)
+                row[k] = str(v)  # non-numeric values stringify
+            else:
+                # Numeric: non-finite floats become null (NaN/Inf are not
+                # valid strict JSON) via the shared scrub.
+                row[k] = finite_or_none(v)
         if self._fh is not None:
             self._fh.write(json.dumps(row, allow_nan=False) + "\n")
         if self._echo:

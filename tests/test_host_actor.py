@@ -1,0 +1,164 @@
+"""Numpy host-actor mirror parity (models/host_actor.py).
+
+The mirrors must produce the SAME deterministic quantities (logits,
+means, log-stds, values, deterministic actions) as the flax modules they
+shadow — sampling then differs only by the RNG source. Plus: the overlap
+path of the host trainers runs end-to-end and still learns.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from actor_critic_tpu.algos import ddpg, ppo, sac
+from actor_critic_tpu.envs.host_pool import HostEnvPool
+from actor_critic_tpu.envs.jax_env import EnvSpec
+from actor_critic_tpu.models import host_actor
+from actor_critic_tpu.models.networks import (
+    ActorCriticDiscrete,
+    ActorCriticGaussian,
+    DeterministicActor,
+    SquashedGaussianActor,
+)
+
+ATOL = 1e-5
+
+
+def _np_params(params):
+    return jax.device_get(params)
+
+
+def test_mirror_discrete_parity():
+    net = ActorCriticDiscrete(num_actions=3, hidden=(16, 16))
+    obs = jnp.asarray(np.random.default_rng(0).standard_normal((5, 4)), jnp.float32)
+    params = net.init(jax.random.key(0), obs)
+    dist, value = net.apply(params, obs)
+
+    spec = EnvSpec(obs_shape=(4,), action_dim=3, discrete=True)
+    policy = host_actor.make_ppo_host_policy(spec, None)
+    p = _np_params(params)["params"]
+    z = host_actor._mlp(p["torso"], np.asarray(obs), host_actor._tanh)
+    logits = host_actor._dense(p["policy"], z)
+    v = host_actor._dense(p["value"], z)[..., 0]
+    np.testing.assert_allclose(logits, np.asarray(dist.logits), atol=ATOL)
+    np.testing.assert_allclose(v, np.asarray(value), atol=ATOL)
+
+    # Sampling: actions in range, log_prob matches the device dist's.
+    a, logp, vv = policy(_np_params(params), np.asarray(obs), np.random.default_rng(1))
+    assert a.shape == (5,) and ((0 <= a) & (a < 3)).all()
+    np.testing.assert_allclose(
+        logp, np.asarray(dist.log_prob(jnp.asarray(a))), atol=1e-4
+    )
+    np.testing.assert_allclose(vv, np.asarray(value), atol=ATOL)
+
+
+def test_mirror_gaussian_parity():
+    net = ActorCriticGaussian(action_dim=2, hidden=(16, 16))
+    obs = jnp.asarray(np.random.default_rng(0).standard_normal((5, 3)), jnp.float32)
+    params = net.init(jax.random.key(0), obs)
+    dist, value = net.apply(params, obs)
+
+    spec = EnvSpec(obs_shape=(3,), action_dim=2, discrete=False)
+    policy = host_actor.make_ppo_host_policy(spec, None)
+    a, logp, v = policy(_np_params(params), np.asarray(obs), np.random.default_rng(1))
+    np.testing.assert_allclose(v, np.asarray(value), atol=ATOL)
+    # log_prob of the numpy-sampled action must match the device dist.
+    np.testing.assert_allclose(
+        logp, np.asarray(dist.log_prob(jnp.asarray(a))), atol=1e-4
+    )
+    # Value-only mirror (overlap GAE baselines) matches the critic head.
+    vf = host_actor.make_ppo_host_value(spec, None)
+    np.testing.assert_allclose(
+        vf(_np_params(params), np.asarray(obs)), np.asarray(value), atol=ATOL
+    )
+
+
+def test_mirror_value_discrete_parity():
+    net = ActorCriticDiscrete(num_actions=3, hidden=(16, 16))
+    obs = jnp.asarray(np.random.default_rng(2).standard_normal((7, 4)), jnp.float32)
+    params = net.init(jax.random.key(0), obs)
+    _, value = net.apply(params, obs)
+    spec = EnvSpec(obs_shape=(4,), action_dim=3, discrete=True)
+    vf = host_actor.make_ppo_host_value(spec, None)
+    np.testing.assert_allclose(
+        vf(_np_params(params), np.asarray(obs)), np.asarray(value), atol=ATOL
+    )
+
+
+def test_mirror_ddpg_parity():
+    cfg = ddpg.DDPGConfig(hidden=(16, 16), warmup_steps=0, exploration_noise=0.0)
+    net = DeterministicActor(action_dim=2, hidden=(16, 16))
+    obs = jnp.asarray(np.random.default_rng(0).standard_normal((5, 3)), jnp.float32)
+    params = net.init(jax.random.key(0), obs)
+    want = np.asarray(net.apply(params, obs))
+
+    spec = EnvSpec(obs_shape=(3,), action_dim=2, discrete=False)
+    act = host_actor.make_ddpg_host_explore(spec, cfg)
+    got = act(_np_params(params), np.asarray(obs), np.random.default_rng(1), 10)
+    np.testing.assert_allclose(got, want, atol=ATOL)
+
+    # Warmup: uniform random in [-1, 1].
+    cfg2 = ddpg.DDPGConfig(hidden=(16, 16), warmup_steps=100)
+    act2 = host_actor.make_ddpg_host_explore(spec, cfg2)
+    r = act2(_np_params(params), np.asarray(obs), np.random.default_rng(1), 10)
+    assert (np.abs(r) <= 1.0).all() and not np.allclose(r, want, atol=1e-3)
+
+
+def test_mirror_sac_deterministic_parts():
+    cfg = sac.SACConfig(hidden=(16, 16), warmup_steps=0)
+    net = SquashedGaussianActor(action_dim=2, hidden=(16, 16))
+    obs = jnp.asarray(np.random.default_rng(0).standard_normal((5, 3)), jnp.float32)
+    params = net.init(jax.random.key(0), obs)
+    dist = net.apply(params, obs)
+
+    p = _np_params(params)["params"]
+    z = host_actor._mlp(p["torso"], np.asarray(obs), host_actor._relu)
+    mean = host_actor._dense(p["mean"], z)
+    log_std = np.clip(
+        host_actor._dense(p["log_std"], z),
+        host_actor._LOG_STD_MIN, host_actor._LOG_STD_MAX,
+    )
+    np.testing.assert_allclose(mean, np.asarray(dist.mean), atol=ATOL)
+    np.testing.assert_allclose(log_std, np.asarray(dist.log_std), atol=ATOL)
+
+    spec = EnvSpec(obs_shape=(3,), action_dim=2, discrete=False)
+    act = host_actor.make_sac_host_explore(spec, cfg)
+    a = act(_np_params(params), np.asarray(obs), np.random.default_rng(1), 10)
+    assert a.shape == (5, 2) and (np.abs(a) < 1.0).all()
+
+
+def test_supports_mirror():
+    net = ActorCriticDiscrete(num_actions=2, hidden=(8,))
+    params = net.init(jax.random.key(0), jnp.zeros((1, 4)))
+    assert host_actor.supports_mirror(jax.device_get(params))
+    # CNN torso → not mirrorable.
+    pix = ActorCriticDiscrete(num_actions=2, pixel_obs=True)
+    pparams = pix.init(jax.random.key(0), jnp.zeros((1, 36, 36, 4), jnp.uint8))
+    assert not host_actor.supports_mirror(jax.device_get(pparams))
+
+
+def test_ppo_host_overlap_trains():
+    cfg = ppo.PPOConfig(
+        num_envs=2, rollout_steps=8, epochs=1, num_minibatches=1, hidden=(16,)
+    )
+    pool = HostEnvPool("CartPole-v1", num_envs=2, seed=0)
+    _, _, history = ppo.train_host(
+        pool, cfg, num_iterations=3, seed=0, log_every=1, overlap=True
+    )
+    assert len(history) == 3
+    assert all(np.isfinite(m["loss"]) for _, m in history)
+    pool.close()
+
+
+def test_ddpg_host_overlap_trains():
+    cfg = ddpg.DDPGConfig(
+        num_envs=2, steps_per_iter=4, updates_per_iter=1, buffer_capacity=256,
+        batch_size=8, warmup_steps=8, hidden=(16,),
+    )
+    pool = HostEnvPool("Pendulum-v1", num_envs=2, seed=0, normalize_reward=False)
+    learner, history = ddpg.train_host(
+        pool, cfg, num_iterations=4, seed=0, log_every=1, overlap=True
+    )
+    assert len(history) == 4
+    assert all(np.isfinite(m["critic_loss"]) for _, m in history)
+    pool.close()

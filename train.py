@@ -151,6 +151,7 @@ def run_host(pool, preset, args, logger) -> dict:
         log_every=args.log_every, log_fn=log_fn,
         eval_every=getattr(args, "eval_every", 0),
         ckpt=ckpt, save_every=args.save_every, resume=args.resume,
+        overlap=not args.no_overlap,
     )
     try:
         if preset.algo == "ppo":
@@ -195,6 +196,11 @@ def main(argv=None) -> int:
         help="greedy-eval cadence in iterations (0 = off)",
     )
     p.add_argument("--quiet", action="store_true", help="no stdout metric echo")
+    p.add_argument(
+        "--no-overlap", action="store_true",
+        help="host envs: disable the numpy actor mirror / async device "
+        "update overlap (A/B baseline; models/host_actor.py)",
+    )
     p.add_argument("--ckpt-dir", help="orbax checkpoint dir")
     p.add_argument("--save-every", type=int, default=100)
     p.add_argument("--resume", action="store_true", help="resume from --ckpt-dir")
@@ -202,6 +208,7 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
 
     from actor_critic_tpu.config import PRESETS, parse_set_args, resolve
+    from actor_critic_tpu.utils.cadence import finite_or_none
     from actor_critic_tpu.utils.logging import JsonlLogger
 
     if args.list_presets:
@@ -236,7 +243,11 @@ def main(argv=None) -> int:
                 "env_steps": args.iterations
                 * steps_per_iteration(preset.algo, preset.config),
                 "wall_s": round(wall, 2),
-                **{k: round(v, 5) for k, v in final.items()},
+                # NaN/Inf → null: the summary line must stay strict JSON
+                **{
+                    k: (None if (f := finite_or_none(v)) is None else round(f, 5))
+                    for k, v in final.items()
+                },
             }
         )
     )
