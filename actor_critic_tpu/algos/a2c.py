@@ -26,8 +26,10 @@ import optax
 from actor_critic_tpu.algos.common import (
     TrainState,
     Transition,
+    anneal_fraction,
     episode_metrics_update,
     init_rollout,
+    linear_anneal,
     rollout_scan,
     truncation_bootstrap_rewards,
 )
@@ -53,6 +55,13 @@ class A2CConfig:
     normalize_adv: bool = False
     # bfloat16 activations for MXU throughput; params/optimizer stay fp32.
     bf16_compute: bool = False
+    # Linear annealing over the first `anneal_iters` train steps (0 = off):
+    # lr → lr_final and entropy_coef → entropy_coef_final, both optional.
+    # The flat-coefficient flagship preset never converged to a solve
+    # (round-2 verdict); annealing is the standard fix.
+    anneal_iters: int = 0
+    lr_final: Optional[float] = None
+    entropy_coef_final: Optional[float] = None
 
 
 def make_network(env: JaxEnv, cfg: A2CConfig):
@@ -74,9 +83,24 @@ def make_eval_fn(env: JaxEnv, cfg: "A2CConfig"):
 
 
 def make_optimizer(cfg: A2CConfig) -> optax.GradientTransformation:
+    lr = cfg.lr
+    if cfg.anneal_iters > 0 and cfg.lr_final is not None:
+        # One optimizer step per train iteration, so the schedule's step
+        # count IS the iteration count.
+        lr = optax.linear_schedule(cfg.lr, cfg.lr_final, cfg.anneal_iters)
     return optax.chain(
         optax.clip_by_global_norm(cfg.max_grad_norm),
-        optax.adam(cfg.lr),
+        optax.adam(lr),
+    )
+
+
+def entropy_coef_at(cfg: A2CConfig, update_step: jax.Array) -> jax.Array:
+    """Current entropy coefficient under the linear anneal (constant when
+    annealing is off)."""
+    return linear_anneal(
+        cfg.entropy_coef,
+        cfg.entropy_coef_final,
+        anneal_fraction(update_step, cfg.anneal_iters),
     )
 
 
@@ -108,13 +132,18 @@ def a2c_loss(
     returns: jax.Array,
     cfg: A2CConfig,
     axis_name: Optional[str] = None,
+    entropy_coef: Optional[jax.Array] = None,
 ) -> tuple[jax.Array, dict[str, jax.Array]]:
     """Policy-gradient + value-MSE + entropy-bonus loss on a [T, E] batch.
 
     Re-evaluates the policy at the stored obs (same params as rollout, so
     ratio==1; the re-evaluation is what makes the loss differentiable).
     `axis_name` keeps advantage-normalization statistics global under dp.
+    `entropy_coef` overrides cfg.entropy_coef (annealing threads the
+    current value through here).
     """
+    if entropy_coef is None:
+        entropy_coef = jnp.asarray(cfg.entropy_coef)
     obs = traj.obs.reshape(-1, *traj.obs.shape[2:])
     actions = traj.action.reshape(-1, *traj.action.shape[2:])
     adv = advantages.reshape(-1)
@@ -128,7 +157,7 @@ def a2c_loss(
 
     pg_loss = -jnp.mean(jax.lax.stop_gradient(adv) * log_prob)
     v_loss = 0.5 * jnp.mean((value - jax.lax.stop_gradient(ret)) ** 2)
-    loss = pg_loss + cfg.value_coef * v_loss - cfg.entropy_coef * entropy
+    loss = pg_loss + cfg.value_coef * v_loss - entropy_coef * entropy
     return loss, {
         "loss": loss,
         "pg_loss": pg_loss,
@@ -178,7 +207,8 @@ def make_train_step(
         # --- update ---
         grad_fn = jax.value_and_grad(a2c_loss, has_aux=True)
         (_, metrics), grads = grad_fn(
-            state.params, apply_fn, traj, advantages, returns, cfg, axis_name
+            state.params, apply_fn, traj, advantages, returns, cfg, axis_name,
+            entropy_coef_at(cfg, state.update_step),
         )
         grads = pmesh.pmean_tree(grads, axis_name)
         updates, new_opt_state = opt.update(grads, state.opt_state, state.params)

@@ -156,6 +156,27 @@ def offpolicy_rollout(
     return rstate, env_steps, traj
 
 
+def anneal_fraction(
+    update_step: jax.Array, anneal_iters: int
+) -> Optional[jax.Array]:
+    """update_step → clipped [0, 1] anneal fraction; None when annealing
+    is off (anneal_iters <= 0). THE progress contract every coefficient
+    schedule shares — compute it once per train step and thread it."""
+    if anneal_iters <= 0:
+        return None
+    return jnp.clip(update_step.astype(jnp.float32) / anneal_iters, 0.0, 1.0)
+
+
+def linear_anneal(
+    initial: float, final, progress: Optional[jax.Array]
+) -> jax.Array:
+    """initial + (final − initial)·progress; the constant `initial` when
+    the schedule is disabled (final is None) or progress is None."""
+    if final is None or progress is None:
+        return jnp.asarray(initial)
+    return initial + (final - initial) * progress
+
+
 def truncation_bootstrap_rewards(
     traj: Transition,
     final_values: jax.Array,
@@ -182,10 +203,13 @@ def evaluate(
     """Greedy eval: mean return of each env's FIRST episode (SURVEY §3.4).
 
     `act_fn(params, obs) -> action` is the deterministic policy (mode /
-    mean action). Rewards stop accumulating at the first `done`; envs
-    whose episode outlives `num_steps` contribute their partial return.
-    One jittable program; used by trainers' periodic eval and the
-    learning tests.
+    mean action). Rewards stop accumulating at the first `done`. Envs
+    whose episode outlives `num_steps` are EXCLUDED from the mean (a
+    partial return would understate exactly when the policy is good);
+    if no env finishes within the horizon, the mean of the partial
+    returns is reported instead — a lower bound, and the only number
+    available. One jittable program; used by trainers' periodic eval
+    and the learning tests.
     """
     keys = jax.random.split(key, num_envs)
     env_state, obs = jax.vmap(env.reset)(keys)
@@ -199,8 +223,19 @@ def evaluate(
         alive = alive * (1.0 - out.done)
         return (out.state, out.obs, ret, alive), None
 
-    (_, _, returns, _), _ = jax.lax.scan(step, init, None, length=num_steps)
-    return jnp.mean(returns)
+    (_, _, returns, alive), _ = jax.lax.scan(step, init, None, length=num_steps)
+    finished = 1.0 - alive
+    n_finished = jnp.sum(finished)
+    finished_mean = jnp.sum(returns * finished) / jnp.maximum(n_finished, 1.0)
+    return jnp.where(n_finished > 0, finished_mean, jnp.mean(returns))
+
+
+def default_eval_steps(env: JaxEnv) -> int:
+    """Eval horizon: the env's episode time-limit plus slack (so a good
+    policy's episodes always FINISH within the eval and are counted), or
+    512 when the env doesn't declare one."""
+    h = env.spec.episode_horizon
+    return h + 8 if h > 0 else 512
 
 
 def make_greedy_eval(
@@ -211,10 +246,11 @@ def make_greedy_eval(
     """THE eval-program factory shared by every algo's `make_eval_fn`:
     `act(params, obs) → action` is the algo's greedy policy, `params_of`
     extracts the acting params from its train state. Returns
-    `eval_fn(state, key, num_envs=32, num_steps=512)` (jit with
-    static_argnums=(2, 3))."""
+    `eval_fn(state, key, num_envs=32, num_steps=default_eval_steps(env))`
+    (jit with static_argnums=(2, 3))."""
+    default_steps = default_eval_steps(env)
 
-    def eval_fn(state, key, num_envs: int = 32, num_steps: int = 512):
+    def eval_fn(state, key, num_envs: int = 32, num_steps: int = default_steps):
         return evaluate(env, act, params_of(state), key, num_envs, num_steps)
 
     return eval_fn
@@ -246,12 +282,13 @@ def episode_metrics_update(
     """
 
     def fold(carry, x):
-        ep_ret, ep_len, avg, n_done, sum_done = carry
+        ep_ret, ep_len, avg, n_done, sum_done, len_done = carry
         reward, done = x
         ep_ret = ep_ret + reward
         ep_len = ep_len + 1.0
         n_done = n_done + jnp.sum(done)
         sum_done = sum_done + jnp.sum(ep_ret * done)
+        len_done = len_done + jnp.sum(ep_len * done)
         # EMA over completed episodes (batch-mean of finished returns).
         batch_done = jnp.sum(done)
         batch_mean = jnp.where(
@@ -260,19 +297,21 @@ def episode_metrics_update(
         avg = jnp.where(batch_done > 0, decay * avg + (1 - decay) * batch_mean, avg)
         ep_ret = ep_ret * (1.0 - done)
         ep_len = ep_len * (1.0 - done)
-        return (ep_ret, ep_len, avg, n_done, sum_done), None
+        return (ep_ret, ep_len, avg, n_done, sum_done, len_done), None
 
-    (ep_return, ep_length, avg_return, n_done, sum_done), _ = jax.lax.scan(
+    (ep_return, ep_length, avg_return, n_done, sum_done, len_done), _ = jax.lax.scan(
         fold,
-        (ep_return, ep_length, avg_return, jnp.zeros(()), jnp.zeros(())),
+        (ep_return, ep_length, avg_return,
+         jnp.zeros(()), jnp.zeros(()), jnp.zeros(())),
         (traj.reward, traj.done),
     )
-    # Raw count and sum so dp callers can psum both and divide AFTER the
+    # Raw count and sums so dp callers can psum them and divide AFTER the
     # reduction (an unweighted pmean of per-device means would bias toward
     # devices with zero finished episodes).
     metrics = {
         "episodes_finished": n_done,
         "finished_return_sum": sum_done,
+        "finished_length_sum": len_done,
         "avg_return_ema": avg_return,
     }
     return ep_return, ep_length, avg_return, metrics

@@ -19,6 +19,9 @@ def aggregate_metrics(
     out = {k: pmesh.pmean(v, axis_name) for k, v in metrics.items()}
     out["episodes_finished"] = n
     out["mean_finished_return"] = s / jnp.maximum(n, 1.0)
+    if "finished_length_sum" in ep_metrics:
+        ln = pmesh.psum(ep_metrics["finished_length_sum"], axis_name)
+        out["mean_ep_length"] = ln / jnp.maximum(n, 1.0)
     # avg_return_ema is pmean'd by the caller before state update.
     out["avg_return_ema"] = ep_metrics["avg_return_ema"]
     return out

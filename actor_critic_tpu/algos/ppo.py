@@ -27,8 +27,10 @@ import optax
 
 from actor_critic_tpu.algos.common import (
     TrainState,
+    anneal_fraction,
     episode_metrics_update,
     init_rollout,
+    linear_anneal,
     rollout_scan,
     truncation_bootstrap_rewards,
 )
@@ -57,6 +59,14 @@ class PPOConfig:
     hidden: tuple[int, ...] = (64, 64)
     normalize_adv: bool = True
     bf16_compute: bool = False
+    # Linear annealing over the first `anneal_iters` iterations (0 = off):
+    # lr → lr_final (per optimizer step, scaled by epochs×minibatches) and
+    # clip_eps → clip_eps_final. Long MuJoCo runs (HalfCheetah → 3000)
+    # want both; round-2 verdict carried this as a known gap.
+    anneal_iters: int = 0
+    lr_final: Optional[float] = None
+    clip_eps_final: Optional[float] = None
+    entropy_coef_final: Optional[float] = None
 
 
 class PPOBatch(NamedTuple):
@@ -89,10 +99,34 @@ def make_eval_fn(env: JaxEnv, cfg: "PPOConfig"):
 
 
 def make_optimizer(cfg: PPOConfig) -> optax.GradientTransformation:
+    lr = cfg.lr
+    if cfg.anneal_iters > 0 and cfg.lr_final is not None:
+        # The optimizer steps epochs×minibatches times per iteration, so
+        # the schedule horizon is in optimizer steps, not iterations.
+        lr = optax.linear_schedule(
+            cfg.lr, cfg.lr_final,
+            cfg.anneal_iters * cfg.epochs * cfg.num_minibatches,
+        )
     return optax.chain(
         optax.clip_by_global_norm(cfg.max_grad_norm),
-        optax.adam(cfg.lr, eps=1e-5),
+        optax.adam(lr, eps=1e-5),
     )
+
+
+def clip_eps_at(cfg: PPOConfig, progress: Optional[jax.Array]) -> jax.Array:
+    """Current clip-ε under the linear anneal; `progress` per the
+    common.anneal_fraction contract."""
+    return linear_anneal(cfg.clip_eps, cfg.clip_eps_final, progress)
+
+
+def entropy_coef_at(cfg: PPOConfig, progress: Optional[jax.Array]) -> jax.Array:
+    """Current entropy coefficient under the linear anneal."""
+    return linear_anneal(cfg.entropy_coef, cfg.entropy_coef_final, progress)
+
+
+def anneal_progress(cfg: PPOConfig, update_step: jax.Array) -> Optional[jax.Array]:
+    """update_step → clipped [0, 1] anneal fraction (None when off)."""
+    return anneal_fraction(update_step, cfg.anneal_iters)
 
 
 def ppo_loss(
@@ -101,8 +135,16 @@ def ppo_loss(
     batch: PPOBatch,
     cfg: PPOConfig,
     axis_name: Optional[str] = None,
+    clip_eps: Optional[jax.Array] = None,
+    entropy_coef: Optional[jax.Array] = None,
 ) -> tuple[jax.Array, dict[str, jax.Array]]:
-    """Clipped-surrogate + clipped-value + entropy loss on a minibatch."""
+    """Clipped-surrogate + clipped-value + entropy loss on a minibatch.
+    `clip_eps`/`entropy_coef` override the cfg constants (annealing
+    threads the current values through here)."""
+    if clip_eps is None:
+        clip_eps = jnp.asarray(cfg.clip_eps)
+    if entropy_coef is None:
+        entropy_coef = jnp.asarray(cfg.entropy_coef)
     dist, value = apply_fn(params, batch.obs)
     log_prob = dist.log_prob(batch.action)
     entropy = jnp.mean(dist.entropy())
@@ -114,7 +156,7 @@ def ppo_loss(
     log_ratio = log_prob - batch.log_prob_old
     ratio = jnp.exp(log_ratio)
     surr1 = ratio * adv
-    surr2 = jnp.clip(ratio, 1.0 - cfg.clip_eps, 1.0 + cfg.clip_eps) * adv
+    surr2 = jnp.clip(ratio, 1.0 - clip_eps, 1.0 + clip_eps) * adv
     pg_loss = -jnp.mean(jnp.minimum(surr1, surr2))
 
     if cfg.vf_clip > 0:
@@ -127,10 +169,10 @@ def ppo_loss(
     else:
         v_loss = 0.5 * jnp.mean((value - batch.ret) ** 2)
 
-    loss = pg_loss + cfg.value_coef * v_loss - cfg.entropy_coef * entropy
+    loss = pg_loss + cfg.value_coef * v_loss - entropy_coef * entropy
     # Schulman's low-variance KL estimator: E[(r-1) - log r].
     approx_kl = jnp.mean((ratio - 1.0) - log_ratio)
-    clip_frac = jnp.mean((jnp.abs(ratio - 1.0) > cfg.clip_eps).astype(jnp.float32))
+    clip_frac = jnp.mean((jnp.abs(ratio - 1.0) > clip_eps).astype(jnp.float32))
     return loss, {
         "loss": loss,
         "pg_loss": pg_loss,
@@ -150,24 +192,30 @@ def ppo_update(
     opt: optax.GradientTransformation,
     cfg: PPOConfig,
     axis_name: Optional[str] = None,
+    progress: Optional[jax.Array] = None,
 ) -> tuple[Any, Any, dict[str, jax.Array]]:
     """E epochs × M shuffled minibatches of PPO updates, all in-jit.
 
     The batch size B must be divisible by num_minibatches. Under dp,
     each device shuffles its local shard; gradients pmean per minibatch
     (the ICI analogue of the reference's per-step NCCL all-reduce).
+    `progress` is the anneal fraction in [0, 1] (clip-ε schedule).
     """
     B = batch.obs.shape[0]
     mb = B // cfg.num_minibatches
     if B % cfg.num_minibatches != 0:
         raise ValueError(f"batch {B} % minibatches {cfg.num_minibatches} != 0")
 
+    clip_eps = clip_eps_at(cfg, progress)
+    ent_coef = entropy_coef_at(cfg, progress)
     grad_fn = jax.value_and_grad(ppo_loss, has_aux=True)
 
     def minibatch_body(carry, idx):
         params, opt_state = carry
         mb_batch = jax.tree.map(lambda x: x[idx], batch)
-        (_, metrics), grads = grad_fn(params, apply_fn, mb_batch, cfg, axis_name)
+        (_, metrics), grads = grad_fn(
+            params, apply_fn, mb_batch, cfg, axis_name, clip_eps, ent_coef
+        )
         grads = pmesh.pmean_tree(grads, axis_name)
         updates, opt_state = opt.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
@@ -242,7 +290,7 @@ def make_host_update_step(env_spec, cfg: PPOConfig, can_truncate: bool = True):
     def update(
         params, opt_state, obs, action, log_prob, value, reward, done,
         terminated, final_obs, last_obs, key,
-        final_values=None, bootstrap_value=None,
+        final_values=None, bootstrap_value=None, progress=None,
     ):
         T, E = reward.shape
         if bootstrap_value is None:
@@ -268,7 +316,10 @@ def make_host_update_step(env_spec, cfg: PPOConfig, can_truncate: bool = True):
             advantage=advantages.reshape(T * E),
             ret=returns.reshape(T * E),
         )
-        return ppo_update(params, opt_state, batch, key, apply_fn, opt, cfg)
+        return ppo_update(
+            params, opt_state, batch, key, apply_fn, opt, cfg,
+            progress=progress,
+        )
 
     return update
 
@@ -416,6 +467,10 @@ def train_host(
             # during collection — so no wait); the update dispatched below
             # then overlaps the next rollout.
             host_params = jax.device_get(params)
+        if cfg.anneal_iters > 0:
+            extra_values["progress"] = jnp.asarray(
+                min(it / cfg.anneal_iters, 1.0), jnp.float32
+            )
         params, opt_state, metrics = update(
             params, opt_state,
             arrays["obs"], arrays["action"], arrays["log_prob"],
@@ -488,7 +543,8 @@ def make_train_step(
             ret=returns.reshape(T * E),
         )
         new_params, new_opt_state, metrics = ppo_update(
-            state.params, state.opt_state, batch, ukey, apply_fn, opt, cfg, axis_name
+            state.params, state.opt_state, batch, ukey, apply_fn, opt, cfg,
+            axis_name, progress=anneal_progress(cfg, state.update_step),
         )
 
         ep_ret, ep_len, avg_ret, ep_metrics = episode_metrics_update(

@@ -82,6 +82,8 @@ def _raw_step(state: CartPoleState, action: jax.Array):
 
 
 def make_cartpole() -> JaxEnv:
-    spec = EnvSpec(obs_shape=(4,), action_dim=2, discrete=True)
+    spec = EnvSpec(
+        obs_shape=(4,), action_dim=2, discrete=True, episode_horizon=500
+    )
     step = auto_reset(_reset, _raw_step, key_of_state=lambda s: s.key)
     return JaxEnv(spec=spec, reset=_reset, step=step)

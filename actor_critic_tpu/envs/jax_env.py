@@ -50,6 +50,11 @@ class EnvSpec:
     # False ⇒ episodes only ever terminate (never time-limit truncate), so
     # trainers can statically skip the truncation-bootstrap forward pass.
     can_truncate: bool = True
+    # Upper bound on episode length (the time-limit), 0 = unknown. Eval
+    # programs size their rollout horizon from this so a good policy's
+    # still-running episodes are never cut (and then wrongly excluded
+    # from the finished-episode mean — common.evaluate docstring).
+    episode_horizon: int = 0
 
 
 @dataclasses.dataclass(frozen=True)

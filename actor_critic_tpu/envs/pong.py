@@ -173,7 +173,7 @@ def make_pong(
 
     spec = EnvSpec(
         obs_shape=(size, size, 2), action_dim=3, discrete=True,
-        obs_dtype=jnp.uint8,
+        obs_dtype=jnp.uint8, episode_horizon=max_steps,
     )
     step = auto_reset(reset, raw_step, key_of_state=lambda s: s.key)
     return JaxEnv(spec=spec, reset=reset, step=step)

@@ -48,7 +48,7 @@ def make_bandit(payouts=(0.2, 0.9, 0.4)) -> JaxEnv:
     return JaxEnv(
         spec=EnvSpec(
             obs_shape=(1,), action_dim=len(payouts), discrete=True,
-            can_truncate=False,
+            can_truncate=False, episode_horizon=1,
         ),
         reset=reset,
         step=auto_reset(reset, raw_step, key_of_state=lambda s: s.key),
@@ -88,7 +88,10 @@ def make_two_state_mdp(horizon: int = 8) -> JaxEnv:
         return nstate, obs_of(action), reward, terminated, truncated
 
     return JaxEnv(
-        spec=EnvSpec(obs_shape=(2,), action_dim=2, discrete=True),
+        spec=EnvSpec(
+            obs_shape=(2,), action_dim=2, discrete=True,
+            episode_horizon=horizon,
+        ),
         reset=reset,
         step=auto_reset(reset, raw_step, key_of_state=lambda s: s.key),
     )
@@ -128,7 +131,10 @@ def make_point_mass(horizon: int = 16, pos_clip: float = 2.0) -> JaxEnv:
         return nstate, npos[None], reward, terminated, truncated
 
     return JaxEnv(
-        spec=EnvSpec(obs_shape=(1,), action_dim=1, discrete=False),
+        spec=EnvSpec(
+            obs_shape=(1,), action_dim=1, discrete=False,
+            episode_horizon=horizon,
+        ),
         reset=reset,
         step=auto_reset(reset, raw_step, key_of_state=lambda s: s.key),
     )

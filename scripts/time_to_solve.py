@@ -1,0 +1,129 @@
+"""Wall-clock-to-solve harness for fused (pure-JAX env) presets.
+
+Measures the BASELINE.json:2 primary metric "wall-clock to target return
+(CartPole)": from COLD process start (t0 is taken before jax is even
+imported, so backend init and XLA compilation are charged to the number)
+to the first time the greedy-eval return clears the threshold on
+`--consecutive` consecutive evals (two by default — a single lucky eval
+must not count as a solve, cf. the round-2 oscillation 397→148→429).
+
+Usage:
+    python scripts/time_to_solve.py --preset ppo_cartpole \
+        --threshold 475 --chunk 10 --out results/cartpole_solve.json
+
+Prints one JSON line per eval and a final summary JSON; with --out the
+full trace is written to disk (checked-in evidence for BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+T0 = time.perf_counter()  # cold start: before jax import
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--preset", default="ppo_cartpole")
+    p.add_argument("--threshold", type=float, default=475.0)
+    p.add_argument("--chunk", type=int, default=10, help="iterations per eval")
+    p.add_argument("--max-iters", type=int, default=0, help="0 = preset default")
+    p.add_argument("--consecutive", type=int, default=2)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--eval-envs", type=int, default=64)
+    p.add_argument("--eval-steps", type=int, default=512)
+    p.add_argument("--out", default="")
+    p.add_argument("--set", action="append", default=[], metavar="KEY=VALUE")
+    args = p.parse_args()
+
+    import dataclasses
+
+    import jax
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from actor_critic_tpu.config import PRESETS, apply_overrides, parse_set_args
+    from train import build_env, fused_module
+
+    preset = PRESETS[args.preset]
+    if args.set:
+        preset = dataclasses.replace(
+            preset, config=apply_overrides(preset.config, parse_set_args(args.set))
+        )
+    env, fused = build_env(preset.env, preset.algo, preset.config, args.seed)
+    if not fused:
+        raise SystemExit("time_to_solve drives fused presets only")
+    mod = fused_module(preset.algo)
+    cfg = preset.config
+    max_iters = args.max_iters or preset.iterations
+
+    state = mod.init_state(env, cfg, jax.random.key(args.seed))
+    step = mod.make_train_step(env, cfg)
+    eval_fn = jax.jit(mod.make_eval_fn(env, cfg), static_argnums=(2, 3))
+    eval_key = jax.random.key(args.seed + 1)
+
+    @jax.jit
+    def run_chunk(state):
+        def body(s, _):
+            s, m = step(s)
+            return s, None
+
+        s, _ = jax.lax.scan(body, state, None, length=args.chunk - 1)
+        return step(s)  # last iteration reports metrics
+
+    spi = (
+        cfg.rollout_steps * cfg.num_envs
+        if hasattr(cfg, "rollout_steps")
+        else cfg.steps_per_iter * cfg.num_envs
+    )
+    trace: list[dict] = []
+    streak = 0
+    solved_at = None
+    it = 0
+    while it < max_iters:
+        state, metrics = run_chunk(state)
+        it += args.chunk
+        ev = float(eval_fn(state, eval_key, args.eval_envs, args.eval_steps))
+        row = {
+            "iter": it,
+            "env_steps": it * spi,
+            "wall_s": round(time.perf_counter() - T0, 2),
+            "eval_return": round(ev, 1),
+            "train_return_ema": round(float(metrics["avg_return_ema"]), 1),
+        }
+        trace.append(row)
+        print(json.dumps(row), flush=True)
+        streak = streak + 1 if ev >= args.threshold else 0
+        if streak >= args.consecutive:
+            solved_at = row
+            break
+
+    summary = {
+        "preset": args.preset,
+        "platform": jax.default_backend(),
+        "threshold": args.threshold,
+        "consecutive": args.consecutive,
+        "solved": solved_at is not None,
+        "wall_s_to_solve": solved_at["wall_s"] if solved_at else None,
+        "env_steps_to_solve": solved_at["env_steps"] if solved_at else None,
+        "iters_to_solve": solved_at["iter"] if solved_at else None,
+        "final_eval": trace[-1]["eval_return"] if trace else None,
+        "config": {
+            k: v
+            for k, v in vars(cfg).items()
+            if isinstance(v, (int, float, bool, str))
+        },
+    }
+    print(json.dumps(summary), flush=True)
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump({"summary": summary, "trace": trace}, f, indent=1)
+    return 0 if solved_at is not None else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())
