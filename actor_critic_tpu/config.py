@@ -59,8 +59,9 @@ PRESETS: dict[str, Preset] = {
         config=ppo.PPOConfig(
             num_envs=8, rollout_steps=256, epochs=10, num_minibatches=32,
             entropy_coef=0.0, lr=3e-4,
+            anneal_iters=1000, lr_final=0.0,
         ),
-        iterations=500,
+        iterations=1000,
         description="PPO-clip on MuJoCo HalfCheetah-v5 (BASELINE.json:8)",
     ),
     # BASELINE.json:9 — off-policy with the HBM replay ring.

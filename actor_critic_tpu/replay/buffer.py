@@ -17,8 +17,11 @@ updates the multi-GB storage in place instead of copying it each step
 
 Sharding: under data-parallel training each device holds an independent
 shard of the ring (its own envs feed it, its own sampler reads it) — the
-buffer needs no collectives, so `ReplayState` simply takes `P("dp")` in
-the dp PartitionSpec tree.
+buffer needs no collectives. `parallel.dp.replay_specs()` builds the
+PartitionSpec tree (storage's capacity axis split over dp, cursor
+scalars replicated) and `parallel.dp.offpolicy_state_specs()` /
+`sac_state_specs()` embed it in the full trainer-state layout; tested by
+tests/test_parallel.py's off-policy dp cases on the 8-device CPU mesh.
 """
 
 from __future__ import annotations

@@ -22,6 +22,7 @@ import json
 import os
 import sys
 import time
+from functools import partial
 
 T0 = time.perf_counter()  # cold start: before jax import
 
@@ -65,7 +66,7 @@ def main() -> int:
     eval_fn = jax.jit(mod.make_eval_fn(env, cfg), static_argnums=(2, 3))
     eval_key = jax.random.key(args.seed + 1)
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=0)
     def run_chunk(state):
         def body(s, _):
             s, m = step(s)
@@ -86,7 +87,11 @@ def main() -> int:
     while it < max_iters:
         state, metrics = run_chunk(state)
         it += args.chunk
-        ev = float(eval_fn(state, eval_key, args.eval_envs, args.eval_steps))
+        # Fresh subkey per eval: consecutive solve evals must draw
+        # INDEPENDENT initial-state sets, or the anti-luck guard is
+        # defeated by perfectly correlated draws.
+        eval_key, ekey = jax.random.split(eval_key)
+        ev = float(eval_fn(state, ekey, args.eval_envs, args.eval_steps))
         row = {
             "iter": it,
             "env_steps": it * spi,
