@@ -146,12 +146,21 @@ def impala_loss(
     bootstrap_obs: jax.Array,
     cfg: ImpalaConfig,
     can_truncate: bool = True,
+    time_axis_name: Optional[str] = None,
 ) -> tuple[jax.Array, dict[str, jax.Array]]:
     """V-trace (or A3C λ-return) actor-critic loss on a [T, E] trajectory.
 
     The learner re-evaluates π/V at the stored observations; `traj.log_prob`
     holds the BEHAVIOUR policy's log-probs from rollout time, so the
     ρ = π/μ importance ratios are exact even under parameter staleness.
+
+    With `time_axis_name` the function runs INSIDE shard_map with the
+    trajectory's TIME axis sharded over that mesh axis (sequence
+    parallelism, SURVEY.md §5.7): the V-trace/GAE recurrences go through
+    `parallel.seqpar` (halo exchange + per-segment affine scan + boundary
+    chain over ICI), and the returned loss/metrics are LOCAL means whose
+    gradients the caller must pmean over the axis (equal time shards make
+    the pmean of local-mean grads exactly the global-mean grad).
     """
     T, E = traj.reward.shape
     obs = traj.obs.reshape(T * E, *traj.obs.shape[2:])
@@ -176,25 +185,43 @@ def impala_loss(
     values_ng = jax.lax.stop_gradient(values)
     bootstrap_ng = jax.lax.stop_gradient(bootstrap_value)
     if cfg.correction == "vtrace":
-        vt = vtrace(
-            jax.lax.stop_gradient(target_log_probs),
-            traj.log_prob,
-            rewards,
-            values_ng,
-            traj.done,
-            bootstrap_ng,
-            cfg.gamma,
-            rho_bar=cfg.rho_bar,
-            c_bar=cfg.c_bar,
-            lam=cfg.lam,
-        )
+        if time_axis_name is not None:
+            from actor_critic_tpu.parallel.seqpar import seqpar_vtrace
+
+            vt = seqpar_vtrace(
+                jax.lax.stop_gradient(target_log_probs),
+                traj.log_prob, rewards, values_ng, traj.done, bootstrap_ng,
+                cfg.gamma, rho_bar=cfg.rho_bar, c_bar=cfg.c_bar, lam=cfg.lam,
+                axis_name=time_axis_name,
+            )
+        else:
+            vt = vtrace(
+                jax.lax.stop_gradient(target_log_probs),
+                traj.log_prob,
+                rewards,
+                values_ng,
+                traj.done,
+                bootstrap_ng,
+                cfg.gamma,
+                rho_bar=cfg.rho_bar,
+                c_bar=cfg.c_bar,
+                lam=cfg.lam,
+            )
         value_targets = vt.vs
         pg_advantages = vt.pg_advantages
         mean_rho = jnp.mean(vt.clipped_rhos)
     else:  # A3C: λ-return advantages, no importance correction
-        pg_advantages, value_targets = gae(
-            rewards, values_ng, traj.done, bootstrap_ng, cfg.gamma, cfg.lam
-        )
+        if time_axis_name is not None:
+            from actor_critic_tpu.parallel.seqpar import seqpar_gae
+
+            pg_advantages, value_targets = seqpar_gae(
+                rewards, values_ng, traj.done, bootstrap_ng, cfg.gamma,
+                cfg.lam, axis_name=time_axis_name,
+            )
+        else:
+            pg_advantages, value_targets = gae(
+                rewards, values_ng, traj.done, bootstrap_ng, cfg.gamma, cfg.lam
+            )
         mean_rho = jnp.ones(())
 
     pg_loss = -jnp.mean(jax.lax.stop_gradient(pg_advantages) * target_log_probs)
@@ -267,6 +294,52 @@ def make_train_step(
         return new_state, metrics
 
     return train_step
+
+
+def make_sp_update(env: JaxEnv, cfg: ImpalaConfig, mesh, axis_name=None):
+    """Sequence-parallel learner update for LONG trajectories (SURVEY.md
+    §5.7 made load-bearing): the [T, E] trajectory's TIME axis is sharded
+    over the mesh's "sp" axis, so each device forwards π/V on its T/D
+    slice, the V-trace (or λ-return) recurrence runs through
+    `parallel.seqpar` (one ppermute halo + per-segment affine scan + a
+    tiny all_gather boundary chain — collectives ride ICI), and gradients
+    pmean over the axis. Per-device activation memory and scan length
+    drop from O(T) to O(T/D): trajectories too long for one device's HBM
+    (or one scan's latency budget) become trainable.
+
+    Returns jitted `(params, opt_state, traj, bootstrap_obs) →
+    (params, opt_state, metrics)` on GLOBAL [T, E] arrays; T must divide
+    by the mesh's sp size. Metric-equivalence with the unsharded update
+    is tested on the 8-device CPU mesh (tests/test_seqpar.py).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from actor_critic_tpu.parallel.seqpar import SP_AXIS
+
+    axis_name = axis_name or SP_AXIS
+    net = make_network(env, cfg)
+    opt = make_optimizer(cfg)
+
+    def local_update(params, opt_state, traj, bootstrap_obs):
+        grad_fn = jax.value_and_grad(impala_loss, has_aux=True)
+        (_, metrics), grads = grad_fn(
+            params, net.apply, traj, bootstrap_obs, cfg,
+            env.spec.can_truncate, axis_name,
+        )
+        grads = pmesh.pmean_tree(grads, axis_name)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        metrics = {k: pmesh.pmean(v, axis_name) for k, v in metrics.items()}
+        return params, opt_state, metrics
+
+    fn = jax.shard_map(
+        local_update,
+        mesh=mesh,
+        in_specs=(P(), P(), P(axis_name), P()),
+        out_specs=(P(), P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(fn)
 
 
 def train(

