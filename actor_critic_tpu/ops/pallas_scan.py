@@ -51,6 +51,19 @@ def _use_interpret() -> bool:
 _VMEM_BUDGET_BYTES = 10 * 2**20
 
 
+# Live (T, be) f32 blocks per op: inputs + outputs + carries.
+_N_ARRAYS = {"gae": 7, "vtrace": 11}
+
+
+def kernel_block(op: str, T: int, E: int, block_envs: int = _DEFAULT_BLOCK_E) -> int:
+    """The env-lane tile the `op` ("gae" | "vtrace") kernel would use on a
+    [T, E] f32 batch — 0 means the call would silently fall back to the
+    lax.scan reference (T too long for any VMEM-resident tile). Public so
+    benches and tests can ASSERT the kernel actually engages before
+    attributing a measurement to it."""
+    return _pick_block(E, block_envs, T, _N_ARRAYS[op])
+
+
 def _pick_block(E: int, block_e: int, T: int, n_arrays: int) -> int:
     """Env-lane tile that (a) divides E, (b) is a multiple of the 128-lane
     f32 Mosaic tile (narrower/ragged blocks only ever compile on real TPU
@@ -98,7 +111,7 @@ def gae(
     if rewards.ndim != 2 or rewards.dtype != jnp.float32:
         return _returns.gae(rewards, values, dones, bootstrap_value, gamma, lam)
     T, E = rewards.shape
-    be = _pick_block(E, block_envs, T, n_arrays=7)  # 3 in + 2 out + 2 carry
+    be = _pick_block(E, block_envs, T, _N_ARRAYS["gae"])  # 3 in + 2 out + 2 carry
     if be == 0:  # T too long for any VMEM-resident tile
         return _returns.gae(rewards, values, dones, bootstrap_value, gamma, lam)
     dones = dones.astype(jnp.float32)
@@ -210,7 +223,7 @@ def vtrace(
             bootstrap_value, gamma, rho_bar, c_bar, lam,
         )
     T, E = rewards.shape
-    be = _pick_block(E, block_envs, T, n_arrays=11)  # 5 in + 3 out + 3 carry
+    be = _pick_block(E, block_envs, T, _N_ARRAYS["vtrace"])  # 5 in + 3 out + 3 carry
     if be == 0:  # T too long for any VMEM-resident tile
         return _returns.vtrace(
             target_log_probs, behaviour_log_probs, rewards, values, dones,

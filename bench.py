@@ -225,15 +225,18 @@ def main() -> None:
     steps = calls * iters_per_call * T * E
     sps = steps / dt
 
-    # FLOPs sanity line (round-2 verdict weak #1): per-env-step compute for
-    # the 4→64→64→{2,1} MLP is 5 forward-equivalents (rollout fwd = 1,
+    # FLOPs sanity line (round-2 verdict weak #1): per-env-step compute is
+    # 5 forward-equivalents of the ACTUAL bench network (rollout fwd = 1,
     # update fwd+bwd ≈ 3, truncation final-obs values fwd = 1) at
-    # 2·Σ(in·out) FLOPs each. The implied sustained-FLOPs figure
-    # lets a reader check the number against real silicon: a v5e peaks at
-    # ~197 TFLOP/s (bf16); an implied figure far above that means the axon
-    # device's wall-times must be read longitudinally, not as v5e silicon.
-    h = (4, 64, 64)
-    fwd_flops = 2 * sum(a * b for a, b in zip(h, h[1:])) + 2 * 64 * (2 + 1)
+    # 2·Σ(in·out) FLOPs each — derived from cfg/env so the emitted model
+    # can never silently drift from what ran. The implied sustained-FLOPs
+    # figure lets a reader check the number against real silicon: a v5e
+    # peaks at ~197 TFLOP/s (bf16); an implied figure far above that means
+    # the axon device's wall-times must be read longitudinally, not as
+    # v5e silicon.
+    dims = (env.spec.obs_shape[0], *cfg.hidden)
+    fwd_flops = 2 * sum(a * b for a, b in zip(dims, dims[1:]))
+    fwd_flops += 2 * cfg.hidden[-1] * (env.spec.action_dim + 1)
     flops_per_step = 5 * fwd_flops
     implied_tflops = sps * flops_per_step / 1e12
     print(

@@ -164,9 +164,13 @@ def bench_host_native():
 def bench_pallas_ops():
     """Per-op evidence for the Pallas scan kernels (round-2 verdict #5):
     time the lax.scan reference (`ops.returns`) against the Pallas
-    kernels (`ops.pallas_scan`) at the headline bench shape, under
-    identical jit + block_until_ready fencing. Reports the GAE pair;
-    the V-trace pair rides along in the extra fields."""
+    kernels (`ops.pallas_scan`) under identical jit + block_until_ready
+    fencing. The headline metric/value is the LONG-T V-trace speedup;
+    the GAE pair and the short (headline-trainer) shape ride along in
+    the extra fields. Every per-shape record carries the kernel tile
+    each op would use (`*_kernel_block`, via pallas_scan.kernel_block) —
+    0 there means the Pallas call silently fell back to lax.scan, and a
+    'speedup' would be measurement noise, not kernel evidence."""
     from actor_critic_tpu.ops import pallas_scan, returns
 
     def timeit(fn, *args, reps=50):
@@ -192,6 +196,8 @@ def bench_pallas_ops():
         vt_scan = jax.jit(lambda *a: returns.vtrace(*a, 0.99))
         vt_pl = jax.jit(lambda *a: pallas_scan.vtrace(*a, 0.99))
         return {
+            "gae_kernel_block": pallas_scan.kernel_block("gae", T, E),
+            "vtrace_kernel_block": pallas_scan.kernel_block("vtrace", T, E),
             "gae_scan_us": round(timeit(gae_scan, r, v, d, b) * 1e6, 1),
             "gae_pallas_us": round(timeit(gae_pl, r, v, d, b) * 1e6, 1),
             "vtrace_scan_us": round(timeit(vt_scan, tlp, blp, r, v, d, b) * 1e6, 1),
@@ -201,15 +207,19 @@ def bench_pallas_ops():
     # Headline bench shape (T=32): both implementations sit at dispatch
     # latency — the Pallas win there is the FUSED trainer's elimination
     # of T sequential scan steps, not this isolated op. Long-T (the
-    # IMPALA/seqpar regime) is where the per-op gap shows.
+    # IMPALA/seqpar regime) is where the per-op gap can show; T=1024 is
+    # the longest T where the 11-array V-trace kernel still fits a
+    # 128-lane tile in VMEM (kernel_block > 0 — larger T falls back).
     short = shape_case(32, 4096)
-    long = shape_case(2048, 256)
+    long = shape_case(1024, 256)
+    assert long["vtrace_kernel_block"] > 0, "vtrace kernel must engage"
+    assert long["gae_kernel_block"] > 0, "gae kernel must engage"
     return {
         "metric": "pallas_vtrace_speedup_longT",
         "value": round(long["vtrace_scan_us"] / long["vtrace_pallas_us"], 2),
-        "unit": "x over lax.scan (T=2048, E=256)",
+        "unit": "x over lax.scan (T=1024, E=256)",
         "T32_E4096": short,
-        "T2048_E256": long,
+        "T1024_E256": long,
         "gae_speedup_longT": round(
             long["gae_scan_us"] / long["gae_pallas_us"], 2
         ),

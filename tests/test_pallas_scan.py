@@ -106,3 +106,22 @@ def test_vtrace_matches_golden(traj):
             np.asarray(getattr(got, name)), np.asarray(getattr(golden, name)),
             rtol=1e-5, atol=1e-6, err_msg=name,
         )
+
+
+def test_kernel_block_engagement():
+    """kernel_block must report exactly when the kernels engage vs fall
+    back — benches and future callers rely on it to avoid attributing
+    lax.scan timings to Pallas (the T=2048 V-trace fallback burned the
+    round-3 bench once already)."""
+    from actor_critic_tpu.ops import pallas_scan as ps
+
+    # 11-array V-trace: T=2048 exceeds the VMEM tile budget → fallback.
+    assert ps.kernel_block("vtrace", 2048, 256) == 0
+    # T=1024 still fits a 128-lane tile.
+    assert ps.kernel_block("vtrace", 1024, 256) == 128
+    # 7-array GAE fits at T=2048.
+    assert ps.kernel_block("gae", 2048, 256) == 128
+    # Headline trainer shape: full default tile.
+    assert ps.kernel_block("gae", 32, 4096) == 512
+    # E not a multiple of 128 → no legal tile.
+    assert ps.kernel_block("gae", 32, 100) == 0
