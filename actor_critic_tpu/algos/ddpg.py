@@ -429,7 +429,10 @@ def train_host(
     Returns (learner, history).
     """
     from actor_critic_tpu.algos.host_loop import off_policy_train_host
-    from actor_critic_tpu.models.host_actor import make_ddpg_host_explore
+    from actor_critic_tpu.models.host_actor import (
+        make_ddpg_host_explore,
+        make_ddpg_host_greedy,
+    )
 
     return off_policy_train_host(
         pool, cfg, num_iterations,
@@ -440,4 +443,5 @@ def train_host(
         eval_every=eval_every, make_greedy_act=make_greedy_act,
         ckpt=ckpt, save_every=save_every, resume=resume,
         overlap=overlap, make_host_explore=make_ddpg_host_explore,
+        make_host_greedy=make_ddpg_host_greedy,
     )

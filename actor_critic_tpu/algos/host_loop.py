@@ -173,6 +173,7 @@ def off_policy_train_host(
     resume: bool = False,
     overlap: bool = True,
     make_host_explore: Optional[Callable] = None,
+    make_host_greedy: Optional[Callable] = None,
 ):
     """Shared host-env loop for the off-policy trainers (DDPG/TD3, SAC).
 
@@ -209,10 +210,17 @@ def off_policy_train_host(
     act = make_act_fn(pool.spec.action_dim, cfg)
     ingest_update = make_ingest_update(pool.spec.action_dim, cfg)
 
-    eval_pool = greedy = None
+    eval_pool = greedy = host_greedy = None
     if eval_every > 0 and make_greedy_act is not None:
         eval_pool = pool.eval_pool(eval_envs)
         greedy = jax.jit(make_greedy_act(pool.spec.action_dim, cfg))
+        if make_host_greedy is not None:
+            from actor_critic_tpu.models import host_actor
+
+            if host_actor.supports_mirror(jax.device_get(learner.actor_params)):
+                # Evals otherwise pay a device round-trip per step
+                # (~26 ms on the tunnel × up to eval_steps).
+                host_greedy = make_host_greedy(pool.spec, cfg)
 
     env_steps = 0
     start_it = 0
@@ -291,10 +299,19 @@ def off_policy_train_host(
         )
         extra = {"env_steps": env_steps}
         if eval_pool is not None and (it + 1) % eval_every == 0:
+            # NB: a fresh name — `act` is the jitted explore fn that the
+            # non-mirror explore_act closure reads late-bound; rebinding
+            # it here would crash collection after the first eval.
+            if host_greedy is not None:
+                # Blocks on the in-flight update: eval sees CURRENT params.
+                ev_params = jax.device_get(learner.actor_params)
+                eval_act = lambda o: np.asarray(host_greedy(ev_params, o))  # noqa: E731
+            else:
+                eval_act = lambda o: np.asarray(  # noqa: E731
+                    greedy(learner.actor_params, jnp.asarray(o))
+                )
             extra["eval_return"] = host_evaluate(
-                eval_pool,
-                lambda o: np.asarray(greedy(learner.actor_params, jnp.asarray(o))),
-                max_steps=eval_steps,
+                eval_pool, eval_act, max_steps=eval_steps
             )
         maybe_log(
             it, log_every, metrics, tracker, history, log_fn,

@@ -392,10 +392,17 @@ def train_host(
     policy_step = make_policy_step(pool.spec, cfg)
     update = make_host_update_step(pool.spec, cfg, can_truncate=True)
 
-    eval_pool = greedy = None
+    eval_pool = greedy = host_greedy = None
     if eval_every > 0:
+        from actor_critic_tpu.models import host_actor
+
         eval_pool = pool.eval_pool(eval_envs)
         greedy = jax.jit(make_greedy_act(pool.spec, cfg))
+        if host_actor.supports_mirror(jax.device_get(params)):
+            # Mirror the mode policy on the host: a device round-trip per
+            # eval step (~26 ms on the tunnel) would otherwise dominate
+            # every eval sweep (host_actor.make_ppo_host_greedy).
+            host_greedy = host_actor.make_ppo_host_greedy(pool.spec, cfg)
 
     start_it = 0
     if ckpt is not None and resume:
@@ -480,10 +487,17 @@ def train_host(
         )
         extra = {"env_steps": (it + 1) * cfg.rollout_steps * pool.num_envs}
         if eval_pool is not None and (it + 1) % eval_every == 0:
+            if host_greedy is not None:
+                # device_get blocks until the in-flight update lands, so
+                # eval always sees the CURRENT params.
+                ev_params = jax.device_get(params)
+                eval_act = lambda o: np.asarray(host_greedy(ev_params, o))  # noqa: E731
+            else:
+                eval_act = lambda o: np.asarray(  # noqa: E731
+                    greedy(params, jnp.asarray(o))
+                )
             extra["eval_return"] = host_evaluate(
-                eval_pool,
-                lambda o: np.asarray(greedy(params, jnp.asarray(o))),
-                max_steps=eval_steps,
+                eval_pool, eval_act, max_steps=eval_steps
             )
         maybe_log(
             it, log_every, metrics, tracker, history, log_fn,

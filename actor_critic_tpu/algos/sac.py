@@ -416,7 +416,10 @@ def train_host(
     `overlap` acts via the numpy host mirror with 1-update-stale params
     so device updates run during collection (host_loop docstring)."""
     from actor_critic_tpu.algos.host_loop import off_policy_train_host
-    from actor_critic_tpu.models.host_actor import make_sac_host_explore
+    from actor_critic_tpu.models.host_actor import (
+        make_sac_host_explore,
+        make_sac_host_greedy,
+    )
 
     return off_policy_train_host(
         pool, cfg, num_iterations,
@@ -427,4 +430,5 @@ def train_host(
         eval_every=eval_every, make_greedy_act=make_greedy_act,
         ckpt=ckpt, save_every=save_every, resume=resume,
         overlap=overlap, make_host_explore=make_sac_host_explore,
+        make_host_greedy=make_sac_host_greedy,
     )

@@ -65,26 +65,38 @@ PRESETS: dict[str, Preset] = {
         description="PPO-clip on MuJoCo HalfCheetah-v5 (BASELINE.json:8)",
     ),
     # BASELINE.json:9 — off-policy with the HBM replay ring.
+    # Default budgets are the real 1M-env-step runs (64 steps/iter × 16k
+    # iterations; 1 update per env step) with a 10k-step uniform-random
+    # warmup — the standard TD3/SAC MuJoCo regime.
     "ddpg_walker2d": Preset(
         algo="ddpg",
         env="host:Walker2d-v5",
-        config=ddpg.DDPGConfig(num_envs=1, steps_per_iter=64, updates_per_iter=64),
-        iterations=2000,
+        config=ddpg.DDPGConfig(
+            num_envs=1, steps_per_iter=64, updates_per_iter=64,
+            warmup_steps=10_000,
+        ),
+        iterations=16_000,
         description="DDPG on MuJoCo Walker2d-v5 (BASELINE.json:9)",
     ),
     "td3_walker2d": Preset(
         algo="td3",
         env="host:Walker2d-v5",
-        config=ddpg.td3_config(num_envs=1, steps_per_iter=64, updates_per_iter=64),
-        iterations=2000,
+        config=ddpg.td3_config(
+            num_envs=1, steps_per_iter=64, updates_per_iter=64,
+            warmup_steps=10_000,
+        ),
+        iterations=16_000,
         description="TD3 on MuJoCo Walker2d-v5 (BASELINE.json:9)",
     ),
     # BASELINE.json:10.
     "sac_humanoid": Preset(
         algo="sac",
         env="host:Humanoid-v5",
-        config=sac.SACConfig(num_envs=1, steps_per_iter=64, updates_per_iter=64),
-        iterations=4000,
+        config=sac.SACConfig(
+            num_envs=1, steps_per_iter=64, updates_per_iter=64,
+            warmup_steps=10_000,
+        ),
+        iterations=16_000,
         description="SAC on MuJoCo Humanoid-v5 (BASELINE.json:10)",
     ),
     # BASELINE.json:11 — ale-py is unavailable; the JAX-native Pong-like

@@ -134,7 +134,37 @@ def make_ppo_host_value(env_spec, cfg):
     return value_fn
 
 
+def make_ppo_host_greedy(env_spec, cfg):
+    """(np_params, obs) → mode action; host mirror of the eval policy
+    (`ppo.make_greedy_act`). Greedy host eval otherwise round-trips the
+    device tunnel once per eval step (~26 ms each on the axon host —
+    ~26 s per 1000-step eval sweep)."""
+    if env_spec.discrete:
+
+        def act(params, obs):
+            p = params["params"]
+            z = _mlp(p["torso"], np.asarray(obs, np.float32), _tanh)
+            return np.argmax(_dense(p["policy"], z), axis=-1)
+
+        return act
+
+    def act(params, obs):
+        p = params["params"]
+        za = _mlp(p["pi_torso"], np.asarray(obs, np.float32), _tanh)
+        return _dense(p["policy"], za).astype(np.float32)
+
+    return act
+
+
 # -- DDPG/TD3 (models/networks.py DeterministicActor) --------------------
+
+
+def _ddpg_actor_fwd(p: dict, obs) -> np.ndarray:
+    """Deterministic tanh actor forward — the ONE copy both the explore
+    and greedy mirrors share (divergence here would split collection and
+    eval policies)."""
+    z = _mlp(p["torso"], np.asarray(obs, np.float32), _relu)
+    return _tanh(_dense(p["action"], z))
 
 
 def make_ddpg_host_explore(env_spec, cfg):
@@ -143,12 +173,10 @@ def make_ddpg_host_explore(env_spec, cfg):
     random during warmup)."""
 
     def act(params, obs, rng: np.random.Generator, env_steps: int):
-        p = params["params"]
         shape = (np.asarray(obs).shape[0], env_spec.action_dim)
         if env_steps < cfg.warmup_steps:
             return rng.uniform(-1.0, 1.0, shape).astype(np.float32)
-        z = _mlp(p["torso"], np.asarray(obs, np.float32), _relu)
-        a = _tanh(_dense(p["action"], z))
+        a = _ddpg_actor_fwd(params["params"], obs)
         a = a + cfg.exploration_noise * rng.standard_normal(shape).astype(
             np.float32
         )
@@ -157,7 +185,25 @@ def make_ddpg_host_explore(env_spec, cfg):
     return act
 
 
+def make_ddpg_host_greedy(env_spec, cfg):
+    """(np_params, obs) → deterministic actor action (no noise); host
+    mirror of ddpg.make_greedy_act."""
+
+    def act(params, obs):
+        return _ddpg_actor_fwd(params["params"], obs).astype(np.float32)
+
+    return act
+
+
 # -- SAC (models/networks.py SquashedGaussianActor) ----------------------
+
+
+def _sac_mean_logstd(p: dict, obs) -> tuple[np.ndarray, np.ndarray]:
+    """Squashed-Gaussian actor heads — shared by explore and greedy."""
+    z = _mlp(p["torso"], np.asarray(obs, np.float32), _relu)
+    mean = _dense(p["mean"], z)
+    log_std = np.clip(_dense(p["log_std"], z), _LOG_STD_MIN, _LOG_STD_MAX)
+    return mean, log_std
 
 
 def make_sac_host_explore(env_spec, cfg):
@@ -165,16 +211,24 @@ def make_sac_host_explore(env_spec, cfg):
     sac.make_explore_fn (tanh-Gaussian sample, uniform during warmup)."""
 
     def act(params, obs, rng: np.random.Generator, env_steps: int):
-        p = params["params"]
         shape = (np.asarray(obs).shape[0], env_spec.action_dim)
         if env_steps < cfg.warmup_steps:
             return rng.uniform(-1.0, 1.0, shape).astype(np.float32)
-        z = _mlp(p["torso"], np.asarray(obs, np.float32), _relu)
-        mean = _dense(p["mean"], z)
-        log_std = np.clip(_dense(p["log_std"], z), _LOG_STD_MIN, _LOG_STD_MAX)
+        mean, log_std = _sac_mean_logstd(params["params"], obs)
         pre = mean + np.exp(log_std) * rng.standard_normal(shape).astype(
             np.float32
         )
         return _tanh(pre).astype(np.float32)
+
+    return act
+
+
+def make_sac_host_greedy(env_spec, cfg):
+    """(np_params, obs) → tanh(mean) action; host mirror of
+    sac.make_greedy_act."""
+
+    def act(params, obs):
+        mean, _ = _sac_mean_logstd(params["params"], obs)
+        return _tanh(mean).astype(np.float32)
 
     return act

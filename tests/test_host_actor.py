@@ -191,3 +191,50 @@ def test_overlap_learning_parity_cartpole():
     assert finals[False] >= 120, finals
     ratio = min(finals.values()) / max(finals.values())
     assert ratio > 0.4, finals
+
+
+def test_greedy_mirror_parity():
+    """The host greedy-eval mirrors must equal the device mode policies
+    exactly (they replace the per-step device round-trip in
+    host_evaluate)."""
+    # PPO discrete: argmax logits == dist.mode().
+    net = ActorCriticDiscrete(num_actions=3, hidden=(16, 16))
+    obs = jnp.asarray(np.random.default_rng(5).standard_normal((6, 4)), jnp.float32)
+    params = net.init(jax.random.key(3), obs)
+    dist, _ = net.apply(params, obs)
+    spec = EnvSpec(obs_shape=(4,), action_dim=3, discrete=True)
+    act = host_actor.make_ppo_host_greedy(spec, None)
+    np.testing.assert_array_equal(
+        act(_np_params(params), np.asarray(obs)), np.asarray(dist.mode())
+    )
+
+    # PPO Gaussian: mean head == dist.mode().
+    gnet = ActorCriticGaussian(action_dim=2, hidden=(16, 16))
+    gobs = jnp.asarray(np.random.default_rng(6).standard_normal((6, 3)), jnp.float32)
+    gparams = gnet.init(jax.random.key(4), gobs)
+    gdist, _ = gnet.apply(gparams, gobs)
+    gspec = EnvSpec(obs_shape=(3,), action_dim=2, discrete=False)
+    gact = host_actor.make_ppo_host_greedy(gspec, None)
+    np.testing.assert_allclose(
+        gact(_np_params(gparams), np.asarray(gobs)),
+        np.asarray(gdist.mode()), atol=ATOL,
+    )
+
+    # DDPG: noiseless tanh actor.
+    dnet = DeterministicActor(action_dim=2, hidden=(16, 16))
+    dparams = dnet.init(jax.random.key(5), gobs)
+    dact = host_actor.make_ddpg_host_greedy(gspec, None)
+    np.testing.assert_allclose(
+        dact(_np_params(dparams), np.asarray(gobs)),
+        np.asarray(dnet.apply(dparams, gobs)), atol=ATOL,
+    )
+
+    # SAC: tanh(mean) == the algo's greedy act.
+    scfg = sac.SACConfig(hidden=(16, 16))
+    snet = SquashedGaussianActor(action_dim=2, hidden=(16, 16))
+    sparams = snet.init(jax.random.key(6), gobs)
+    sact = host_actor.make_sac_host_greedy(gspec, scfg)
+    want = sac.make_greedy_act(2, scfg)(sparams, gobs)
+    np.testing.assert_allclose(
+        sact(_np_params(sparams), np.asarray(gobs)), np.asarray(want), atol=ATOL
+    )
