@@ -415,6 +415,8 @@ def train_host(
     log_every: int = 10,
     log_fn: Optional[Callable[[int, dict], None]] = None,
     eval_every: int = 0,
+    eval_envs: int = 4,
+    eval_steps: int = 1000,
     ckpt=None,
     save_every: int = 0,
     resume: bool = False,
@@ -441,6 +443,7 @@ def train_host(
         make_ingest_update=make_host_ingest_update,
         seed=seed, log_every=log_every, log_fn=log_fn,
         eval_every=eval_every, make_greedy_act=make_greedy_act,
+        eval_envs=eval_envs, eval_steps=eval_steps,
         ckpt=ckpt, save_every=save_every, resume=resume,
         overlap=overlap, make_host_explore=make_ddpg_host_explore,
         make_host_greedy=make_ddpg_host_greedy,

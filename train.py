@@ -150,6 +150,8 @@ def run_host(pool, preset, args, logger) -> dict:
         num_iterations=args.iterations, seed=args.seed,
         log_every=args.log_every, log_fn=log_fn,
         eval_every=getattr(args, "eval_every", 0),
+        eval_envs=getattr(args, "eval_envs", 4),
+        eval_steps=getattr(args, "eval_steps", 1000),
         ckpt=ckpt, save_every=args.save_every, resume=args.resume,
         overlap=not args.no_overlap,
     )
@@ -194,6 +196,14 @@ def main(argv=None) -> int:
     p.add_argument(
         "--eval-every", type=int, default=0,
         help="greedy-eval cadence in iterations (0 = off)",
+    )
+    p.add_argument(
+        "--eval-envs", type=int, default=4,
+        help="host trainers: env count of the frozen-stats eval pool",
+    )
+    p.add_argument(
+        "--eval-steps", type=int, default=1000,
+        help="host trainers: max steps per eval sweep (first episode only)",
     )
     p.add_argument("--quiet", action="store_true", help="no stdout metric echo")
     p.add_argument(
