@@ -58,7 +58,10 @@ def host_collect(
     def record(name: str, value: np.ndarray) -> None:
         block.setdefault(name, []).append(value)
 
+    from actor_critic_tpu.utils import watchdog
+
     for _ in range(num_steps):
+        watchdog.beat()  # progress heartbeat (utils/watchdog.py)
         action, extras = act_fn(obs)
         out = pool.step(action)
         record("obs", obs)
@@ -84,11 +87,14 @@ def host_evaluate(
     (host counterpart of common.evaluate; SURVEY.md §3.4). `act_fn(obs)
     -> action` is the deterministic policy. Stops early once every env
     has finished an episode."""
+    from actor_critic_tpu.utils import watchdog
+
     obs = pool.reset()
     E = pool.num_envs
     returns = np.zeros(E)
     alive = np.ones(E)
     for _ in range(max_steps):
+        watchdog.beat()  # an eval sweep is progress, not a stall
         out = pool.step(act_fn(obs))
         returns += out.raw_reward * alive
         alive *= 1.0 - out.done

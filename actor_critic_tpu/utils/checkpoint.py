@@ -207,9 +207,11 @@ def checkpointed_train(
         if (ckpt is not None and done and done >= num_iterations)
         else {}
     )
+    from actor_critic_tpu.utils import watchdog
     from actor_critic_tpu.utils.cadence import should_save
 
     for it in range(done + 1, num_iterations + 1):
+        watchdog.beat()  # progress heartbeat (utils/watchdog.py)
         state, metrics = step_fn(state)
         if ckpt is not None and should_save(it, save_every, num_iterations):
             # Sync before handing buffers to the async saver: donation

@@ -1,0 +1,102 @@
+"""Stall watchdog — failure DETECTION for long training runs (SURVEY.md
+§5.3).
+
+The axon TPU tunnel can wedge mid-run: every device call then blocks
+forever in a futex wait, the process looks alive, and a 1-hour run
+silently becomes a 0-progress hang (observed in-session 2026-07-30: a
+SAC Humanoid run froze at iteration ~680 and burned 15 minutes before a
+human noticed). Checkpoint/resume already makes runs restart-idempotent;
+what was missing is the component that *notices* the hang and dies so a
+retry loop can restart:
+
+    python train.py ... --ckpt-dir runs/x --save-every 1000 --stall-timeout 300
+    while [ $? -eq 42 ]; do python train.py ... --resume; done
+
+A daemon thread watches a heartbeat the training loops touch every
+collection step (`beat()` via `host_collect`); if no beat lands within
+`timeout_s` the process prints a diagnosis and `os._exit(42)` — the only
+reliable escape, since the main thread is stuck inside a C extension
+call that Python exceptions cannot interrupt.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+STALL_EXIT_CODE = 42
+
+_ACTIVE: list["StallWatchdog"] = []
+
+
+def beat() -> None:
+    """Touch every armed watchdog. Called from the hot host loops; a
+    plain attribute write, so it is safe (and ~free) when none is armed."""
+    for w in _ACTIVE:
+        w.touch()
+
+
+class StallWatchdog:
+    """Arms a daemon thread that kills the process (exit 42) if `touch()`
+    isn't called for `timeout_s` seconds. Use as a context manager around
+    a training run; `stop()` disarms."""
+
+    def __init__(self, timeout_s: float, startup_grace_s: float = 600.0):
+        """`startup_grace_s`: no firing during the first max(timeout,
+        grace) seconds of THIS process — first-call XLA compilation
+        blocks the host with no beats (observed ~60 s here, and a resume
+        recompiles from scratch), so an early 'stall' would send the
+        retry loop into a kill/recompile cycle that never progresses."""
+        if timeout_s <= 0:
+            raise ValueError("timeout_s must be > 0 (use no watchdog instead)")
+        self.timeout_s = float(timeout_s)
+        self._grace_until = time.monotonic() + max(timeout_s, startup_grace_s)
+        self._last = time.monotonic()
+        self._stopped = False
+        self._thread = threading.Thread(
+            target=self._run, name="stall-watchdog", daemon=True
+        )
+
+    def touch(self) -> None:
+        self._last = time.monotonic()
+
+    def start(self) -> "StallWatchdog":
+        _ACTIVE.append(self)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stopped = True
+        if self in _ACTIVE:
+            _ACTIVE.remove(self)
+
+    def __enter__(self) -> "StallWatchdog":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _run(self) -> None:
+        poll = min(5.0, self.timeout_s / 4)
+        while not self._stopped:
+            time.sleep(poll)
+            now = time.monotonic()
+            stalled = now - self._last
+            if (
+                not self._stopped
+                and now > self._grace_until
+                and stalled > self.timeout_s
+            ):
+                print(
+                    f"[stall-watchdog] no training progress for "
+                    f"{stalled:.0f}s (> {self.timeout_s:.0f}s) — device "
+                    "tunnel presumed wedged; exiting "
+                    f"{STALL_EXIT_CODE} so a retry loop can --resume "
+                    "from the last checkpoint",
+                    file=sys.stderr,
+                    flush=True,
+                )
+                sys.stderr.flush()
+                os._exit(STALL_EXIT_CODE)

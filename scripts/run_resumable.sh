@@ -1,0 +1,45 @@
+#!/usr/bin/env bash
+# Self-healing wrapper for long host-training runs (SURVEY.md §5.3).
+#
+# Pair with train.py's stall watchdog: when the axon device tunnel wedges
+# mid-run, the watchdog exits 42, and this wrapper restarts the run with
+# --resume from the last orbax checkpoint. Any other exit code passes
+# through. The retry budget counts CONSECUTIVE no-progress attempts: a
+# resume that advanced the checkpoint resets it, so a multi-day run that
+# wedges many times — but always past a fresh checkpoint — keeps going,
+# while a wedge that recurs before ANY checkpoint lands gives up after
+# MAX_RETRIES instead of replaying the same prefix forever.
+#
+#   scripts/run_resumable.sh --preset sac_humanoid --ckpt-dir runs/hum \
+#       --save-every 1000 --stall-timeout 300 --eval-every 1000
+set -u
+MAX_RETRIES=${MAX_RETRIES:-10}
+
+ckpt_dir=""
+prev=""
+for a in "$@"; do
+  if [ "$prev" = "--ckpt-dir" ]; then ckpt_dir="$a"; fi
+  prev="$a"
+done
+
+latest_step() {
+  [ -n "$ckpt_dir" ] && [ -d "$ckpt_dir" ] || { echo -1; return; }
+  ls "$ckpt_dir" 2>/dev/null | grep -E '^[0-9]+$' | sort -n | tail -1 || echo -1
+}
+
+python train.py "$@"
+rc=$?
+tries=0
+last_seen=$(latest_step)
+while [ "$rc" -eq 42 ] && [ "$tries" -lt "$MAX_RETRIES" ]; do
+  tries=$((tries + 1))
+  echo "[run_resumable] stall exit 42 — resuming (no-progress attempt $tries/$MAX_RETRIES)" >&2
+  python train.py "$@" --resume
+  rc=$?
+  now_seen=$(latest_step)
+  if [ "${now_seen:-"-1"}" != "${last_seen:-"-1"}" ]; then
+    tries=0  # the checkpoint advanced: this was not a futile retry
+    last_seen="$now_seen"
+  fi
+done
+exit "$rc"

@@ -1,0 +1,66 @@
+"""Stall watchdog (utils/watchdog.py, SURVEY.md §5.3 failure detection).
+
+The firing path calls os._exit, so it must be exercised in a subprocess;
+the keep-alive path runs in-process.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+from actor_critic_tpu.utils import watchdog
+
+
+def _run(code: str) -> subprocess.CompletedProcess:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    return subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=60, env=env,
+    )
+
+
+def test_fires_exit_42_on_stall():
+    proc = _run(
+        "import time\n"
+        "from actor_critic_tpu.utils.watchdog import StallWatchdog\n"
+        "StallWatchdog(1.0, startup_grace_s=0.0).start()\n"
+        "time.sleep(30)\n"  # a 'wedged device call'; watchdog must kill us
+        "print('unreachable')\n"
+    )
+    assert proc.returncode == watchdog.STALL_EXIT_CODE, (
+        proc.returncode, proc.stderr,
+    )
+    assert "stall-watchdog" in proc.stderr
+    assert "unreachable" not in proc.stdout
+
+
+def test_beats_keep_it_alive_and_stop_disarms():
+    # Generous timeout/beat ratio (15x): this watchdog is ARMED in the
+    # pytest process, and a firing would os._exit the whole session —
+    # the margin must absorb CI scheduler hiccups.
+    w = watchdog.StallWatchdog(3.0, startup_grace_s=0.0).start()
+    try:
+        for _ in range(8):
+            time.sleep(0.2)
+            watchdog.beat()  # module-level beat reaches the armed instance
+    finally:
+        w.stop()
+    assert w not in watchdog._ACTIVE
+    time.sleep(0.5)  # disarmed: no exit even without beats
+
+
+def test_cli_stall_timeout_clean_run(tmp_path):
+    """--stall-timeout armed around a healthy run must not interfere."""
+    proc = _run(
+        "import sys\n"
+        "sys.argv = ['train.py', '--algo', 'a2c', '--env', 'jax:two_state',\n"
+        "            '--iterations', '3', '--quiet', '--log-every', '1',\n"
+        f"            '--metrics', {str(tmp_path / 'm.jsonl')!r},\n"
+        "            '--stall-timeout', '120']\n"
+        "import train\n"
+        "sys.exit(train.main())\n"
+    )
+    assert proc.returncode == 0, proc.stderr

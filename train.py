@@ -214,6 +214,12 @@ def main(argv=None) -> int:
     p.add_argument("--ckpt-dir", help="orbax checkpoint dir")
     p.add_argument("--save-every", type=int, default=100)
     p.add_argument("--resume", action="store_true", help="resume from --ckpt-dir")
+    p.add_argument(
+        "--stall-timeout", type=float, default=0,
+        help="seconds without training progress before the process exits "
+        "42 (device tunnel presumed wedged) so a retry loop can --resume; "
+        "0 = off. Pair with --ckpt-dir/--save-every.",
+    )
     p.add_argument("--list-presets", action="store_true")
     args = p.parse_args(argv)
 
@@ -237,12 +243,21 @@ def main(argv=None) -> int:
     )
     env, fused = build_env(preset.env, preset.algo, preset.config, args.seed)
 
+    watchdog = None
+    if args.stall_timeout > 0:
+        from actor_critic_tpu.utils.watchdog import StallWatchdog
+
+        watchdog = StallWatchdog(args.stall_timeout).start()
     t0 = time.time()
-    with JsonlLogger(args.metrics, echo=not args.quiet) as logger:
-        if fused:
-            final = run_fused(env, preset, args, logger)
-        else:
-            final = run_host(env, preset, args, logger)
+    try:
+        with JsonlLogger(args.metrics, echo=not args.quiet) as logger:
+            if fused:
+                final = run_fused(env, preset, args, logger)
+            else:
+                final = run_host(env, preset, args, logger)
+    finally:
+        if watchdog is not None:
+            watchdog.stop()
     wall = time.time() - t0
     print(
         json.dumps(
