@@ -1,0 +1,31 @@
+"""A2C CartPole learning test (SURVEY.md §4: 'CartPole-v1 A2C/PPO reach
+reward >=195 within a step budget').
+
+The flagship a2c_cartpole preset's annealed shape (lr 1e-3→0 and entropy
+0.01→0 over the run — the flat-coefficient config oscillated at eval
+≤429 and never converged, round-2 verdict #1) at a reduced CPU batch:
+calibrated greedy eval 462.9 at iteration 400 (E=256, seed 0); the test
+floor of 400 doubles SURVEY's ≥195 bar.
+"""
+
+import jax
+import pytest
+
+from actor_critic_tpu.algos import a2c
+from actor_critic_tpu.envs import make_cartpole
+
+
+@pytest.mark.slow
+def test_a2c_learns_cartpole_annealed():
+    env = make_cartpole()
+    cfg = a2c.A2CConfig(
+        num_envs=256, rollout_steps=32, lr=1e-3,
+        anneal_iters=400, lr_final=0.0,
+        entropy_coef=0.01, entropy_coef_final=0.0,
+    )
+    # a2c.train with log_every=0 is the real entry path (the silent loop
+    # scans on-device in O(1) dispatches).
+    state, _ = a2c.train(env, cfg, num_iterations=400, seed=0)
+    eval_fn = jax.jit(a2c.make_eval_fn(env, cfg), static_argnums=(2, 3))
+    ev = float(eval_fn(state, jax.random.key(1), 32, 512))
+    assert ev >= 400.0, f"annealed A2C failed CartPole: greedy eval {ev}"
