@@ -296,7 +296,9 @@ def make_train_step(
     return train_step
 
 
-def make_sp_update(env: JaxEnv, cfg: ImpalaConfig, mesh, axis_name=None):
+def make_sp_update(
+    env: JaxEnv, cfg: ImpalaConfig, mesh, axis_name=None, dp_axis_name=None
+):
     """Sequence-parallel learner update for LONG trajectories (SURVEY.md
     §5.7 made load-bearing): the [T, E] trajectory's TIME axis is sharded
     over the mesh's "sp" axis, so each device forwards π/V on its T/D
@@ -307,16 +309,30 @@ def make_sp_update(env: JaxEnv, cfg: ImpalaConfig, mesh, axis_name=None):
     drop from O(T) to O(T/D): trajectories too long for one device's HBM
     (or one scan's latency budget) become trainable.
 
+    With `dp_axis_name` the update runs over a 2-D sp×dp mesh: the env
+    batch axis additionally shards over dp (the recurrence is
+    independent per env, so dp needs no extra communication beyond the
+    gradient/metric pmean, which then reduces over BOTH axes).
+
     Returns jitted `(params, opt_state, traj, bootstrap_obs) →
     (params, opt_state, metrics)` on GLOBAL [T, E] arrays; T must divide
-    by the mesh's sp size. Metric-equivalence with the unsharded update
-    is tested on the 8-device CPU mesh (tests/test_seqpar.py).
+    by the mesh's sp size (and E by its dp size). Metric-equivalence
+    with the unsharded update is tested on the 8-device CPU mesh, in
+    both 1-D sp and 2×4 sp×dp layouts (tests/test_seqpar.py).
     """
     from jax.sharding import PartitionSpec as P
 
     from actor_critic_tpu.parallel.seqpar import SP_AXIS
 
     axis_name = axis_name or SP_AXIS
+    # lax.pmean accepts an axis-name tuple: one reduction over both axes.
+    reduce_axes = (
+        axis_name if dp_axis_name is None else (axis_name, dp_axis_name)
+    )
+    traj_spec = (
+        P(axis_name) if dp_axis_name is None else P(axis_name, dp_axis_name)
+    )
+    boot_spec = P() if dp_axis_name is None else P(dp_axis_name)
     net = make_network(env, cfg)
     opt = make_optimizer(cfg)
 
@@ -326,16 +342,16 @@ def make_sp_update(env: JaxEnv, cfg: ImpalaConfig, mesh, axis_name=None):
             params, net.apply, traj, bootstrap_obs, cfg,
             env.spec.can_truncate, axis_name,
         )
-        grads = pmesh.pmean_tree(grads, axis_name)
+        grads = pmesh.pmean_tree(grads, reduce_axes)
         updates, opt_state = opt.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
-        metrics = {k: pmesh.pmean(v, axis_name) for k, v in metrics.items()}
+        metrics = {k: pmesh.pmean(v, reduce_axes) for k, v in metrics.items()}
         return params, opt_state, metrics
 
     fn = jax.shard_map(
         local_update,
         mesh=mesh,
-        in_specs=(P(), P(), P(axis_name), P()),
+        in_specs=(P(), P(), traj_spec, boot_spec),
         out_specs=(P(), P(), P()),
         check_vma=False,
     )

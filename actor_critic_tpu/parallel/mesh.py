@@ -85,20 +85,25 @@ def multihost_init(coordinator: Optional[str] = None) -> None:
 
 
 # --- collective helpers: no-op when axis_name is None ---------------------
+# axis_name may also be a TUPLE of mesh-axis names (lax.pmean/psum reduce
+# over all of them in one collective — the sp×dp learner update uses this).
 
-def pmean(x, axis_name: Optional[str]):
+AxisName = Optional["str | tuple[str, ...]"]
+
+
+def pmean(x, axis_name: AxisName):
     if axis_name is None:
         return x
     return jax.lax.pmean(x, axis_name)
 
 
-def psum(x, axis_name: Optional[str]):
+def psum(x, axis_name: AxisName):
     if axis_name is None:
         return x
     return jax.lax.psum(x, axis_name)
 
 
-def pmean_tree(tree, axis_name: Optional[str]):
+def pmean_tree(tree, axis_name: AxisName):
     if axis_name is None:
         return tree
     return jax.tree.map(partial(jax.lax.pmean, axis_name=axis_name), tree)

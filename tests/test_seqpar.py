@@ -102,12 +102,18 @@ def test_long_trajectory_many_segments(mesh):
     np.testing.assert_allclose(np.asarray(ret), np.asarray(ret_g), rtol=1e-4, atol=1e-4)
 
 
-def test_sp_impala_update_matches_unsharded(mesh):
-    """The sequence-parallel IMPALA learner update (impala.make_sp_update:
-    time axis sharded over "sp", seqpar V-trace, pmean-ed grads) produces
-    the SAME post-update params as the unsharded impala_loss + optimizer
-    step on an identical long trajectory — the trainer-level integration
-    the standalone seqpar_* golden tests don't cover."""
+@pytest.mark.parametrize(
+    "layout,dp_axis",
+    [("sp8", None), ("sp2xdp4", "dp")],
+    ids=["sp-1d", "sp2xdp4-2d"],
+)
+def test_sp_impala_update_matches_unsharded(layout, dp_axis):
+    """The sequence-parallel IMPALA learner update (impala.make_sp_update)
+    produces the SAME post-update params as the unsharded impala_loss +
+    optimizer step on an identical long trajectory — the trainer-level
+    integration the standalone seqpar_* golden tests don't cover. Runs in
+    both mesh layouts: 1-D sp (8 time shards) and 2-D sp×dp (2 time × 4
+    env shards, gradients/metrics reduced over both axes)."""
     import optax
 
     from actor_critic_tpu.algos import impala
@@ -115,12 +121,11 @@ def test_sp_impala_update_matches_unsharded(mesh):
     from actor_critic_tpu.envs import make_two_state_mdp
 
     env = make_two_state_mdp()
-    cfg = impala.ImpalaConfig(num_envs=4, rollout_steps=512, hidden=(16,))
-    Tl, El = 512, 4  # long trajectory: 64 timesteps per device
+    cfg = impala.ImpalaConfig(num_envs=8, rollout_steps=512, hidden=(16,))
+    Tl, El = 512, 8
     rng = np.random.default_rng(3)
-    obs = jnp.asarray(rng.random((Tl, El, 2)), jnp.float32)
     traj = Transition(
-        obs=obs,
+        obs=jnp.asarray(rng.random((Tl, El, 2)), jnp.float32),
         action=jnp.asarray(rng.integers(0, 2, (Tl, El))),
         log_prob=jnp.asarray(rng.normal(size=(Tl, El)) * 0.3, jnp.float32),
         value=jnp.zeros((Tl, El)),
@@ -130,7 +135,7 @@ def test_sp_impala_update_matches_unsharded(mesh):
         final_obs=jnp.asarray(rng.random((Tl, El, 2)), jnp.float32),
     )
     traj = traj._replace(
-        terminated=jnp.minimum(traj.terminated, traj.done)  # term ⇒ done
+        terminated=jnp.minimum(traj.terminated, traj.done)  # term => done
     )
     bootstrap_obs = jnp.asarray(rng.random((El, 2)), jnp.float32)
 
@@ -143,13 +148,15 @@ def test_sp_impala_update_matches_unsharded(mesh):
     (_, metrics_g), grads = jax.value_and_grad(impala.impala_loss, has_aux=True)(
         params, net.apply, traj, bootstrap_obs, cfg, True
     )
-    upd, opt_g = opt.update(grads, opt_state, params)
+    upd, _ = opt.update(grads, opt_state, params)
     params_g = optax.apply_updates(params, upd)
 
-    sp_update = impala.make_sp_update(env, cfg, mesh)
-    params_sp, opt_sp, metrics_sp = sp_update(
-        params, opt_state, traj, bootstrap_obs
-    )
+    if dp_axis is None:
+        m = seqpar.make_sp_mesh()
+    else:
+        m = jax.make_mesh((2, 4), (seqpar.SP_AXIS, dp_axis))
+    sp_update = impala.make_sp_update(env, cfg, m, dp_axis_name=dp_axis)
+    params_sp, _, metrics_sp = sp_update(params, opt_state, traj, bootstrap_obs)
     jax.tree.map(
         lambda a, b: np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
