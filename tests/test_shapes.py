@@ -1,0 +1,69 @@
+"""Shape/dtype smoke tests (SURVEY.md §4 'Unit (shapes/dtypes)').
+
+`jax.eval_shape` traces every algorithm's fused train step WITHOUT
+executing it, and chex asserts the output state is shape/dtype-identical
+to the input — the invariant donation and the scan-carry contract both
+depend on. Covers all five algorithm families, including the CNN/uint8
+pixel path (IMPALA on Pong), in milliseconds.
+"""
+
+import chex
+import jax
+import pytest
+
+from actor_critic_tpu.algos import a2c, ddpg, impala, ppo, sac
+from actor_critic_tpu.envs import make_cartpole, make_point_mass, make_pong
+
+
+CASES = [
+    (
+        a2c,
+        make_cartpole,
+        a2c.A2CConfig(num_envs=4, rollout_steps=3, hidden=(8,)),
+    ),
+    (
+        ppo,
+        make_cartpole,
+        ppo.PPOConfig(
+            num_envs=4, rollout_steps=3, epochs=2, num_minibatches=2,
+            hidden=(8,), anneal_iters=5, lr_final=0.0,
+        ),
+    ),
+    (
+        impala,
+        make_pong,
+        impala.ImpalaConfig(num_envs=2, rollout_steps=3, hidden=(8,)),
+    ),
+    (
+        ddpg,
+        make_point_mass,
+        ddpg.td3_config(
+            num_envs=4, steps_per_iter=2, updates_per_iter=1,
+            buffer_capacity=32, batch_size=4, warmup_steps=0, hidden=(8,),
+        ),
+    ),
+    (
+        sac,
+        make_point_mass,
+        sac.SACConfig(
+            num_envs=4, steps_per_iter=2, updates_per_iter=1,
+            buffer_capacity=32, batch_size=4, warmup_steps=0, hidden=(8,),
+        ),
+    ),
+]
+
+
+@pytest.mark.parametrize(
+    "mod,make_env,cfg", CASES,
+    ids=["a2c", "ppo", "impala_pixels", "td3", "sac"],
+)
+def test_train_step_preserves_state_shapes(mod, make_env, cfg):
+    env = make_env()
+    state = mod.init_state(env, cfg, jax.random.key(0))
+    step = mod.make_train_step(env, cfg)
+    out_state, metrics = jax.eval_shape(step, state)
+    # The carry contract: donation/scan require bitwise-identical
+    # structure, shapes, and dtypes across iterations.
+    chex.assert_trees_all_equal_shapes_and_dtypes(state, out_state)
+    for k, v in metrics.items():
+        assert v.shape == (), f"metric {k} is not scalar: {v.shape}"
