@@ -226,6 +226,38 @@ def bench_pallas_ops():
     }
 
 
+def bench_mujoco_host():
+    """Raw MuJoCo host-stepping rate through HostEnvPool (E=8,
+    HalfCheetah-v5) — the 1-core host bound that caps every host-env
+    config's wall-clock (SURVEY.md §7.2 item 2); measured so the
+    BASELINE.md MuJoCo rows have a reproducible denominator."""
+    import importlib.util
+
+    if importlib.util.find_spec("mujoco") is None:
+        return {"metric": "mujoco_host_stepping", "value": 0.0,
+                "unit": "env-steps/sec", "error": "mujoco not installed"}
+    from actor_critic_tpu.envs.host_pool import HostEnvPool
+
+    E, T = 8, 500
+    pool = HostEnvPool(
+        "HalfCheetah-v5", num_envs=E, seed=0,
+        normalize_obs=True, normalize_reward=True,
+    )
+    pool.reset()
+    acts = np.zeros((E, 6), np.float32)
+    pool.step(acts)
+    t0 = time.perf_counter()
+    for _ in range(T):
+        pool.step(acts)
+    sps = E * T / (time.perf_counter() - t0)
+    pool.close()
+    return {
+        "metric": "mujoco_host_stepping",
+        "value": round(sps, 1),
+        "unit": "env-steps/sec (HalfCheetah-v5, E=8, incl. normalization)",
+    }
+
+
 BENCHES = {
     "a2c": bench_a2c,
     "ppo": bench_ppo,
@@ -233,6 +265,7 @@ BENCHES = {
     "sac": bench_sac_updates,
     "ddpg": bench_ddpg_updates,
     "host": bench_host_native,
+    "mujoco": bench_mujoco_host,
     "pallas": bench_pallas_ops,
 }
 
