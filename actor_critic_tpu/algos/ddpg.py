@@ -424,8 +424,11 @@ def train_host(
 ):
     """DDPG/TD3 on a HostEnvPool (host rollout, device learner).
 
-    Recommended pool settings for off-policy MuJoCo: normalize_obs=True,
-    normalize_reward=False (TD targets want raw reward scale).
+    Recommended pool settings for off-policy MuJoCo: normalize_obs=False
+    AND normalize_reward=False — running-stat obs normalization scales
+    replayed transitions inconsistently as the stats drift (the critic
+    then bootstraps across mixed frames; observed to destabilize SAC on
+    Humanoid-v5), and TD targets want raw reward scale.
     `overlap` acts via the numpy host mirror with 1-update-stale params
     so device updates run during collection (host_loop docstring).
     Returns (learner, history).

@@ -156,6 +156,28 @@ def host_resume(ckpt, template: dict, pool) -> tuple[Optional[dict], int]:
         return None, 0
     restored = ckpt.restore(template, step)
     pool.set_state(restored["pool"])
+    # Normalization-contract check: a checkpoint whose obs-normalizer
+    # accumulated real statistics came from a run that FED NORMALIZED
+    # observations to the networks. Resuming it into a raw-obs pool
+    # (e.g. after the off-policy default flipped to normalize_obs=False)
+    # silently puts the restored policy/critic off-distribution — warn
+    # loudly instead of degrading in silence. (The flags themselves are
+    # not checkpointed, so the stats are the only available signal.)
+    try:
+        saved_count = float(np.asarray(restored["pool"]["obs_rms"]["count"]))
+    except (KeyError, TypeError):
+        saved_count = 0.0
+    if saved_count > 1.0 and not pool._normalize_obs:
+        import warnings
+
+        warnings.warn(
+            "resuming a checkpoint trained with obs normalization into a "
+            "pool with normalize_obs=False — the restored networks expect "
+            "normalized observations and will act off-distribution. "
+            "Rebuild the pool with normalize_obs=True (or restart the "
+            "run from scratch).",
+            stacklevel=2,
+        )
     return restored, step
 
 

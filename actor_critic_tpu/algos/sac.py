@@ -414,7 +414,12 @@ def train_host(
     overlap: bool = True,
 ):
     """SAC on a HostEnvPool (host rollout, device learner). Use
-    normalize_reward=False on the pool (TD targets want raw rewards).
+    normalize_obs=False AND normalize_reward=False on the pool: running-
+    stat obs normalization scales replayed transitions inconsistently as
+    the stats drift, and the critic then bootstraps across mixed frames —
+    observed in-session to send SAC Humanoid-v5 into a Q/alpha runaway
+    (alpha 0.2 -> 18, Q ~17k) that raw observations eliminate; TD targets
+    likewise want raw reward scale.
     `overlap` acts via the numpy host mirror with 1-update-stale params
     so device updates run during collection (host_loop docstring)."""
     from actor_critic_tpu.algos.host_loop import off_policy_train_host

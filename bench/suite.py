@@ -244,7 +244,7 @@ def bench_mujoco_host():
         normalize_obs=True, normalize_reward=True,
     )
     pool.reset()
-    acts = np.zeros((E, 6), np.float32)
+    acts = np.zeros((E, pool.spec.action_dim), np.float32)
     pool.step(acts)
     t0 = time.perf_counter()
     for _ in range(T):

@@ -44,16 +44,25 @@ def build_env(spec: str, algo: str, cfg, seed: int):
     if kind in ("host", "native"):
         from actor_critic_tpu.envs.host_pool import HostEnvPool
 
-        # Off-policy TD targets want raw reward scale (ddpg/sac docstrings).
+        # Off-policy TD targets want raw reward scale, and off-policy
+        # REPLAY wants raw observations too: the pool normalizes with
+        # RUNNING stats, so replayed transitions stored early are scaled
+        # differently than fresh ones, and the critic bootstraps across
+        # inconsistent frames. On high-dim envs this destabilizes Q
+        # (observed: SAC Humanoid-v5 Q/alpha runaway with normalization
+        # on; raw obs is also the standard SAC/TD3 setup). On-policy PPO
+        # consumes each batch immediately, so drifting stats are safe
+        # and obs/reward normalization helps it.
         # 'native:<id>' steps the batch in the C++ engine (one C call per
         # step) instead of the Python SyncVectorEnv loop.
+        on_policy = algo == "ppo"
         return (
             HostEnvPool(
                 name,
                 num_envs=cfg.num_envs,
                 seed=seed,
-                normalize_obs=True,
-                normalize_reward=(algo == "ppo"),
+                normalize_obs=on_policy,
+                normalize_reward=on_policy,
                 backend="gym" if kind == "host" else "native",
             ),
             False,
