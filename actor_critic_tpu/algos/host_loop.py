@@ -10,6 +10,7 @@ don't each carry a diverging copy.
 
 from __future__ import annotations
 
+import warnings
 from typing import Callable, Optional
 
 import numpy as np
@@ -167,15 +168,19 @@ def host_resume(ckpt, template: dict, pool) -> tuple[Optional[dict], int]:
         saved_count = float(np.asarray(restored["pool"]["obs_rms"]["count"]))
     except (KeyError, TypeError):
         saved_count = 0.0
-    if saved_count > 1.0 and not pool._normalize_obs:
-        import warnings
-
+    trained_normalized = saved_count > 1.0
+    if trained_normalized != pool.normalizes_obs:
+        was, now = (
+            ("with obs normalization", "normalize_obs=False")
+            if trained_normalized
+            else ("on RAW observations", "normalize_obs=True")
+        )
         warnings.warn(
-            "resuming a checkpoint trained with obs normalization into a "
-            "pool with normalize_obs=False — the restored networks expect "
-            "normalized observations and will act off-distribution. "
-            "Rebuild the pool with normalize_obs=True (or restart the "
-            "run from scratch).",
+            f"resuming a checkpoint trained {was} into a pool with {now} "
+            "— the restored networks will act off-distribution (their "
+            "observation scaling no longer matches the pool's). Rebuild "
+            f"the pool with normalize_obs={trained_normalized} (or "
+            "restart the run from scratch).",
             stacklevel=2,
         )
     return restored, step

@@ -154,6 +154,13 @@ class HostEnvPool:
         self._backend = backend
         self._pixel_preprocess = pixel_preprocess
 
+    @property
+    def normalizes_obs(self) -> bool:
+        """Whether observations are normalized with running stats — part of
+        the pool's public contract because resume-time compatibility checks
+        (algos/host_loop.host_resume) depend on it."""
+        return self._normalize_obs
+
     def eval_pool(self, num_envs: int = 4, seed: int = 1234) -> "HostEnvPool":
         """A companion pool for greedy evaluation: same env/backend and the
         SAME obs-normalization statistics (shared by reference, read-only —
