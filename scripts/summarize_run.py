@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Summarize a training-metrics JSONL into BASELINE.md row numbers.
+
+Reads the JSONL a `train.py --metrics` run streams (one dict per logged
+iteration; eval rows carry `eval_return`) and prints best/final eval
+return, the env-step and wall-clock positions where they happened, and
+effective steps/sec — the numbers BASELINE.md's measured table records
+for the MuJoCo configs (BASELINE.json:2,8-10).
+
+    python scripts/summarize_run.py runs/sac_humanoid_run1.jsonl
+    python scripts/summarize_run.py runs/*.jsonl   # one block per file
+
+Wall-clock caveat: `wall_s` is per-process. A run that was resumed
+(scripts/run_resumable.sh) restarts the counter, so this script sums the
+segments: a wall_s decrease or a non-increasing iter marks a new
+process, and the reported total adds each segment's max (restore/compile
+time between segments is NOT counted — the printed total is optimistic
+by the restart overhead; the segment count is printed so a reader can
+see it). Eval positions are reported in resume-summed wall-clock.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def summarize(path: str) -> dict:
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                rows.append(json.loads(line))
+    if not rows:
+        return {"path": path, "empty": True}
+
+    # Sum wall-clock across resume segments (wall_s resets per process).
+    # A new process shows as a wall_s decrease OR a non-increasing iter
+    # (resume restarts from the last checkpoint, which is <= the last
+    # logged iteration) — wall_s alone misses a restart whose first
+    # logged wall_s already exceeds the previous segment's last.
+    base = 0.0  # sum of completed segments' maxima
+    seg_max = 0.0
+    segments = 1
+    prev_w, prev_it = -1.0, -1
+    for r in rows:
+        w = float(r.get("wall_s", 0.0))
+        it = int(r.get("iter", prev_it + 1))
+        if w < prev_w or it <= prev_it:  # new process
+            base += seg_max
+            seg_max = 0.0
+            segments += 1
+        seg_max = max(seg_max, w)
+        r["_cum_wall_s"] = base + w  # resume-summed position of this row
+        prev_w, prev_it = w, it
+    total_wall = base + seg_max
+
+    last = rows[-1]
+    evals = [r for r in rows if "eval_return" in r]
+    out = {
+        "path": path,
+        "rows": len(rows),
+        "segments": segments,
+        "final_iter": last.get("iter"),
+        "env_steps": last.get("env_steps"),
+        "wall_s_sum": round(total_wall, 1),
+        "steps_per_sec": (
+            round(float(last["env_steps"]) / total_wall, 1)
+            if total_wall > 0 and "env_steps" in last
+            else None
+        ),
+        "final_train_return": last.get("recent_return", last.get("avg_return_ema")),
+    }
+    if evals:
+        best = max(evals, key=lambda r: r["eval_return"])
+        out.update(
+            eval_count=len(evals),
+            best_eval=round(float(best["eval_return"]), 1),
+            best_eval_at_steps=best.get("env_steps"),
+            best_eval_at_wall_s=round(best["_cum_wall_s"], 1),
+            final_eval=round(float(evals[-1]["eval_return"]), 1),
+            final_eval_at_steps=evals[-1].get("env_steps"),
+        )
+    return out
+
+
+def main() -> None:
+    paths = sys.argv[1:]
+    if not paths:
+        sys.exit("usage: summarize_run.py metrics.jsonl [...]")
+    for p in paths:
+        print(json.dumps(summarize(p)))
+
+
+if __name__ == "__main__":
+    main()

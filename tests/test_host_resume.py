@@ -132,6 +132,57 @@ def test_offpolicy_host_resume_restores_learner(tmp_path):
     pool2.close()
 
 
+@pytest.mark.parametrize("trained_normalized", [True, False],
+                         ids=["norm-ckpt-raw-pool", "raw-ckpt-norm-pool"])
+def test_resume_warns_on_normalization_mismatch(tmp_path, trained_normalized):
+    """host_resume warns in BOTH mismatch directions: a checkpoint whose
+    obs-normalizer accumulated real statistics resumed into a raw-obs
+    pool, and a raw-obs checkpoint resumed into a normalizing pool — the
+    restored networks would silently act off-distribution either way."""
+    cfg = _tiny_ppo_cfg()
+    pool = HostEnvPool(
+        "CartPole-v1", num_envs=2, seed=0,
+        normalize_obs=trained_normalized, normalize_reward=False,
+    )
+    with Checkpointer(tmp_path / "ck") as ck:
+        ppo.train_host(
+            pool, cfg, num_iterations=2, seed=0, log_every=0,
+            ckpt=ck, save_every=1,
+        )
+        ck.wait()
+    pool.close()
+
+    mismatched = HostEnvPool(
+        "CartPole-v1", num_envs=2, seed=0,
+        normalize_obs=not trained_normalized, normalize_reward=False,
+    )
+    with Checkpointer(tmp_path / "ck") as ck:
+        with pytest.warns(UserWarning, match="off-distribution"):
+            ppo.train_host(
+                mismatched, cfg, num_iterations=2, seed=0, log_every=0,
+                ckpt=ck, resume=True,
+            )
+    mismatched.close()
+
+    # Matched resume stays silent (on THIS warning; unrelated library
+    # warnings must not fail the assertion).
+    matched = HostEnvPool(
+        "CartPole-v1", num_envs=2, seed=0,
+        normalize_obs=trained_normalized, normalize_reward=False,
+    )
+    import warnings as _warnings
+
+    with Checkpointer(tmp_path / "ck") as ck:
+        with _warnings.catch_warnings(record=True) as caught:
+            _warnings.simplefilter("always")
+            ppo.train_host(
+                matched, cfg, num_iterations=2, seed=0, log_every=0,
+                ckpt=ck, resume=True,
+            )
+    assert not [w for w in caught if "off-distribution" in str(w.message)]
+    matched.close()
+
+
 def test_ppo_host_eval_rides_log_row():
     cfg = _tiny_ppo_cfg()
     pool = HostEnvPool("CartPole-v1", num_envs=2, seed=0)
