@@ -27,13 +27,21 @@ import sys
 
 def summarize(path: str) -> dict:
     rows = []
+    bad_lines = 0
     with open(path) as f:
         for line in f:
             line = line.strip()
-            if line:
+            if not line:
+                continue
+            try:
                 rows.append(json.loads(line))
+            except json.JSONDecodeError:
+                # A process killed mid-write (the run_resumable.sh
+                # stall-kill scenario) leaves a torn line; count it
+                # rather than aborting the whole summary.
+                bad_lines += 1
     if not rows:
-        return {"path": path, "empty": True}
+        return {"path": path, "empty": True, "bad_lines": bad_lines}
 
     # Sum wall-clock across resume segments (wall_s resets per process).
     # A new process shows as a wall_s decrease OR a non-increasing iter
@@ -57,10 +65,16 @@ def summarize(path: str) -> dict:
     total_wall = base + seg_max
 
     last = rows[-1]
-    evals = [r for r in rows if "eval_return" in r]
+    # JsonlLogger scrubs non-finite metrics to null — a diverged run logs
+    # eval_return=null, which must not crash the max() below.
+    evals = [
+        r for r in rows
+        if isinstance(r.get("eval_return"), (int, float))
+    ]
     out = {
         "path": path,
         "rows": len(rows),
+        **({"bad_lines": bad_lines} if bad_lines else {}),
         "segments": segments,
         "final_iter": last.get("iter"),
         "env_steps": last.get("env_steps"),

@@ -122,3 +122,23 @@ def test_resolve_preset_with_different_algo_specializes():
     assert pre.config.twin_q is True
     pre = resolve("impala_pong", "a3c", None, {})
     assert pre.config.correction == "none"
+
+
+@pytest.mark.parametrize("algo,normalized", [
+    ("ppo", True), ("ddpg", False), ("td3", False), ("sac", False),
+])
+def test_build_env_normalization_policy(algo, normalized):
+    """train.py's host pools normalize obs/rewards for on-policy PPO only.
+    Off-policy replay must see RAW frames: running-stat normalization
+    rescales early-stored transitions differently than fresh ones and the
+    critic bootstraps across inconsistent frames (observed as the SAC
+    Humanoid-v5 Q/alpha runaway). Regression-pins train.py build_env."""
+    import train as train_cli
+
+    cfg = ALGO_CONFIGS[algo](num_envs=1)
+    pool, fused = train_cli.build_env("host:CartPole-v1", algo, cfg, seed=0)
+    try:
+        assert fused is False
+        assert pool.normalizes_obs is normalized
+    finally:
+        pool.close()

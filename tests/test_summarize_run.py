@@ -1,0 +1,95 @@
+"""scripts/summarize_run.py — the JSONL→BASELINE-row summarizer.
+
+The tricky part is resume stitching: `wall_s` is per-process, so a
+resumed run (scripts/run_resumable.sh) resets it, and the summarizer
+must (a) sum segment maxima into the total, (b) detect a restart even
+when the new process's first logged wall_s already exceeds the previous
+segment's last (the iter field going non-increasing is the signal), and
+(c) report eval positions in resume-summed wall-clock.
+"""
+
+import importlib.util
+import json
+import sys
+from pathlib import Path
+
+spec = importlib.util.spec_from_file_location(
+    "summarize_run", Path(__file__).parent.parent / "scripts" / "summarize_run.py"
+)
+summarize_run = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(summarize_run)
+
+
+def _write(tmp_path, rows):
+    p = tmp_path / "m.jsonl"
+    p.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    return str(p)
+
+
+def test_single_segment(tmp_path):
+    rows = [
+        {"iter": 10, "wall_s": 5.0, "env_steps": 100.0},
+        {"iter": 20, "wall_s": 9.0, "env_steps": 200.0, "eval_return": 3.0},
+        {"iter": 30, "wall_s": 14.0, "env_steps": 300.0},
+    ]
+    s = summarize_run.summarize(_write(tmp_path, rows))
+    assert s["segments"] == 1
+    assert s["wall_s_sum"] == 14.0
+    assert s["env_steps"] == 300.0
+    assert s["best_eval"] == 3.0 and s["best_eval_at_wall_s"] == 9.0
+
+
+def test_resume_detected_by_wall_decrease(tmp_path):
+    rows = [
+        {"iter": 10, "wall_s": 100.0, "env_steps": 100.0},
+        # resume from ckpt at iter 10; wall restarts lower
+        {"iter": 20, "wall_s": 7.0, "env_steps": 200.0, "eval_return": 5.0},
+        {"iter": 30, "wall_s": 12.0, "env_steps": 300.0},
+    ]
+    s = summarize_run.summarize(_write(tmp_path, rows))
+    assert s["segments"] == 2
+    assert s["wall_s_sum"] == 112.0  # 100 + 12
+    # Best eval landed 7s into segment 2 → 107s of summed wall-clock.
+    assert s["best_eval_at_wall_s"] == 107.0
+
+
+def test_resume_detected_by_iter_regression(tmp_path):
+    # Segment 1 dies at wall_s=5; segment 2's first log (after a slow
+    # restore/compile) is already at wall_s=8 — wall_s never decreases,
+    # but iter regresses to the checkpointed 10.
+    rows = [
+        {"iter": 10, "wall_s": 5.0, "env_steps": 100.0},
+        {"iter": 10, "wall_s": 8.0, "env_steps": 100.0},
+        {"iter": 20, "wall_s": 16.0, "env_steps": 200.0},
+    ]
+    s = summarize_run.summarize(_write(tmp_path, rows))
+    assert s["segments"] == 2
+    assert s["wall_s_sum"] == 21.0  # 5 + 16
+    assert s["steps_per_sec"] == round(200.0 / 21.0, 1)
+
+
+def test_empty_file(tmp_path):
+    s = summarize_run.summarize(_write(tmp_path, []))
+    assert s.get("empty") is True
+
+
+def test_null_eval_rows_skipped(tmp_path):
+    # JsonlLogger scrubs NaN to null; a diverged run's eval rows must
+    # not crash the summary (and must not count as evals).
+    rows = [
+        {"iter": 10, "wall_s": 5.0, "env_steps": 100.0, "eval_return": None},
+        {"iter": 20, "wall_s": 9.0, "env_steps": 200.0, "eval_return": 4.0},
+    ]
+    s = summarize_run.summarize(_write(tmp_path, rows))
+    assert s["eval_count"] == 1 and s["best_eval"] == 4.0
+
+
+def test_torn_final_line_tolerated(tmp_path):
+    p = tmp_path / "m.jsonl"
+    p.write_text(
+        json.dumps({"iter": 10, "wall_s": 5.0, "env_steps": 100.0}) + "\n"
+        + '{"iter": 20, "wall_s'  # process killed mid-write
+    )
+    s = summarize_run.summarize(str(p))
+    assert s["rows"] == 1 and s["bad_lines"] == 1
+    assert s["final_iter"] == 10
