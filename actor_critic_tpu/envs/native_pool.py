@@ -7,9 +7,9 @@ terminated, truncated, info with `final_obs`). The entire batch steps in
 ONE C call (native/vecenv.cpp), which on this 1-core host removes the
 Python per-env loop that dominates gym stepping (SURVEY.md §7.2 item 2).
 
-Supported env ids: CartPole-v1 (discrete), Pendulum-v1 (continuous) —
-exact gymnasium dynamics, verified step-for-step against gymnasium in
-tests/test_native_pool.py.
+Supported env ids: CartPole-v1 and Acrobot-v1 (discrete), Pendulum-v1
+and MountainCarContinuous-v0 (continuous) — exact gymnasium dynamics,
+verified step-for-step against gymnasium in tests/test_native_pool.py.
 """
 
 from __future__ import annotations
@@ -20,13 +20,29 @@ import numpy as np
 
 _SPECS = {
     "CartPole-v1": dict(
+        prefix="cartpole",
         state_dim=4, obs_dim=4, discrete=True, n_actions=2, max_steps=500,
         obs_high=np.array([4.8, np.inf, 0.41887903, np.inf], np.float32),
     ),
     "Pendulum-v1": dict(
+        prefix="pendulum",
         state_dim=2, obs_dim=3, discrete=False, act_low=-2.0, act_high=2.0,
         max_steps=200,
         obs_high=np.array([1.0, 1.0, 8.0], np.float32),
+    ),
+    "MountainCarContinuous-v0": dict(
+        prefix="mountaincar",
+        state_dim=2, obs_dim=2, discrete=False, act_low=-1.0, act_high=1.0,
+        max_steps=999,
+        obs_low=np.array([-1.2, -0.07], np.float32),
+        obs_high=np.array([0.6, 0.07], np.float32),
+    ),
+    "Acrobot-v1": dict(
+        prefix="acrobot",
+        state_dim=4, obs_dim=6, discrete=True, n_actions=3, max_steps=500,
+        obs_high=np.array(
+            [1.0, 1.0, 1.0, 1.0, 4 * np.pi, 9 * np.pi], np.float32
+        ),
     ),
 }
 
@@ -54,7 +70,8 @@ class NativeVecEnv:
         import gymnasium as gym
 
         high = self._spec["obs_high"]
-        self.single_observation_space = gym.spaces.Box(-high, high, dtype=np.float32)
+        low = self._spec.get("obs_low", -high)
+        self.single_observation_space = gym.spaces.Box(low, high, dtype=np.float32)
         if self._spec["discrete"]:
             self.single_action_space = gym.spaces.Discrete(self._spec["n_actions"])
         else:
@@ -78,11 +95,7 @@ class NativeVecEnv:
     def reset(self, seed: int | None = None):
         if seed is not None:
             self._rng[0] = np.uint64(seed) ^ np.uint64(0xDA3E39CB94B95BDB)
-        fn = (
-            self._lib.cartpole_reset
-            if self._spec["discrete"]
-            else self._lib.pendulum_reset
-        )
+        fn = getattr(self._lib, self._spec["prefix"] + "_reset")
         fn(
             self._p(self._state, ctypes.c_double), self._p(self._obs),
             self.num_envs, self._p(self._rng, ctypes.c_uint64),
@@ -91,14 +104,13 @@ class NativeVecEnv:
         return self._obs.copy(), {}
 
     def step(self, actions: np.ndarray):
+        fn = getattr(self._lib, self._spec["prefix"] + "_step")
         if self._spec["discrete"]:
             acts = np.ascontiguousarray(actions, np.int64)
             act_ptr = self._p(acts, ctypes.c_int64)
-            fn = self._lib.cartpole_step
         else:
             acts = np.ascontiguousarray(actions, np.float32).reshape(self.num_envs)
             act_ptr = self._p(acts)
-            fn = self._lib.pendulum_step
         fn(
             self._p(self._state, ctypes.c_double), act_ptr, self.num_envs,
             self._p(self._rng, ctypes.c_uint64),

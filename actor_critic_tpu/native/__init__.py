@@ -69,10 +69,22 @@ def load() -> ctypes.CDLL:
         _f64p, _f32p, ctypes.c_int, _u64p, _i32p, ctypes.c_int32,
         _f32p, _f32p, _u8p, _u8p, _f32p,
     ]
+    lib.mountaincar_reset.argtypes = [_f64p, _f32p, ctypes.c_int, _u64p, _i32p]
+    lib.mountaincar_step.argtypes = [
+        _f64p, _f32p, ctypes.c_int, _u64p, _i32p, ctypes.c_int32,
+        _f32p, _f32p, _u8p, _u8p, _f32p,
+    ]
+    lib.acrobot_reset.argtypes = [_f64p, _f32p, ctypes.c_int, _u64p, _i32p]
+    lib.acrobot_step.argtypes = [
+        _f64p, _i64p, ctypes.c_int, _u64p, _i32p, ctypes.c_int32,
+        _f32p, _f32p, _u8p, _u8p, _f32p,
+    ]
     lib.set_state.argtypes = [_f64p, _f64p, ctypes.c_int, ctypes.c_int]
     for fn in (
         lib.cartpole_reset, lib.cartpole_step,
-        lib.pendulum_reset, lib.pendulum_step, lib.set_state,
+        lib.pendulum_reset, lib.pendulum_step,
+        lib.mountaincar_reset, lib.mountaincar_step,
+        lib.acrobot_reset, lib.acrobot_step, lib.set_state,
     ):
         fn.restype = None
     return lib

@@ -8,11 +8,14 @@
 // removing the Python per-env loop from the host hot path that matters
 // on this 1-core host (SURVEY.md §7.2 item 2).
 //
-// Dynamics are exact gymnasium semantics (CartPole-v1 Euler integration
-// and 12deg/2.4m termination with 500-step time limit; Pendulum-v1
-// clipped-torque dynamics with 200-step limit) so trainers can swap
-// backends without re-tuning. Layout: row-major; state is float64
-// (gymnasium's precision) and observations float32.
+// Dynamics are exact gymnasium semantics — CartPole-v1 (Euler
+// integration, 12deg/2.4m termination, 500-step limit), Pendulum-v1
+// (clipped torque, 200 steps), MountainCarContinuous-v0 (inelastic left
+// wall, +100 goal bonus minus raw-action penalty, 999 steps), and
+// Acrobot-v1 (book dynamics, one RK4 step of dt=0.2, ±4π/±9π velocity
+// clips, 500 steps) — so trainers can swap backends without re-tuning.
+// Layout: row-major; state is float64 (gymnasium's precision) and
+// observations float32.
 //
 // Built standalone:  g++ -O3 -shared -fPIC vecenv.cpp -o _vecenv.so
 // (the Python side builds+caches automatically; see native/__init__.py)
@@ -38,6 +41,7 @@ inline float uniform(uint64_t* s, float lo, float hi) {
 }
 
 constexpr float kPi = 3.14159265358979323846f;
+constexpr double kPiD = 3.14159265358979323846;  // double-precision math
 
 // ---- CartPole-v1 ---------------------------------------------------------
 constexpr double kGravity = 9.8;
@@ -83,6 +87,96 @@ inline void pendulum_obs(const double* st, float* obs) {
   obs[0] = (float)std::cos(st[0]);
   obs[1] = (float)std::sin(st[0]);
   obs[2] = (float)st[1];
+}
+
+// ---- MountainCarContinuous-v0 -------------------------------------------
+constexpr double kMcMinPos = -1.2;
+constexpr double kMcMaxPos = 0.6;
+constexpr double kMcMaxSpeed = 0.07;
+constexpr double kMcGoalPos = 0.45;
+constexpr double kMcGoalVel = 0.0;
+constexpr double kMcPower = 0.0015;
+
+inline void mountaincar_reset_one(double* st, uint64_t* rng) {
+  st[0] = uniform(rng, -0.6f, -0.4f);  // position
+  st[1] = 0.0;                         // velocity
+}
+
+// ---- Acrobot-v1 ----------------------------------------------------------
+// Double-pendulum swing-up, gymnasium's "book" dynamics (Sutton & Barto),
+// RK4-integrated with one dt=0.2 step, velocities clipped to ±4π/±9π.
+constexpr double kAcDt = 0.2;
+constexpr double kAcM1 = 1.0, kAcM2 = 1.0;   // link masses
+constexpr double kAcL1 = 1.0;                // link 1 length
+constexpr double kAcLc1 = 0.5, kAcLc2 = 0.5; // link COM positions
+constexpr double kAcI1 = 1.0, kAcI2 = 1.0;   // moments of inertia
+constexpr double kAcG = 9.8;
+constexpr double kAcMaxVel1 = 4.0 * kPiD;
+constexpr double kAcMaxVel2 = 9.0 * kPiD;
+
+inline void acrobot_reset_one(double* st, uint64_t* rng) {
+  for (int k = 0; k < 4; ++k) st[k] = uniform(rng, -0.1f, 0.1f);
+}
+
+inline void acrobot_obs(const double* st, float* obs) {
+  obs[0] = (float)std::cos(st[0]);
+  obs[1] = (float)std::sin(st[0]);
+  obs[2] = (float)std::cos(st[1]);
+  obs[3] = (float)std::sin(st[1]);
+  obs[4] = (float)st[2];
+  obs[5] = (float)st[3];
+}
+
+// ds/dt of the torque-augmented state (gymnasium Acrobot._dsdt, book eqs).
+inline void acrobot_dsdt(const double* s, double torque, double* ds) {
+  const double th1 = s[0], th2 = s[1], dth1 = s[2], dth2 = s[3];
+  const double d1 =
+      kAcM1 * kAcLc1 * kAcLc1 +
+      kAcM2 * (kAcL1 * kAcL1 + kAcLc2 * kAcLc2 +
+               2.0 * kAcL1 * kAcLc2 * std::cos(th2)) +
+      kAcI1 + kAcI2;
+  const double d2 =
+      kAcM2 * (kAcLc2 * kAcLc2 + kAcL1 * kAcLc2 * std::cos(th2)) + kAcI2;
+  const double phi2 =
+      kAcM2 * kAcLc2 * kAcG * std::cos(th1 + th2 - kPiD / 2.0);
+  const double phi1 =
+      -kAcM2 * kAcL1 * kAcLc2 * dth2 * dth2 * std::sin(th2) -
+      2.0 * kAcM2 * kAcL1 * kAcLc2 * dth2 * dth1 * std::sin(th2) +
+      (kAcM1 * kAcLc1 + kAcM2 * kAcL1) * kAcG * std::cos(th1 - kPiD / 2.0) +
+      phi2;
+  const double ddth2 =
+      (torque + d2 / d1 * phi1 -
+       kAcM2 * kAcL1 * kAcLc2 * dth1 * dth1 * std::sin(th2) - phi2) /
+      (kAcM2 * kAcLc2 * kAcLc2 + kAcI2 - d2 * d2 / d1);
+  const double ddth1 = -(d2 * ddth2 + phi1) / d1;
+  ds[0] = dth1;
+  ds[1] = dth2;
+  ds[2] = ddth1;
+  ds[3] = ddth2;
+}
+
+inline double wrap_pi(double x) {
+  // gymnasium wrap(x, -π, π)
+  const double diff = 2.0 * kPiD;
+  while (x > kPiD) x -= diff;
+  while (x < -kPiD) x += diff;
+  return x;
+}
+
+// One RK4 step of size kAcDt on the 4-state with constant torque
+// (gymnasium's rk4 over t=[0, 0.2]; the augmented torque slot has zero
+// derivative, so it is simply threaded through).
+inline void acrobot_rk4(double* st, double torque) {
+  double k1[4], k2[4], k3[4], k4[4], tmp[4];
+  acrobot_dsdt(st, torque, k1);
+  for (int k = 0; k < 4; ++k) tmp[k] = st[k] + 0.5 * kAcDt * k1[k];
+  acrobot_dsdt(tmp, torque, k2);
+  for (int k = 0; k < 4; ++k) tmp[k] = st[k] + 0.5 * kAcDt * k2[k];
+  acrobot_dsdt(tmp, torque, k3);
+  for (int k = 0; k < 4; ++k) tmp[k] = st[k] + kAcDt * k3[k];
+  acrobot_dsdt(tmp, torque, k4);
+  for (int k = 0; k < 4; ++k)
+    st[k] += kAcDt / 6.0 * (k1[k] + 2.0 * k2[k] + 2.0 * k3[k] + k4[k]);
 }
 
 }  // namespace
@@ -186,6 +280,94 @@ void pendulum_step(double* state, const float* action, int n, uint64_t* rng,
       steps[i] = 0;
     }
     pendulum_obs(st, obs + 3 * i);
+  }
+}
+
+// state: [n,2] float64 (position, velocity); obs out: [n,2] float32
+void mountaincar_reset(double* state, float* obs, int n, uint64_t* rng,
+                       int32_t* steps) {
+  for (int i = 0; i < n; ++i) {
+    mountaincar_reset_one(state + 2 * i, rng);
+    obs_from_state(state + 2 * i, obs + 2 * i, 2);
+    steps[i] = 0;
+  }
+}
+
+void mountaincar_step(double* state, const float* action, int n,
+                      uint64_t* rng, int32_t* steps, int32_t max_steps,
+                      float* obs, float* reward, uint8_t* terminated,
+                      uint8_t* truncated, float* final_obs) {
+  for (int i = 0; i < n; ++i) {
+    double* st = state + 2 * i;
+    const double raw = action[i];
+    double force = raw;
+    if (force > 1.0) force = 1.0;
+    if (force < -1.0) force = -1.0;
+    double pos = st[0], vel = st[1];
+    vel += force * kMcPower - 0.0025 * std::cos(3.0 * pos);
+    if (vel > kMcMaxSpeed) vel = kMcMaxSpeed;
+    if (vel < -kMcMaxSpeed) vel = -kMcMaxSpeed;
+    pos += vel;
+    if (pos > kMcMaxPos) pos = kMcMaxPos;
+    if (pos < kMcMinPos) pos = kMcMinPos;
+    if (pos == kMcMinPos && vel < 0.0) vel = 0.0;  // inelastic left wall
+    st[0] = pos;
+    st[1] = vel;
+    steps[i] += 1;
+
+    const bool term = pos >= kMcGoalPos && vel >= kMcGoalVel;
+    const bool trunc = !term && steps[i] >= max_steps;
+    // gymnasium penalizes the RAW action (not the clipped force) and
+    // pays +100 on reaching the goal.
+    reward[i] = (float)((term ? 100.0 : 0.0) - 0.1 * raw * raw);
+    terminated[i] = term;
+    truncated[i] = trunc;
+    obs_from_state(st, final_obs + 2 * i, 2);
+    if (term || trunc) {
+      mountaincar_reset_one(st, rng);
+      steps[i] = 0;
+    }
+    obs_from_state(st, obs + 2 * i, 2);
+  }
+}
+
+// state: [n,4] float64 (θ1, θ2, dθ1, dθ2); obs out: [n,6] float32
+void acrobot_reset(double* state, float* obs, int n, uint64_t* rng,
+                   int32_t* steps) {
+  for (int i = 0; i < n; ++i) {
+    acrobot_reset_one(state + 4 * i, rng);
+    acrobot_obs(state + 4 * i, obs + 6 * i);
+    steps[i] = 0;
+  }
+}
+
+void acrobot_step(double* state, const int64_t* action, int n, uint64_t* rng,
+                  int32_t* steps, int32_t max_steps, float* obs,
+                  float* reward, uint8_t* terminated, uint8_t* truncated,
+                  float* final_obs) {
+  for (int i = 0; i < n; ++i) {
+    double* st = state + 4 * i;
+    const double torque = (double)(action[i] - 1);  // {0,1,2} → {-1,0,+1}
+    acrobot_rk4(st, torque);
+    st[0] = wrap_pi(st[0]);
+    st[1] = wrap_pi(st[1]);
+    if (st[2] > kAcMaxVel1) st[2] = kAcMaxVel1;
+    if (st[2] < -kAcMaxVel1) st[2] = -kAcMaxVel1;
+    if (st[3] > kAcMaxVel2) st[3] = kAcMaxVel2;
+    if (st[3] < -kAcMaxVel2) st[3] = -kAcMaxVel2;
+    steps[i] += 1;
+
+    const bool term = -std::cos(st[0]) - std::cos(st[1] + st[0]) > 1.0;
+    const bool trunc = !term && steps[i] >= max_steps;
+    reward[i] = term ? 0.0f : -1.0f;
+    terminated[i] = term;
+    truncated[i] = trunc;
+    acrobot_obs(st, final_obs + 6 * i);
+    if (term || trunc) {
+      acrobot_reset_one(st, rng);
+      steps[i] = 0;
+    }
+    acrobot_obs(st, obs + 6 * i);
   }
 }
 

@@ -121,3 +121,139 @@ def test_native_faster_than_gym():
     t0 = time.perf_counter(); [gympool.step(acts) for _ in range(T)]
     t_gym = time.perf_counter() - t0
     assert t_native < t_gym, (t_native, t_gym)
+
+
+def test_mountaincar_dynamics_match_gymnasium():
+    """MountainCarContinuous-v0: clipped force, inelastic left wall, raw-
+    action reward penalty, +100 goal bonus — stepped against gymnasium
+    from identical injected states."""
+    genv = gym.make("MountainCarContinuous-v0").unwrapped
+    genv.reset(seed=0)
+    nenv = NativeVecEnv("MountainCarContinuous-v0", num_envs=1)
+    nenv.reset(seed=0)
+
+    rng = np.random.default_rng(5)
+    start = np.array([rng.uniform(-0.6, -0.4), 0.0], np.float64)
+    genv.state = start.copy()
+    nenv.set_state(start[None, :])
+
+    for t in range(200):
+        # Out-of-range actions exercise the clip-for-force /
+        # raw-for-penalty asymmetry.
+        a = np.array([rng.uniform(-1.5, 1.5)], np.float32)
+        gobs, grew, gterm, gtrunc, _ = genv.step(a)
+        nobs, nrew, nterm, ntrunc, ninfo = nenv.step(a[None, :])
+        if gterm:
+            np.testing.assert_allclose(
+                ninfo["final_obs"][0], gobs.astype(np.float32),
+                rtol=1e-5, atol=1e-6,
+            )
+            assert bool(nterm[0])
+            break
+        np.testing.assert_allclose(
+            nobs[0], gobs.astype(np.float32), rtol=1e-5, atol=1e-6
+        )
+        np.testing.assert_allclose(nrew[0], grew, rtol=1e-5, atol=1e-6)
+        assert not bool(nterm[0])
+
+
+def test_acrobot_dynamics_match_gymnasium():
+    """Acrobot-v1: RK4 book dynamics, angle wrap, velocity bounds — the
+    native trajectory must track gymnasium's step for step."""
+    genv = gym.make("Acrobot-v1").unwrapped
+    genv.reset(seed=0)
+    nenv = NativeVecEnv("Acrobot-v1", num_envs=1)
+    nenv.reset(seed=0)
+
+    rng = np.random.default_rng(9)
+    start = rng.uniform(-0.1, 0.1, size=4)
+    genv.state = start.astype(np.float64)
+    nenv.set_state(start[None, :])
+
+    for t in range(120):
+        a = int(rng.integers(0, 3))
+        gobs, grew, gterm, gtrunc, _ = genv.step(a)
+        nobs, nrew, nterm, ntrunc, ninfo = nenv.step(np.array([a]))
+        if gterm:
+            np.testing.assert_allclose(
+                ninfo["final_obs"][0], gobs.astype(np.float32),
+                rtol=1e-4, atol=1e-5,
+            )
+            assert bool(nterm[0])
+            break
+        np.testing.assert_allclose(
+            nobs[0], gobs.astype(np.float32), rtol=1e-4, atol=1e-5
+        )
+        assert nrew[0] == grew
+        assert not bool(nterm[0])
+
+
+def test_new_native_envs_under_hostenvpool():
+    """Both new envs ride HostEnvPool's native backend end-to-end."""
+    for env_id, disc in (
+        ("MountainCarContinuous-v0", False), ("Acrobot-v1", True),
+    ):
+        pool = HostEnvPool(
+            env_id, num_envs=4, seed=3, backend="native",
+            normalize_obs=False, normalize_reward=False,
+        )
+        obs = pool.reset()
+        assert obs.shape == (4, pool.spec.obs_shape[0])
+        if disc:
+            acts = np.zeros(4, np.int64)
+        else:
+            acts = np.zeros((4, 1), np.float32)
+        out = pool.step(acts)
+        assert np.isfinite(out.obs).all()
+        pool.close()
+
+
+def test_mountaincar_goal_termination_and_bonus():
+    """The +100 goal bonus, raw-action penalty, and termination flag —
+    injected near-goal state so the terminal branch actually runs."""
+    genv = gym.make("MountainCarContinuous-v0").unwrapped
+    genv.reset(seed=0)
+    nenv = NativeVecEnv("MountainCarContinuous-v0", num_envs=1)
+    nenv.reset(seed=0)
+
+    start = np.array([0.445, 0.055], np.float64)
+    genv.state = start.copy()
+    nenv.set_state(start[None, :])
+
+    a = np.array([1.0], np.float32)
+    gobs, grew, gterm, _, _ = genv.step(a)
+    nobs, nrew, nterm, _, ninfo = nenv.step(a[None, :])
+    assert gterm, "test setup must reach the goal in one step"
+    assert bool(nterm[0])
+    np.testing.assert_allclose(nrew[0], grew, rtol=1e-6)  # ≈ 100 - 0.1
+    assert nrew[0] > 99.0
+    np.testing.assert_allclose(
+        ninfo["final_obs"][0], gobs.astype(np.float32), rtol=1e-5, atol=1e-6
+    )
+    # SAME_STEP: obs holds the fresh episode (position ∈ [-0.6, -0.4]).
+    assert -0.6 <= nobs[0, 0] <= -0.4 and nobs[0, 1] == 0.0
+
+
+def test_acrobot_termination_parity():
+    """Terminal condition (-cosθ1 - cos(θ1+θ2) > 1) and 0-vs-(-1) reward,
+    from an injected state one step short of the goal height."""
+    genv = gym.make("Acrobot-v1").unwrapped
+    genv.reset(seed=0)
+    nenv = NativeVecEnv("Acrobot-v1", num_envs=1)
+    nenv.reset(seed=0)
+
+    start = np.array([2.8, 0.0, 0.0, 0.0], np.float64)  # near-vertical link 1
+    genv.state = start.copy()
+    nenv.set_state(start[None, :])
+
+    a = 1  # zero torque
+    gobs, grew, gterm, _, _ = genv.step(a)
+    nobs, nrew, nterm, _, ninfo = nenv.step(np.array([a]))
+    assert gterm, "test setup must terminate in one step"
+    assert bool(nterm[0])
+    assert nrew[0] == grew == 0.0
+    np.testing.assert_allclose(
+        ninfo["final_obs"][0], gobs.astype(np.float32), rtol=1e-5, atol=1e-6
+    )
+    # fresh episode obs: all four state vars uniform in [-0.1, 0.1]
+    assert abs(nobs[0, 4]) <= 0.1 and abs(nobs[0, 5]) <= 0.1
