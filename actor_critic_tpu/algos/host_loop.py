@@ -138,6 +138,14 @@ def host_maybe_save(
     import jax
 
     jax.block_until_ready(device_state)
+    # The pool's action convention rides the tolerant metrics JSON (NOT
+    # the state tree: adding a leaf there would structurally invalidate
+    # every pre-existing checkpoint under orbax's exact-template
+    # restore) so host_resume can warn on a convention flip.
+    metrics = {
+        **(metrics or {}),
+        "_pool_scale_actions": float(getattr(pool, "scales_actions", False)),
+    }
     ckpt.save(
         it, host_ckpt_state(pool, **device_state), metrics=metrics, force=True
     )
@@ -168,6 +176,19 @@ def host_resume(ckpt, template: dict, pool) -> tuple[Optional[dict], int]:
         saved_count = float(np.asarray(restored["pool"]["obs_rms"]["count"]))
     except (KeyError, TypeError):
         saved_count = 0.0
+    saved_scale = ckpt.restore_metrics(step).get("_pool_scale_actions")
+    if saved_scale is not None and bool(saved_scale) != getattr(
+        pool, "scales_actions", False
+    ):
+        warnings.warn(
+            "resuming a checkpoint trained under the "
+            f"{'scaled' if saved_scale else 'clipped'}-action convention "
+            "into a pool with scale_actions="
+            f"{getattr(pool, 'scales_actions', False)} — the restored "
+            "policy's actions will execute differently than they trained. "
+            "Relaunch with the run's original --scale-actions setting.",
+            stacklevel=2,
+        )
     trained_normalized = saved_count > 1.0
     if trained_normalized != pool.normalizes_obs:
         was, now = (

@@ -71,11 +71,29 @@ class HostStepOutput:
     final_obs: np.ndarray    # pre-reset obs (normalized); == obs if not done
 
 
+def scalable_bounds(discrete: bool, low, high) -> bool:
+    """Whether an action space supports the [-1,1]→Box affine map: a
+    continuous Box with finite bounds (an infinite bound would make the
+    mid/half-range constants inf/nan and every scaled action nan)."""
+    return not discrete and bool(
+        np.isfinite(low).all() and np.isfinite(high).all()
+    )
+
+
 class HostEnvPool:
     """Batched gymnasium envs with normalization, one `step(actions)` call.
 
     Actions: for Box spaces the policy's raw (Gaussian) actions are clipped
     to the space bounds; for Discrete they pass through as int arrays.
+    With `scale_actions=True` the pool instead treats policy actions as
+    normalized [-1, 1] and affine-maps them onto the Box bounds — the
+    standard tanh-policy convention. This keeps the REPLAYED action
+    consistent with the EXECUTED one on envs whose bounds are narrower
+    than [-1, 1] (Humanoid-v5's ±0.4: clipping executes ±0.4 while the
+    buffer stores the raw sample, so Q(s,a) trains on actions that were
+    never taken; scaling removes the mismatch and restores full actuator
+    authority). Off by default: recorded runs used clip semantics, and
+    the flag must never change under a resumed process.
     """
 
     def __init__(
@@ -90,6 +108,7 @@ class HostEnvPool:
         gamma: float = 0.99,
         backend: str = "gym",
         pixel_preprocess: bool = False,
+        scale_actions: bool = False,
     ):
         self.env_id = env_id
         self.num_envs = num_envs
@@ -129,6 +148,16 @@ class HostEnvPool:
             action_dim = int(np.prod(space.shape))
             self._act_low = np.asarray(space.low, np.float32)
             self._act_high = np.asarray(space.high, np.float32)
+        if scale_actions and not scalable_bounds(
+            self._discrete, self._act_low, self._act_high
+        ):
+            raise ValueError(
+                "scale_actions needs a finite continuous action Box"
+            )
+        self._scale_actions = scale_actions
+        if scale_actions:
+            self._act_mid = 0.5 * (self._act_high + self._act_low)
+            self._act_half = 0.5 * (self._act_high - self._act_low)
         # uint8 pixel obs keep their dtype (the CNN's /255 branch fires on
         # it); everything else is delivered as float32 regardless of the
         # env's native dtype — MuJoCo emits float64, and letting that flow
@@ -161,6 +190,13 @@ class HostEnvPool:
         (algos/host_loop.host_resume) depend on it."""
         return self._normalize_obs
 
+    @property
+    def scales_actions(self) -> bool:
+        """Whether policy actions are affine-mapped from [-1,1] onto the
+        action Box (vs clipped) — public for the same resume-time
+        compatibility checks as `normalizes_obs`."""
+        return self._scale_actions
+
     def eval_pool(self, num_envs: int = 4, seed: int = 1234) -> "HostEnvPool":
         """A companion pool for greedy evaluation: same env/backend and the
         SAME obs-normalization statistics (shared by reference, read-only —
@@ -171,6 +207,7 @@ class HostEnvPool:
             normalize_obs=self._normalize_obs, normalize_reward=False,
             clip_obs=self._clip_obs, gamma=self._gamma,
             backend=self._backend, pixel_preprocess=self._pixel_preprocess,
+            scale_actions=self._scale_actions,
         )
         pool.obs_rms = self.obs_rms  # aliased on purpose; frozen below
         pool._frozen_stats = True
@@ -211,6 +248,9 @@ class HostEnvPool:
         actions = np.asarray(actions)
         if self._discrete:
             actions = actions.astype(np.int64)
+        elif self._scale_actions:
+            a = np.clip(actions.astype(np.float32), -1.0, 1.0)
+            actions = self._act_mid + self._act_half * a
         else:
             actions = np.clip(
                 actions.astype(np.float32), self._act_low, self._act_high

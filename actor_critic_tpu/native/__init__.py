@@ -32,9 +32,13 @@ def _build() -> None:
     # Compile to a per-process temp path, then atomically rename: a
     # concurrent process must never dlopen a half-written .so.
     tmp = f"{_LIB}.{os.getpid()}.tmp"
+    # -ffp-contract=off: gymnasium's NumPy arithmetic never fuses
+    # multiply-adds, so FMA contraction (default under -O3) silently
+    # breaks the engine's bit-parity contract — measured as a 1-ulp
+    # velocity difference in MountainCar's force*power - cosTerm.
     cmd = [
-        "g++", "-O3", "-march=native", "-shared", "-fPIC",
-        _SRC, "-o", tmp,
+        "g++", "-O3", "-march=native", "-ffp-contract=off",
+        "-shared", "-fPIC", _SRC, "-o", tmp,
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, text=True)

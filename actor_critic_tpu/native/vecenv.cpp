@@ -14,11 +14,15 @@
 // wall, +100 goal bonus minus raw-action penalty, 999 steps), and
 // Acrobot-v1 (book dynamics, one RK4 step of dt=0.2, ±4π/±9π velocity
 // clips, 500 steps) — so trainers can swap backends without re-tuning.
-// Layout: row-major; state is float64 (gymnasium's precision) and
-// observations float32.
+// Layout: row-major; state is float64 (gymnasium computes these envs in
+// float64 — except MountainCar, whose float32 per-op arithmetic is
+// emulated op-for-op in mountaincar_step) and observations float32.
 //
-// Built standalone:  g++ -O3 -shared -fPIC vecenv.cpp -o _vecenv.so
-// (the Python side builds+caches automatically; see native/__init__.py)
+// Built standalone:
+//   g++ -O3 -ffp-contract=off -shared -fPIC vecenv.cpp -o _vecenv.so
+// (-ffp-contract=off is load-bearing: FMA contraction breaks the
+// bit-parity contract — see native/__init__.py. The Python side
+// builds+caches automatically.)
 
 #include <cmath>
 #include <cstdint>
@@ -297,29 +301,63 @@ void mountaincar_step(double* state, const float* action, int n,
                       uint64_t* rng, int32_t* steps, int32_t max_steps,
                       float* obs, float* reward, uint8_t* terminated,
                       uint8_t* truncated, float* final_obs) {
+  // Bit-exact emulation of gymnasium's float32 MountainCar arithmetic.
+  // Unlike the other classic-control envs, gymnasium keeps this state
+  // in float32 and (via NumPy 2 weak promotion) performs EACH velocity/
+  // position update op in float32, while clamps assign python float64
+  // constants and comparisons run in float64 — rounding only at the end
+  // of the step is NOT equivalent (the wall/clip discontinuities
+  // amplify a 1-ulp difference chaotically; measured ~0.55 obs
+  // divergence within one 999-step episode). The mixed float/double
+  // locals below mirror that op-for-op.
   for (int i = 0; i < n; ++i) {
     double* st = state + 2 * i;
-    const double raw = action[i];
-    double force = raw;
-    if (force > 1.0) force = 1.0;
-    if (force < -1.0) force = -1.0;
-    double pos = st[0], vel = st[1];
-    vel += force * kMcPower - 0.0025 * std::cos(3.0 * pos);
-    if (vel > kMcMaxSpeed) vel = kMcMaxSpeed;
-    if (vel < -kMcMaxSpeed) vel = -kMcMaxSpeed;
-    pos += vel;
-    if (pos > kMcMaxPos) pos = kMcMaxPos;
-    if (pos < kMcMinPos) pos = kMcMinPos;
-    if (pos == kMcMinPos && vel < 0.0) vel = 0.0;  // inelastic left wall
-    st[0] = pos;
-    st[1] = vel;
+    const float raw = action[i];
+    const float pos_f = (float)st[0];
+    const float vel_f = (float)st[1];
+    // velocity += force*power - 0.0025*cos(3*position). The cos term is
+    // python-float (double) math on the float32 product 3*position.
+    // When the force clamps, python's min/max returns the PYTHON float
+    // bound, so force*power - cosTerm is one double expression rounded
+    // ONCE on the float32 +=; unclamped, force stays np.float32 and the
+    // product/subtraction are separate float32 ops. The branches differ
+    // by 1 ulp often enough (~each few hundred clamped steps) that
+    // collapsing them breaks long-horizon parity.
+    const double cos_term = 0.0025 * std::cos((double)(3.0f * pos_f));
+    float delta_f;
+    if (raw > 1.0f) {
+      delta_f = (float)(1.0 * kMcPower - cos_term);
+    } else if (raw < -1.0f) {
+      delta_f = (float)(-1.0 * kMcPower - cos_term);
+    } else {
+      delta_f = (raw * (float)kMcPower) - (float)cos_term;
+    }
+    float vel1_f = vel_f + delta_f;
+    // Clamps assign the python float64 constant; comparisons in double.
+    double vel_d = (double)vel1_f;
+    if (vel_d > kMcMaxSpeed) vel_d = kMcMaxSpeed;
+    if (vel_d < -kMcMaxSpeed) vel_d = -kMcMaxSpeed;
+    // position += velocity is a float32 op regardless of which branch
+    // velocity took (weak promotion casts a python float back down).
+    const float pos1_f = pos_f + (float)vel_d;
+    double pos_d = (double)pos1_f;
+    if (pos_d > kMcMaxPos) pos_d = kMcMaxPos;
+    if (pos_d < kMcMinPos) pos_d = kMcMinPos;
+    // `position == min_position` can only be true via the clamp branch
+    // (-1.2 is not float32-representable), exactly as in gymnasium.
+    if (pos_d == kMcMinPos && vel_d < 0.0) vel_d = 0.0;
+    st[0] = (double)(float)pos_d;  // np.array([...], dtype=np.float32)
+    st[1] = (double)(float)vel_d;
     steps[i] += 1;
+    const double pos = pos_d;
+    const double vel = vel_d;
 
     const bool term = pos >= kMcGoalPos && vel >= kMcGoalVel;
     const bool trunc = !term && steps[i] >= max_steps;
     // gymnasium penalizes the RAW action (not the clipped force) and
     // pays +100 on reaching the goal.
-    reward[i] = (float)((term ? 100.0 : 0.0) - 0.1 * raw * raw);
+    reward[i] =
+        (float)((term ? 100.0 : 0.0) - 0.1 * ((double)raw * (double)raw));
     terminated[i] = term;
     truncated[i] = trunc;
     obs_from_state(st, final_obs + 2 * i, 2);

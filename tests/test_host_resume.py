@@ -195,3 +195,35 @@ def test_ppo_host_eval_rides_log_row():
     assert np.isfinite(rows[2]["eval_return"])
     assert "env_steps" in rows[2]
     pool.close()
+
+
+def test_resume_warns_on_action_convention_mismatch(tmp_path):
+    """The scale_actions convention rides the checkpoint's metrics JSON
+    (not the state tree — that would break old checkpoints); resuming
+    under the other convention must warn."""
+    from actor_critic_tpu.algos import ddpg
+
+    cfg = _tiny_ddpg_cfg()
+    pool = HostEnvPool(
+        "Pendulum-v1", num_envs=2, seed=0, normalize_obs=False,
+        normalize_reward=False, scale_actions=True,
+    )
+    with Checkpointer(tmp_path / "ck") as ck:
+        ddpg.train_host(
+            pool, cfg, num_iterations=2, seed=0, log_every=0,
+            ckpt=ck, save_every=1,
+        )
+        ck.wait()
+    pool.close()
+
+    clipped = HostEnvPool(
+        "Pendulum-v1", num_envs=2, seed=0, normalize_obs=False,
+        normalize_reward=False, scale_actions=False,
+    )
+    with Checkpointer(tmp_path / "ck") as ck:
+        with pytest.warns(UserWarning, match="action convention|execute differently"):
+            ddpg.train_host(
+                clipped, cfg, num_iterations=2, seed=0, log_every=0,
+                ckpt=ck, resume=True,
+            )
+    clipped.close()

@@ -25,7 +25,7 @@ import sys
 import time
 
 
-def build_env(spec: str, algo: str, cfg, seed: int):
+def build_env(spec: str, algo: str, cfg, seed: int, scale_actions: bool = False):
     """'jax:<name>' → (JaxEnv, fused=True); 'host:<id>' → (pool, False)."""
     kind, _, name = spec.partition(":")
     if kind == "jax":
@@ -64,6 +64,7 @@ def build_env(spec: str, algo: str, cfg, seed: int):
                 normalize_obs=on_policy,
                 normalize_reward=on_policy,
                 backend="gym" if kind == "host" else "native",
+                scale_actions=scale_actions,
             ),
             False,
         )
@@ -180,7 +181,12 @@ def run_host(pool, preset, args, logger) -> dict:
             # Resume found the run already complete: no iteration ran, so
             # no log row fired — recover the final metrics saved alongside
             # the checkpoint instead of returning an empty summary.
-            last = ckpt.restore_metrics()
+            # Underscore-prefixed keys are checkpoint-internal bookkeeping
+            # (e.g. _pool_scale_actions), not metrics.
+            last = {
+                k: v for k, v in ckpt.restore_metrics().items()
+                if not k.startswith("_")
+            }
     finally:
         if ckpt is not None:
             ckpt.close()
@@ -220,6 +226,14 @@ def main(argv=None) -> int:
         help="host envs: disable the numpy actor mirror / async device "
         "update overlap (A/B baseline; models/host_actor.py)",
     )
+    p.add_argument(
+        "--scale-actions", action="store_true",
+        help="host envs (continuous): affine-map policy actions from "
+        "[-1,1] onto the env's Box bounds instead of clipping — keeps "
+        "replayed == executed actions on narrow-bound envs like "
+        "Humanoid-v5 (±0.4). Never flip this on a resumed run: the "
+        "restored networks were trained under the other convention.",
+    )
     p.add_argument("--ckpt-dir", help="orbax checkpoint dir")
     p.add_argument("--save-every", type=int, default=100)
     p.add_argument("--resume", action="store_true", help="resume from --ckpt-dir")
@@ -250,7 +264,10 @@ def main(argv=None) -> int:
         f"config={dataclasses.asdict(preset.config)}",
         flush=True,
     )
-    env, fused = build_env(preset.env, preset.algo, preset.config, args.seed)
+    env, fused = build_env(
+        preset.env, preset.algo, preset.config, args.seed,
+        scale_actions=args.scale_actions,
+    )
 
     watchdog = None
     if args.stall_timeout > 0:
