@@ -1,5 +1,6 @@
 from actor_critic_tpu.envs.jax_env import EnvSpec, JaxEnv, StepOutput, auto_reset
 from actor_critic_tpu.envs.cartpole import make_cartpole
+from actor_critic_tpu.envs.pendulum import make_pendulum
 from actor_critic_tpu.envs.pong import make_pong
 from actor_critic_tpu.envs.testbeds import (
     make_bandit,
@@ -14,6 +15,7 @@ __all__ = [
     "auto_reset",
     "make_bandit",
     "make_cartpole",
+    "make_pendulum",
     "make_point_mass",
     "make_pong",
     "make_two_state_mdp",

@@ -1,0 +1,98 @@
+"""Pure-JAX Pendulum-v1 with exact gymnasium dynamics.
+
+Gives the fused off-policy trainers (DDPG/TD3/SAC — rollout, HBM
+replay, and updates in ONE XLA program, SURVEY.md §3.2) a real physical
+continuous-control env on-device, complementing the analytic point-mass
+testbed. Dynamics, reward (computed from the PRE-step state and the
+clipped torque, as gymnasium does), reset distribution, torque/speed
+clips, and the 200-step time limit match gymnasium 1.2.2's
+`PendulumEnv` (verified numerically in tests/test_envs.py against the
+installed gymnasium). The same dynamics also back the C++ engine
+(native/vecenv.cpp) — this is the JAX twin for fused training.
+
+Action convention: policies emit normalized actions in [-1, 1]
+(tanh-Gaussian / clipped Gaussian); by default the env affine-maps them
+onto the ±2.0 torque range — the same convention as
+`HostEnvPool(scale_actions=True)` — so SAC's tanh actor has full
+actuator authority. `make_pendulum(scale_actions=False)` takes raw
+torques (clipped to ±2) for gymnasium-parity testing.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from actor_critic_tpu.envs.jax_env import EnvSpec, JaxEnv, auto_reset
+
+GRAVITY = 10.0
+MASS = 1.0
+LENGTH = 1.0
+DT = 0.05
+MAX_SPEED = 8.0
+MAX_TORQUE = 2.0
+MAX_STEPS = 200
+
+
+class PendulumState(NamedTuple):
+    theta: jax.Array
+    theta_dot: jax.Array
+    t: jax.Array
+    key: jax.Array
+
+
+def _obs(s: PendulumState) -> jax.Array:
+    return jnp.stack(
+        [jnp.cos(s.theta), jnp.sin(s.theta), s.theta_dot]
+    ).astype(jnp.float32)
+
+
+def _angle_normalize(x: jax.Array) -> jax.Array:
+    return ((x + jnp.pi) % (2.0 * jnp.pi)) - jnp.pi
+
+
+def _reset(key: jax.Array) -> tuple[PendulumState, jax.Array]:
+    key, sub = jax.random.split(key)
+    vals = jax.random.uniform(sub, (2,), jnp.float32) * 2.0 - 1.0
+    state = PendulumState(
+        theta=vals[0] * jnp.pi,
+        theta_dot=vals[1],
+        t=jnp.zeros((), jnp.int32),
+        key=key,
+    )
+    return state, _obs(state)
+
+
+def make_pendulum(scale_actions: bool = True) -> JaxEnv:
+    def _raw_step(state: PendulumState, action: jax.Array):
+        a = action.reshape(())
+        if scale_actions:
+            u = jnp.clip(a, -1.0, 1.0) * MAX_TORQUE
+        else:
+            u = jnp.clip(a, -MAX_TORQUE, MAX_TORQUE)
+        th, thdot = state.theta, state.theta_dot
+        # Reward from the PRE-step state + clipped torque (gymnasium
+        # returns -costs computed before integrating).
+        costs = (
+            _angle_normalize(th) ** 2 + 0.1 * thdot**2 + 0.001 * u**2
+        )
+        newthdot = thdot + (
+            3.0 * GRAVITY / (2.0 * LENGTH) * jnp.sin(th)
+            + 3.0 / (MASS * LENGTH**2) * u
+        ) * DT
+        newthdot = jnp.clip(newthdot, -MAX_SPEED, MAX_SPEED)
+        newth = th + newthdot * DT
+        t = state.t + 1
+
+        nstate = PendulumState(newth, newthdot, t, state.key)
+        terminated = jnp.zeros((), jnp.float32)  # never terminates
+        truncated = (t >= MAX_STEPS).astype(jnp.float32)
+        return nstate, _obs(nstate), -costs, terminated, truncated
+
+    spec = EnvSpec(
+        obs_shape=(3,), action_dim=1, discrete=False, episode_horizon=200
+    )
+    step = auto_reset(_reset, _raw_step, key_of_state=lambda s: s.key)
+    return JaxEnv(spec=spec, reset=_reset, step=step)

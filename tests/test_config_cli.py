@@ -142,3 +142,36 @@ def test_build_env_normalization_policy(algo, normalized):
         assert pool.normalizes_obs is normalized
     finally:
         pool.close()
+
+
+def test_build_env_scale_actions_tristate():
+    """--scale-actions threads through to BOTH env families; None keeps
+    each env's own convention (host pools clip, jax:pendulum scales)."""
+    import train as train_cli
+    from actor_critic_tpu.algos import sac
+
+    cfg = sac.SACConfig(num_envs=1)
+    pool, _ = train_cli.build_env("host:Pendulum-v1", "sac", cfg, 0)
+    assert pool.scales_actions is False  # None → pool default (clip)
+    pool.close()
+    pool, _ = train_cli.build_env(
+        "host:Pendulum-v1", "sac", cfg, 0, scale_actions=True
+    )
+    assert pool.scales_actions is True
+    pool.close()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    # jax:pendulum: None → scaled (env default); False → raw torque.
+    scaled, fused = train_cli.build_env("jax:pendulum", "sac", cfg, 0)
+    raw, _ = train_cli.build_env(
+        "jax:pendulum", "sac", cfg, 0, scale_actions=False
+    )
+    assert fused
+    s1, _ = scaled.reset(jax.random.key(0))
+    s2, _ = raw.reset(jax.random.key(0))
+    o1 = scaled.step(s1, jnp.asarray([0.5], jnp.float32))  # torque 1.0
+    o2 = raw.step(s2, jnp.asarray([1.0], jnp.float32))     # torque 1.0
+    np.testing.assert_allclose(np.asarray(o1.obs), np.asarray(o2.obs), rtol=1e-6)

@@ -111,3 +111,54 @@ def test_envs_vmap_and_jit():
     assert out.reward.shape == (E,)
     out2 = step_all(out.state, jnp.zeros((E,), jnp.int32))
     assert bool(jnp.all(jnp.isfinite(out2.obs)))
+
+
+def test_pendulum_matches_gymnasium_dynamics():
+    """Pure-JAX Pendulum vs installed gymnasium from identical states and
+    torque sequences (raw-torque mode so actions compare 1:1): obs and
+    reward must match to float32 precision over a full 200-step episode."""
+    gym = pytest.importorskip("gymnasium")
+    from actor_critic_tpu.envs import make_pendulum
+
+    genv = gym.make("Pendulum-v1").unwrapped
+    jenv = make_pendulum(scale_actions=False)
+
+    state, _ = jenv.reset(jax.random.key(3))
+    rng = np.random.RandomState(7)
+    th, thdot = rng.uniform(-np.pi, np.pi), rng.uniform(-1, 1)
+    genv.reset(seed=0)
+    genv.state = np.array([th, thdot], np.float64)
+    state = state._replace(
+        theta=jnp.asarray(th, jnp.float32),
+        theta_dot=jnp.asarray(thdot, jnp.float32),
+    )
+
+    for t in range(199):
+        a = rng.uniform(-2.5, 2.5)  # out-of-range exercises the clip
+        out = jenv.step(state, jnp.asarray([a], jnp.float32))
+        gobs, grew, _, _, _ = genv.step(np.array([a], np.float32))
+        np.testing.assert_allclose(out.obs, gobs, rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(float(out.reward), grew, rtol=1e-4, atol=1e-4)
+        assert float(out.done) == 0.0
+        state = out.state
+
+
+def test_pendulum_scaled_actions_and_truncation():
+    """Default scale_actions=True: normalized action a executes as torque
+    2a (a=1 ≡ raw torque 2.0); episodes truncate (never terminate) at 200."""
+    from actor_critic_tpu.envs import make_pendulum
+
+    scaled = make_pendulum()
+    raw = make_pendulum(scale_actions=False)
+    s1, _ = scaled.reset(jax.random.key(5))
+    s2, _ = raw.reset(jax.random.key(5))  # same key → same start
+    o1 = scaled.step(s1, jnp.asarray([0.75], jnp.float32))
+    o2 = raw.step(s2, jnp.asarray([1.5], jnp.float32))
+    np.testing.assert_allclose(o1.obs, o2.obs, rtol=1e-6)
+    np.testing.assert_allclose(float(o1.reward), float(o2.reward), rtol=1e-6)
+
+    st = s1._replace(t=jnp.asarray(199, jnp.int32))
+    out = scaled.step(st, jnp.asarray([0.0], jnp.float32))
+    assert float(out.done) == 1.0
+    assert float(out.info["terminated"]) == 0.0  # truncation, not termination
+    assert int(out.state.t) == 0

@@ -25,14 +25,22 @@ import sys
 import time
 
 
-def build_env(spec: str, algo: str, cfg, seed: int, scale_actions: bool = False):
-    """'jax:<name>' → (JaxEnv, fused=True); 'host:<id>' → (pool, False)."""
+def build_env(spec: str, algo: str, cfg, seed: int, scale_actions=None):
+    """'jax:<name>' → (JaxEnv, fused=True); 'host:<id>' → (pool, False).
+
+    scale_actions is tri-state: None keeps each env's own convention
+    (host pools clip — the recorded-run behavior; jax:pendulum scales),
+    True/False (--scale-actions / --no-scale-actions) forces it where
+    the env supports the choice."""
     kind, _, name = spec.partition(":")
     if kind == "jax":
         from actor_critic_tpu import envs as E
 
         makers = {
             "cartpole": E.make_cartpole,
+            "pendulum": lambda: E.make_pendulum(
+                scale_actions=True if scale_actions is None else scale_actions
+            ),
             "pong": E.make_pong,
             "two_state": E.make_two_state_mdp,
             "point_mass": E.make_point_mass,
@@ -64,7 +72,7 @@ def build_env(spec: str, algo: str, cfg, seed: int, scale_actions: bool = False)
                 normalize_obs=on_policy,
                 normalize_reward=on_policy,
                 backend="gym" if kind == "host" else "native",
-                scale_actions=scale_actions,
+                scale_actions=bool(scale_actions),
             ),
             False,
         )
@@ -227,12 +235,14 @@ def main(argv=None) -> int:
         "update overlap (A/B baseline; models/host_actor.py)",
     )
     p.add_argument(
-        "--scale-actions", action="store_true",
-        help="host envs (continuous): affine-map policy actions from "
-        "[-1,1] onto the env's Box bounds instead of clipping — keeps "
+        "--scale-actions", action=argparse.BooleanOptionalAction,
+        default=None,
+        help="continuous envs: affine-map policy actions from [-1,1] "
+        "onto the env's action bounds instead of clipping — keeps "
         "replayed == executed actions on narrow-bound envs like "
-        "Humanoid-v5 (±0.4). Never flip this on a resumed run: the "
-        "restored networks were trained under the other convention.",
+        "Humanoid-v5 (±0.4). Default: each env's own convention (host "
+        "pools clip; jax:pendulum scales). Never flip this on a resumed "
+        "run: the restored networks trained under the other convention.",
     )
     p.add_argument("--ckpt-dir", help="orbax checkpoint dir")
     p.add_argument("--save-every", type=int, default=100)
