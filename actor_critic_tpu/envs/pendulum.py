@@ -92,7 +92,8 @@ def make_pendulum(scale_actions: bool = True) -> JaxEnv:
         return nstate, _obs(nstate), -costs, terminated, truncated
 
     spec = EnvSpec(
-        obs_shape=(3,), action_dim=1, discrete=False, episode_horizon=200
+        obs_shape=(3,), action_dim=1, discrete=False,
+        episode_horizon=MAX_STEPS,
     )
     step = auto_reset(_reset, _raw_step, key_of_state=lambda s: s.key)
     return JaxEnv(spec=spec, reset=_reset, step=step)

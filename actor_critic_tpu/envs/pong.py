@@ -54,18 +54,30 @@ def make_pong(
     size: int = 84,
     points_to_win: int = 5,
     max_steps: int = 1000,
+    paddle_hh: float = 6.0,
+    ball_speed: float = 1.0,
 ) -> JaxEnv:
     """Build the Pong-like env. `size` ≥ 36 keeps the Nature CNN's VALID
-    conv stack non-degenerate (84 is the canonical Atari shape)."""
+    conv stack non-degenerate (84 is the canonical Atari shape).
+
+    Difficulty knobs (both at their hardest by default — the canonical
+    config): `paddle_hh` is the agent/opponent paddle half-height in
+    84-scale pixels, `ball_speed` scales the serve/vertical ball
+    velocities AND, deliberately, the opponent's paddle speed and the
+    hit-offset english (keeping opp_speed < vy_max, so the opponent
+    stays beatable at every difficulty). Pixel-pong from ±1 terminal rewards is a sparse-signal
+    task that needs tens of millions of frames at the defaults (as real
+    Pong does); a larger paddle / slower ball densify the reward signal
+    for learning demos and CI-budget learning tests."""
     if size < 36:
         raise ValueError("size must be >= 36 for the Nature-CNN conv stack")
     scale = size / 84.0
-    hh = 6.0 * scale            # paddle half-height (pixels)
+    hh = paddle_hh * scale      # paddle half-height (pixels)
     paddle_speed = 2.0 * scale
-    opp_speed = 1.1 * scale     # < max |vel_y| ⇒ opponent is beatable
-    serve_speed_x = 1.8 * scale
-    vy_max = 2.2 * scale
-    english = 1.2 * scale       # vy gain per unit of paddle-hit offset
+    opp_speed = 1.1 * scale * ball_speed  # < max |vel_y| ⇒ beatable
+    serve_speed_x = 1.8 * scale * ball_speed
+    vy_max = 2.2 * scale * ball_speed
+    english = 1.2 * scale * ball_speed  # vy gain per unit of hit offset
     player_x = float(size - 3)  # paddle planes
     opp_x = 2.0
     lo, hi = hh, float(size - 1) - hh  # paddle-center travel range

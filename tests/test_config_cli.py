@@ -175,3 +175,32 @@ def test_build_env_scale_actions_tristate():
     o1 = scaled.step(s1, jnp.asarray([0.5], jnp.float32))  # torque 1.0
     o2 = raw.step(s2, jnp.asarray([1.0], jnp.float32))     # torque 1.0
     np.testing.assert_allclose(np.asarray(o1.obs), np.asarray(o2.obs), rtol=1e-6)
+
+
+def test_check_env_convention_sidecar(tmp_path):
+    """Fused-path action-convention guard: first run records the flag in
+    a ckpt-dir sidecar; a resume with a flipped flag warns; matched and
+    legacy (no sidecar) resumes stay silent."""
+    import warnings
+
+    import train as train_cli
+
+    d = str(tmp_path / "ck")
+    train_cli.check_env_convention(d, "jax:pendulum", None, resume=False)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        train_cli.check_env_convention(d, "jax:pendulum", None, resume=True)
+    assert not caught
+    with pytest.warns(UserWarning, match="action\nconvention|other action"):
+        train_cli.check_env_convention(d, "jax:pendulum", False, resume=True)
+    # Legacy dir without a sidecar: resume is silent (tolerant).
+    legacy = str(tmp_path / "legacy")
+    import os
+
+    os.makedirs(legacy)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        train_cli.check_env_convention(legacy, "jax:pendulum", True, resume=True)
+    assert not caught
+    # No ckpt dir at all: no-op.
+    train_cli.check_env_convention(None, "jax:pendulum", True, resume=True)

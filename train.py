@@ -81,6 +81,39 @@ def build_env(spec: str, algo: str, cfg, seed: int, scale_actions=None):
     )
 
 
+def check_env_convention(ckpt_dir, env_spec: str, scale_actions, resume: bool):
+    """Fused-path twin of the host path's `_pool_scale_actions` resume
+    guard (algos/host_loop.py): record the run's action-convention flag
+    in a sidecar JSON next to the checkpoints, and warn when a resume
+    flips it — the restored policy's actions would silently execute
+    under the other convention (e.g. jax:pendulum ±2-scaled vs raw
+    torques). Tolerant of pre-existing checkpoint dirs without the
+    sidecar."""
+    if not ckpt_dir:
+        return
+    import os
+    import warnings
+
+    path = os.path.join(ckpt_dir, "env_convention.json")
+    current = {"env": env_spec, "scale_actions": scale_actions}
+    if resume and os.path.exists(path):
+        with open(path) as f:
+            saved = json.load(f)
+        if saved.get("scale_actions") != scale_actions:
+            warnings.warn(
+                f"--resume with scale_actions={scale_actions!r} but this "
+                f"run started with {saved.get('scale_actions')!r} — the "
+                "restored policy trained under the other action "
+                "convention. Relaunch with the original flag.",
+                stacklevel=2,
+            )
+        return
+    if not os.path.exists(path):
+        os.makedirs(ckpt_dir, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(current, f)
+
+
 def fused_module(algo: str):
     from actor_critic_tpu.algos import a2c, ddpg, impala, ppo, sac
 
@@ -278,6 +311,12 @@ def main(argv=None) -> int:
         preset.env, preset.algo, preset.config, args.seed,
         scale_actions=args.scale_actions,
     )
+    if fused:
+        # Host pools carry their convention in the checkpoint metrics
+        # (host_loop); fused envs use a ckpt-dir sidecar.
+        check_env_convention(
+            args.ckpt_dir, preset.env, args.scale_actions, args.resume
+        )
 
     watchdog = None
     if args.stall_timeout > 0:
