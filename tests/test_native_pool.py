@@ -271,3 +271,35 @@ def test_acrobot_termination_parity():
     )
     # fresh episode obs: all four state vars uniform in [-0.1, 0.1]
     assert abs(nobs[0, 4]) <= 0.1 and abs(nobs[0, 5]) <= 0.1
+
+
+@pytest.mark.slow
+def test_ppo_learns_native_acrobot():
+    """Learning test on the C++ engine's Acrobot: the full host PPO path
+    (native batch stepping + normalization + jitted learner) reaches
+    greedy eval >= -100 (the conventional solve bar) within 150
+    iterations / 307k env steps. The recorded run
+    (results/ppo_acrobot_native_cpu.jsonl) hits -83.8 by iteration 25,
+    so 150 leaves wide margin; wall-clock is ~10 s of stepping on the
+    1-core host."""
+    from actor_critic_tpu.algos import ppo
+
+    pool = HostEnvPool(
+        "Acrobot-v1", num_envs=16, seed=0, backend="native",
+        normalize_obs=True, normalize_reward=True,
+    )
+    cfg = ppo.PPOConfig(
+        num_envs=16, rollout_steps=128, epochs=4, num_minibatches=8,
+        anneal_iters=300, lr_final=0.0,  # the recorded run's schedule —
+        # this test replays its first 150 iterations exactly
+    )
+    best = -float("inf")
+    _, _, history = ppo.train_host(
+        pool, cfg, num_iterations=150, seed=0, log_every=0,
+        eval_every=50, eval_envs=8, eval_steps=500,
+    )
+    for _, m in history:
+        if "eval_return" in m:
+            best = max(best, m["eval_return"])
+    pool.close()
+    assert best >= -100.0, f"native Acrobot not learned: best eval {best}"

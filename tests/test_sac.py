@@ -147,3 +147,28 @@ class TestHostPath:
         assert int(learner.replay.size) == K * E
         assert int(learner.update_count) == 1
         assert np.isfinite(float(metrics["critic_loss"]))
+
+
+@pytest.mark.slow
+def test_sac_learns_jax_pendulum_fused():
+    """Fused-path learning test on the pure-JAX Pendulum: rollout + HBM
+    replay + updates in one XLA program reach greedy eval >= -250
+    within 2000 iterations / 128k env steps (the recorded run —
+    results/sac_jax_pendulum_cpu.jsonl — is at -137 by 192k steps; a
+    random policy scores ~-1200, an always-max-torque one ~-880)."""
+    from actor_critic_tpu.envs import make_pendulum
+
+    env = make_pendulum()
+    cfg = sac.SACConfig(
+        num_envs=8, steps_per_iter=8, updates_per_iter=8,
+        hidden=(128, 128), batch_size=128, warmup_steps=1000,
+    )
+    state = sac.init_state(env, cfg, jax.random.key(0))
+    step = jax.jit(sac.make_train_step(env, cfg), donate_argnums=0)
+    eval_fn = jax.jit(sac.make_eval_fn(env, cfg), static_argnums=(2, 3))
+    best = -float("inf")
+    for it in range(2000):
+        state, m = step(state)
+        if (it + 1) % 500 == 0:
+            best = max(best, float(eval_fn(state, jax.random.key(1), 8, 200)))
+    assert best >= -250.0, f"jax pendulum not learned: best eval {best}"
