@@ -68,7 +68,8 @@ def make_network(env: JaxEnv, cfg: A2CConfig):
     dtype = jnp.bfloat16 if cfg.bf16_compute else jnp.float32
     if env.spec.discrete:
         return ActorCriticDiscrete(
-            num_actions=env.spec.action_dim, hidden=cfg.hidden, compute_dtype=dtype
+            num_actions=env.spec.action_dim, hidden=cfg.hidden,
+            pixel_obs=env.spec.pixel_obs, compute_dtype=dtype,
         )
     return ActorCriticGaussian(
         action_dim=env.spec.action_dim, hidden=cfg.hidden, compute_dtype=dtype

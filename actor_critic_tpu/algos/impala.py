@@ -94,7 +94,7 @@ def make_network(env: JaxEnv, cfg: ImpalaConfig):
         return ActorCriticDiscrete(
             num_actions=env.spec.action_dim,
             hidden=cfg.hidden,
-            pixel_obs=len(env.spec.obs_shape) == 3,
+            pixel_obs=env.spec.pixel_obs,
             compute_dtype=dtype,
         )
     return ActorCriticGaussian(

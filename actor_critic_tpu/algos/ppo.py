@@ -84,7 +84,8 @@ def make_network(env_spec, cfg: PPOConfig):
     dtype = jnp.bfloat16 if cfg.bf16_compute else jnp.float32
     if env_spec.discrete:
         return ActorCriticDiscrete(
-            num_actions=env_spec.action_dim, hidden=cfg.hidden, compute_dtype=dtype
+            num_actions=env_spec.action_dim, hidden=cfg.hidden,
+            pixel_obs=env_spec.pixel_obs, compute_dtype=dtype,
         )
     return ActorCriticGaussian(
         action_dim=env_spec.action_dim, hidden=cfg.hidden, compute_dtype=dtype

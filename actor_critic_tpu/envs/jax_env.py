@@ -56,6 +56,13 @@ class EnvSpec:
     # from the finished-episode mean — common.evaluate docstring).
     episode_horizon: int = 0
 
+    @property
+    def pixel_obs(self) -> bool:
+        """Whether observations are image-shaped ([H, W, C]) — the single
+        rule every algorithm's make_network uses to pick the Nature CNN
+        over the MLP torso (keep it here, not copy-pasted per algo)."""
+        return len(self.obs_shape) == 3
+
 
 @dataclasses.dataclass(frozen=True)
 class JaxEnv:

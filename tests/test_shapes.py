@@ -67,3 +67,24 @@ def test_train_step_preserves_state_shapes(mod, make_env, cfg):
     chex.assert_trees_all_equal_shapes_and_dtypes(state, out_state)
     for k, v in metrics.items():
         assert v.shape == (), f"metric {k} is not scalar: {v.shape}"
+
+
+def test_ppo_a2c_pixel_networks_use_cnn():
+    """PPO/A2C must route 3-D (pixel) observations through the Nature
+    CNN like IMPALA does — with the MLP torso a [B,H,W,C] batch produces
+    garbage shapes. Regression for the round-3 fix."""
+    import jax.numpy as jnp
+
+    env = make_pong(size=36)
+    for make in (lambda: ppo.make_network(env.spec, ppo.PPOConfig()),
+                 lambda: a2c.make_network(env, a2c.A2CConfig())):
+        net = make()
+        obs = jnp.zeros((2, *env.spec.obs_shape), jnp.uint8)
+        params = net.init(jax.random.key(0), obs)
+        assert any(
+            "conv" in "/".join(str(p.key) for p in path)
+            for path, _ in jax.tree.flatten_with_path(params)[0]
+        ), "pixel obs did not route through the CNN torso"
+        dist, value = net.apply(params, obs)
+        assert value.shape == (2,)
+        assert dist.logits.shape == (2, env.spec.action_dim)
