@@ -57,6 +57,7 @@ def make_pong(
     paddle_hh: float = 6.0,
     ball_speed: float = 1.0,
     opp_skill: float = 1.0,
+    frame_skip: int = 1,
 ) -> JaxEnv:
     """Build the Pong-like env. `size` ≥ 36 keeps the Nature CNN's VALID
     conv stack non-degenerate (84 is the canonical Atari shape).
@@ -74,12 +75,20 @@ def make_pong(
     γ=0.99 credit assignment), while at ~0.5 placed shots score within
     ~100 steps, the regime where pixel-pong is learnable at single-
     digit millions of frames (like ALE Pong's beatable computer
-    paddle). Pixel-pong from ±1 terminal rewards is a sparse-signal
+    paddle). `frame_skip` is ALE's action repeat: one agent decision
+    drives k physics frames and rewards sum over the window — without
+    it the ball moves sub-pixel between the two stacked frames (its
+    VELOCITY is invisible to the CNN) and credit horizons stretch k×
+    past every published pong recipe, which all assume skip=4. Default
+    1 preserves the recorded throughput rows; learning configs want 4.
+    `max_steps` counts agent decisions (windows), not physics frames. Pixel-pong from ±1 terminal rewards is a sparse-signal
     task that needs tens of millions of frames at the defaults (as real
     Pong does); a larger paddle / slower ball densify the reward signal
     for learning demos and CI-budget learning tests."""
     if size < 36:
         raise ValueError("size must be >= 36 for the Nature-CNN conv stack")
+    if frame_skip < 1:
+        raise ValueError("frame_skip must be >= 1 (0 would freeze the env)")
     if not 0.0 <= opp_skill < 2.0:
         # opp_speed = 1.1·scale·ball_speed·opp_skill must stay below
         # vy_max = 2.2·scale·ball_speed, or the opponent tracks every
@@ -132,17 +141,18 @@ def make_pong(
         obs = jnp.stack([frame, frame], axis=-1)
         return state, obs
 
-    def raw_step(state: PongState, action: jax.Array):
-        move = jnp.where(action == 1, -1.0, jnp.where(action == 2, 1.0, 0.0))
-        player_y = jnp.clip(state.player_y + move * paddle_speed, lo, hi)
+    def physics_substep(core, move):
+        """One physics frame with the agent's move held fixed (the action
+        repeats across a frame-skip window, ALE-style)."""
+        (ball_x0, ball_y0, vel_x, vel_y, player_y, opp_y,
+         player_score, opp_score, key) = core
+        player_y = jnp.clip(player_y + move * paddle_speed, lo, hi)
         opp_y = jnp.clip(
-            state.opp_y + jnp.clip(state.ball_y - state.opp_y, -opp_speed, opp_speed),
-            lo, hi,
+            opp_y + jnp.clip(ball_y0 - opp_y, -opp_speed, opp_speed), lo, hi
         )
 
-        ball_x = state.ball_x + state.vel_x
-        ball_y = state.ball_y + state.vel_y
-        vel_x, vel_y = state.vel_x, state.vel_y
+        ball_x = ball_x0 + vel_x
+        ball_y = ball_y0 + vel_y
 
         # Top/bottom wall bounce (positions reflect, vy flips).
         top = jnp.float32(size - 1)
@@ -170,16 +180,47 @@ def make_pong(
         player_point = ball_x < 0.0          # opponent missed
         opp_point = ball_x > jnp.float32(size - 1)  # agent missed
         reward = jnp.where(player_point, 1.0, jnp.where(opp_point, -1.0, 0.0))
-        player_score = state.player_score + player_point.astype(jnp.int32)
-        opp_score = state.opp_score + opp_point.astype(jnp.int32)
+        player_score = player_score + player_point.astype(jnp.int32)
+        opp_score = opp_score + opp_point.astype(jnp.int32)
 
-        key, skey = jax.random.split(state.key)
+        key, skey = jax.random.split(key)
         sx, sy, svx, svy = serve(skey)
         scored = player_point | opp_point
         ball_x = jnp.where(scored, sx, ball_x)
         ball_y = jnp.where(scored, sy, ball_y)
         vel_x = jnp.where(scored, svx, vel_x)
         vel_y = jnp.where(scored, svy, vel_y)
+
+        return (
+            ball_x, ball_y, vel_x, vel_y, player_y, opp_y,
+            player_score, opp_score, key,
+        ), reward
+
+    def raw_step(state: PongState, action: jax.Array):
+        move = jnp.where(action == 1, -1.0, jnp.where(action == 2, 1.0, 0.0))
+        core = (
+            state.ball_x, state.ball_y, state.vel_x, state.vel_y,
+            state.player_y, state.opp_y,
+            state.player_score, state.opp_score, state.key,
+        )
+        if frame_skip == 1:
+            core, reward = physics_substep(core, move)
+        else:
+            # ALE-style action repeat: the same move drives `frame_skip`
+            # physics frames; rewards sum over the window. (Play continues
+            # within a window even if the final point lands mid-window —
+            # the rally after match point is unobserved and harmless,
+            # matching how ALE's skip can overrun a terminal frame.)
+            def sub(carry, _):
+                c, rew = carry
+                c, r = physics_substep(c, move)
+                return (c, rew + r), None
+
+            (core, reward), _ = jax.lax.scan(
+                sub, (core, jnp.zeros(())), None, length=frame_skip
+            )
+        (ball_x, ball_y, vel_x, vel_y, player_y, opp_y,
+         player_score, opp_score, key) = core
 
         t = state.t + 1
         terminated = (
