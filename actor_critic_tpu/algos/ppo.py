@@ -184,6 +184,19 @@ def ppo_loss(
     }
 
 
+def should_unroll_update(env_spec, cfg: "PPOConfig") -> bool:
+    """Default policy for `ppo_update(unroll=...)`: fully unroll the
+    epoch/minibatch loop nest when the torso is a CNN, the backend is
+    XLA:CPU (whose conv custom-call cannot fire inside a scan body —
+    measured 37× slower), and the nest is small enough that straight-
+    line compilation stays cheap. TPU/GPU always scan."""
+    return (
+        env_spec.pixel_obs
+        and jax.default_backend() == "cpu"
+        and cfg.epochs * cfg.num_minibatches <= 64
+    )
+
+
 def ppo_update(
     params: Any,
     opt_state: Any,
@@ -194,6 +207,7 @@ def ppo_update(
     cfg: PPOConfig,
     axis_name: Optional[str] = None,
     progress: Optional[jax.Array] = None,
+    unroll: bool = False,
 ) -> tuple[Any, Any, dict[str, jax.Array]]:
     """E epochs × M shuffled minibatches of PPO updates, all in-jit.
 
@@ -201,6 +215,12 @@ def ppo_update(
     each device shuffles its local shard; gradients pmean per minibatch
     (the ICI analogue of the reference's per-step NCCL all-reduce).
     `progress` is the anneal fraction in [0, 1] (clip-ε schedule).
+    `unroll=True` fully unrolls the epoch/minibatch scans — identical
+    math, straight-line XLA. Load-bearing on XLA:CPU with CNN torsos,
+    where convolutions inside a scan body cannot use the fast conv
+    custom-call and fall back to naive codegen (measured 37× slower on
+    a 1280-sample pixel minibatch); TPU lowers scanned convs fine. Use
+    `should_unroll_update` for the default policy.
     """
     B = batch.obs.shape[0]
     mb = B // cfg.num_minibatches
@@ -225,11 +245,11 @@ def ppo_update(
     def epoch_body(carry, ekey):
         perm = jax.random.permutation(ekey, B)
         idxs = perm.reshape(cfg.num_minibatches, mb)
-        return jax.lax.scan(minibatch_body, carry, idxs)
+        return jax.lax.scan(minibatch_body, carry, idxs, unroll=unroll)
 
     epoch_keys = jax.random.split(key, cfg.epochs)
     (params, opt_state), metrics = jax.lax.scan(
-        epoch_body, (params, opt_state), epoch_keys
+        epoch_body, (params, opt_state), epoch_keys, unroll=unroll
     )
     # metrics: [epochs, minibatches] — report the mean over the loop nest.
     metrics = jax.tree.map(jnp.mean, metrics)
@@ -319,7 +339,7 @@ def make_host_update_step(env_spec, cfg: PPOConfig, can_truncate: bool = True):
         )
         return ppo_update(
             params, opt_state, batch, key, apply_fn, opt, cfg,
-            progress=progress,
+            progress=progress, unroll=should_unroll_update(env_spec, cfg),
         )
 
     return update
@@ -560,6 +580,7 @@ def make_train_step(
         new_params, new_opt_state, metrics = ppo_update(
             state.params, state.opt_state, batch, ukey, apply_fn, opt, cfg,
             axis_name, progress=anneal_progress(cfg, state.update_step),
+            unroll=should_unroll_update(env.spec, cfg),
         )
 
         ep_ret, ep_len, avg_ret, ep_metrics = episode_metrics_update(

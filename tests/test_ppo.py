@@ -164,3 +164,50 @@ def test_ppo_learns_point_mass_continuous():
         state, metrics = step(state)
     # verified convergence profile: ema ≈ -0.12 at 300 iters, policy mean ≈ -pos
     assert float(metrics["avg_return_ema"]) > -0.3
+
+
+def test_ppo_update_unroll_equivalence():
+    """`unroll=True` must be bit-for-bit the same math as the scanned
+    loop nest — it exists purely as an XLA:CPU lowering workaround
+    (convs inside scan bodies can't use the fast conv custom-call)."""
+    import numpy as np
+
+    from actor_critic_tpu.envs import make_pong
+
+    env = make_pong(size=36)
+    cfg = ppo.PPOConfig(num_envs=4, rollout_steps=4, epochs=2,
+                        num_minibatches=2)
+    net = ppo.make_network(env.spec, cfg)
+    opt = ppo.make_optimizer(cfg)
+    B = 16
+    obs = jnp.asarray(
+        np.random.default_rng(0).integers(0, 255, (B, 36, 36, 2)), jnp.uint8
+    )
+    batch = ppo.PPOBatch(
+        obs=obs,
+        action=jnp.zeros((B,), jnp.int32),
+        log_prob_old=jnp.full((B,), -1.0),
+        value_old=jnp.zeros((B,)),
+        advantage=jnp.linspace(-1, 1, B),
+        ret=jnp.linspace(0, 1, B),
+    )
+    params = net.init(jax.random.key(0), obs[:1])
+    os0 = opt.init(params)
+    key = jax.random.key(7)
+    p1, _, m1 = ppo.ppo_update(
+        params, os0, batch, key, net.apply, opt, cfg, unroll=False
+    )
+    p2, _, m2 = ppo.ppo_update(
+        params, os0, batch, key, net.apply, opt, cfg, unroll=True
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7
+        ),
+        p1, p2,
+    )
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-6)
+    # And the default policy: CPU + pixels + small nest → unroll.
+    assert ppo.should_unroll_update(env.spec, cfg) is True
+    big = ppo.PPOConfig(epochs=10, num_minibatches=32)
+    assert ppo.should_unroll_update(env.spec, big) is False
