@@ -421,6 +421,7 @@ def train_host(
     save_every: int = 0,
     resume: bool = False,
     overlap: bool = True,
+    save_replay: bool = True,
 ):
     """DDPG/TD3 on a HostEnvPool (host rollout, device learner).
 
@@ -450,4 +451,5 @@ def train_host(
         ckpt=ckpt, save_every=save_every, resume=resume,
         overlap=overlap, make_host_explore=make_ddpg_host_explore,
         make_host_greedy=make_ddpg_host_greedy,
+        save_replay=save_replay,
     )

@@ -105,14 +105,40 @@ def host_evaluate(
     return float(returns.mean())
 
 
-def host_ckpt_state(pool, **device_state) -> dict:
+def host_ckpt_state(pool, save_replay: bool = True, **device_state) -> dict:
     """Assemble the host-trainer checkpoint pytree: the device-side state
     (learner/params/opt/key/env_steps) plus the pool's normalizer stats,
-    every leaf coerced to an array so orbax round-trips it."""
+    every leaf coerced to an array so orbax round-trips it.
+
+    `save_replay=False` strips the learner's replay ring down to a
+    one-slot stub (SURVEY §5.4 scopes buffer checkpointing as optional):
+    a Humanoid-scale ring is ~3 GB per save, untenable at a real save
+    cadence. Resuming such a checkpoint restarts with an EMPTY buffer —
+    the warmup gate (`size >= batch_size`) pauses updates for the few
+    iterations the fresh ring needs to refill, then training continues
+    on fresh experience only.
+    """
+    if not save_replay and "learner" in device_state:
+        device_state = dict(device_state)
+        device_state["learner"] = strip_replay(device_state["learner"])
     return {
         **device_state,
         "pool": np_tree(pool.get_state()),
     }
+
+
+def strip_replay(learner):
+    """Learner with its replay storage truncated to one slot (shape and
+    dtype preserved so save/restore templates stay structurally stable;
+    cursors ride along but are discarded on reattach)."""
+    import jax
+
+    rb = learner.replay
+    return learner._replace(
+        replay=rb._replace(
+            storage=jax.tree.map(lambda x: x[:1], rb.storage)
+        )
+    )
 
 
 def np_tree(d):
@@ -127,7 +153,7 @@ from actor_critic_tpu.utils.cadence import should_save  # noqa: E402, F401
 
 def host_maybe_save(
     ckpt, it: int, save_every: int, num_iterations: int, pool, metrics: dict,
-    **device_state,
+    save_replay: bool = True, **device_state,
 ) -> None:
     """Save the host-trainer state on the `should_save` cadence (`it` is
     1-based). Syncs the device state first; the orbax device→host fetch
@@ -138,16 +164,19 @@ def host_maybe_save(
     import jax
 
     jax.block_until_ready(device_state)
-    # The pool's action convention rides the tolerant metrics JSON (NOT
-    # the state tree: adding a leaf there would structurally invalidate
-    # every pre-existing checkpoint under orbax's exact-template
-    # restore) so host_resume can warn on a convention flip.
+    # The pool's action convention and the replay-saved flag ride the
+    # tolerant metrics JSON (NOT the state tree: adding a leaf there
+    # would structurally invalidate every pre-existing checkpoint under
+    # orbax's exact-template restore) so host_resume can warn on a
+    # convention flip and resume can build the matching template.
     metrics = {
         **(metrics or {}),
         "_pool_scale_actions": float(getattr(pool, "scales_actions", False)),
+        "_replay_saved": float(save_replay),
     }
     ckpt.save(
-        it, host_ckpt_state(pool, **device_state), metrics=metrics, force=True
+        it, host_ckpt_state(pool, save_replay=save_replay, **device_state),
+        metrics=metrics, force=True,
     )
 
 
@@ -228,6 +257,7 @@ def off_policy_train_host(
     overlap: bool = True,
     make_host_explore: Optional[Callable] = None,
     make_host_greedy: Optional[Callable] = None,
+    save_replay: bool = True,
 ):
     """Shared host-env loop for the off-policy trainers (DDPG/TD3, SAC).
 
@@ -279,12 +309,38 @@ def off_policy_train_host(
     env_steps = 0
     start_it = 0
     if ckpt is not None and resume:
+        # The TEMPLATE must mirror what the checkpoint actually holds:
+        # the saved `_replay_saved` metric (not this run's flag) decides
+        # whether the learner tree carries the full ring or the one-slot
+        # stub. Legacy checkpoints (no flag) saved the full ring.
+        step = ckpt.latest_step()
+        saved_replay = True
+        if step is not None:
+            saved_replay = bool(
+                ckpt.restore_metrics(step).get("_replay_saved", 1.0)
+            )
+        template_learner = learner if saved_replay else strip_replay(learner)
         template = host_ckpt_state(
-            pool, learner=learner, key=key, env_steps=np.asarray(0, np.int64)
+            pool, learner=template_learner, key=key,
+            env_steps=np.asarray(0, np.int64),
         )
         restored, start_it = host_resume(ckpt, template, pool)
         if restored is not None:
-            learner = restored["learner"]
+            restored_learner = restored["learner"]
+            if not saved_replay:
+                warnings.warn(
+                    "resuming a replay-free checkpoint (save_replay=False): "
+                    "the buffer restarts EMPTY — updates pause until it "
+                    "refills past one batch, then continue on fresh "
+                    "experience only.",
+                    stacklevel=2,
+                )
+                # Reattach this run's zeroed full-capacity ring; the
+                # stub's cursors are stale by construction.
+                restored_learner = restored_learner._replace(
+                    replay=learner.replay
+                )
+            learner = restored_learner
             key = restored["key"]
             env_steps = int(restored["env_steps"])
 
@@ -378,6 +434,7 @@ def off_policy_train_host(
         )
         host_maybe_save(
             ckpt, it + 1, save_every, num_iterations, pool, metrics,
+            save_replay=save_replay,
             learner=learner, key=key,
             env_steps=np.asarray(env_steps, np.int64),
         )

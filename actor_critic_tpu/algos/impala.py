@@ -320,6 +320,14 @@ def make_sp_update(
     with the unsharded update is tested on the 8-device CPU mesh, in
     both 1-D sp and 2×4 sp×dp layouts (tests/test_seqpar.py).
     """
+    fn, _, _ = _sp_update_shardmap(env, cfg, mesh, axis_name, dp_axis_name)
+    return jax.jit(fn)
+
+
+def _sp_update_shardmap(env, cfg, mesh, axis_name=None, dp_axis_name=None):
+    """The shard_map'd sp learner update, un-jitted, plus the traj /
+    bootstrap PartitionSpecs — shared by `make_sp_update` (standalone)
+    and `make_sp_train_step` (fused rollout→update program)."""
     from jax.sharding import PartitionSpec as P
 
     from actor_critic_tpu.parallel.seqpar import SP_AXIS
@@ -355,7 +363,93 @@ def make_sp_update(
         out_specs=(P(), P(), P()),
         check_vma=False,
     )
-    return jax.jit(fn)
+    return fn, traj_spec, boot_spec
+
+
+def make_sp_train_step(
+    env: JaxEnv, cfg: ImpalaConfig, mesh, axis_name=None, dp_axis_name=None
+):
+    """ONE jitted program: rollout(stale actor) → resharding constraint →
+    sequence-parallel V-trace update → k-step actor refresh.
+
+    This is the end-to-end form of the claim sp exists for: a trainer
+    PRODUCES the long [T, E] trajectory (rollout is time-sequential by
+    nature, so it runs env-parallel — sharded over the mesh's dp axis
+    when present) and the learner consumes it time-sharded over sp, with
+    XLA inserting the redistribution between the two layouts inside the
+    same program. Metric/param equivalence with `make_train_step` is
+    tested on the 8-device CPU mesh (tests/test_seqpar.py).
+    """
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    upd, traj_spec, _ = _sp_update_shardmap(
+        env, cfg, mesh, axis_name, dp_axis_name
+    )
+    net = make_network(env, cfg)
+    apply_fn = net.apply
+
+    def train_step(state: ImpalaTrainState):
+        key, rkey = jax.random.split(state.key)
+        # The rollout is time-SEQUENTIAL (a scan), so it cannot be sp-
+        # sharded; pin its carry replicated so sharding propagation from
+        # the sp-resharded consumer below can't leak a partitioned
+        # layout back into the per-step vmap (explicit-mesh axes are
+        # part of the value types).
+        rollout_in = jax.tree.map(
+            lambda x: jax.sharding.reshard(x, NamedSharding(mesh, P())),
+            state.rollout,
+        )
+        new_rollout, traj = rollout_scan(
+            env, apply_fn, state.actor_params, rollout_in, rkey,
+            cfg.rollout_steps,
+        )
+        # Episode accounting folds a scan over TIME, so it reads the
+        # rollout-layout trajectory (before the time axis is sharded).
+        ep_ret, ep_len, avg_ret, ep_metrics = episode_metrics_update(
+            state.ep_return, state.ep_length, state.avg_return, traj
+        )
+
+        # Rollout materializes [T, E] time-major on the dp layout; the
+        # reshard makes XLA redistribute the TIME axis over sp for the
+        # learner (an all-to-all over ICI) inside this program. (The
+        # mesh axes are Explicit-typed, so `reshard` is the constraint
+        # API — with_sharding_constraint only talks to Auto axes.)
+        traj_sp = jax.tree.map(
+            lambda x: jax.sharding.reshard(
+                x,
+                NamedSharding(
+                    mesh,
+                    P(*traj_spec, *((None,) * (x.ndim - len(traj_spec)))),
+                ),
+            ),
+            traj,
+        )
+        new_params, new_opt_state, metrics = upd(
+            state.params, state.opt_state, traj_sp, new_rollout.obs
+        )
+
+        new_step = state.update_step + 1
+        refresh = (new_step % cfg.actor_refresh_every) == 0
+        new_actor_params = jax.tree.map(
+            lambda n, o: jnp.where(refresh, n, o), new_params,
+            state.actor_params,
+        )
+        metrics = {**metrics, **ep_metrics, "avg_return_ema": avg_ret}
+        new_state = ImpalaTrainState(
+            params=new_params,
+            actor_params=new_actor_params,
+            opt_state=new_opt_state,
+            rollout=new_rollout,
+            key=key,
+            update_step=new_step,
+            ep_return=ep_ret,
+            ep_length=ep_len,
+            avg_return=avg_ret,
+        )
+        return new_state, metrics
+
+    return jax.jit(train_step)
 
 
 def train(

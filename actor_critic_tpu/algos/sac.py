@@ -412,6 +412,7 @@ def train_host(
     save_every: int = 0,
     resume: bool = False,
     overlap: bool = True,
+    save_replay: bool = True,
 ):
     """SAC on a HostEnvPool (host rollout, device learner). Use
     normalize_obs=False AND normalize_reward=False on the pool: running-
@@ -439,4 +440,5 @@ def train_host(
         ckpt=ckpt, save_every=save_every, resume=resume,
         overlap=overlap, make_host_explore=make_sac_host_explore,
         make_host_greedy=make_sac_host_greedy,
+        save_replay=save_replay,
     )

@@ -26,6 +26,11 @@ class Preset:
     config: Any      # the algorithm's frozen config dataclass
     iterations: int  # default --iterations
     description: str
+    # Keyword arguments for the ENV constructor (the jax:* maker, or
+    # gym.make for host pools) — the difficulty/shape knobs that define
+    # a runnable result, e.g. pong's opp_skill/frame_skip. CLI
+    # `--env-set key=value` merges over these.
+    env_kwargs: dict = dataclasses.field(default_factory=dict)
 
 
 PRESETS: dict[str, Preset] = {
@@ -120,6 +125,24 @@ PRESETS: dict[str, Preset] = {
         iterations=2000,
         description="IMPALA/V-trace on JAX Pong-like pixels (BASELINE.json:11)",
     ),
+    # The config-5 setup that PROVABLY LEARNS (round 3, BASELINE.md:
+    # eval −3.78 → +2.41 over 51.2M decisions): same learner as
+    # impala_pong, env at the learnable difficulty — opponent tracking
+    # at half speed (placed shots score within ~100 steps instead of
+    # hundreds), ALE-style frame_skip=4 (ball velocity visible in the
+    # 2-frame stack), 36px frames. 40k iterations ≈ 51.2M decisions
+    # reproduces the recorded curve; eval crosses 0 at ~27M.
+    "impala_pong_learn": Preset(
+        algo="impala",
+        env="jax:pong",
+        config=impala.ImpalaConfig(
+            num_envs=64, rollout_steps=20, actor_refresh_every=4
+        ),
+        iterations=40_000,
+        description="IMPALA on JAX Pong at the learnable difficulty "
+        "(opp_skill=0.5, frame_skip=4, 36px — BASELINE.json:11)",
+        env_kwargs={"opp_skill": 0.5, "frame_skip": 4, "size": 36},
+    ),
     "a3c_pong": Preset(
         algo="a3c",
         env="jax:pong",
@@ -203,6 +226,30 @@ def parse_set_args(pairs: list[str]) -> dict[str, str]:
     return out
 
 
+def coerce_env_value(raw: str) -> Any:
+    """Parse an `--env-set` value. Env-maker kwargs are not dataclass
+    fields, so there is no annotation to coerce against — use literal
+    syntax: bools/None by keyword, then int, then float, else string."""
+    low = raw.lower()
+    if low in ("true", "yes", "on"):
+        return True
+    if low in ("false", "no", "off"):
+        return False
+    if low in ("none", "null"):
+        return None
+    for typ in (int, float):
+        try:
+            return typ(raw)
+        except ValueError:
+            pass
+    return raw
+
+
+def parse_env_set_args(pairs: list[str]) -> dict[str, Any]:
+    """['opp_skill=0.5', 'frame_skip=4'] → {'opp_skill': 0.5, 'frame_skip': 4}."""
+    return {k: coerce_env_value(v) for k, v in parse_set_args(pairs).items()}
+
+
 def default_config(algo: str) -> Any:
     """The algorithm's default config, with variant specialization applied
     (td3 → twin-Q/delay/smoothing; a3c → no importance correction)."""
@@ -221,17 +268,23 @@ def resolve(
     algo: Optional[str],
     env: Optional[str],
     overrides: dict[str, str],
+    env_overrides: Optional[dict[str, Any]] = None,
 ) -> Preset:
     """Resolve CLI selections into a concrete Preset.
 
     Either `--preset name` (optionally overridden by --algo/--env) or
     `--algo` + `--env` from scratch with that algorithm's default config.
+    `env_overrides` (from --env-set) merge over the preset's env_kwargs;
+    changing the env drops the preset's env_kwargs (they belong to the
+    preset's env), keeping only the CLI ones.
     """
+    env_overrides = env_overrides or {}
     if preset is not None:
         if preset not in PRESETS:
             raise KeyError(f"unknown preset {preset!r}; valid: {sorted(PRESETS)}")
         base = PRESETS[preset]
         algo = algo or base.algo
+        base_env_kwargs = base.env_kwargs if env in (None, base.env) else {}
         env = env or base.env
         # Changing the algo drops the preset's config (it belongs to the
         # preset's algorithm) in favor of the new algo's specialized
@@ -241,6 +294,7 @@ def resolve(
         return Preset(
             algo=algo, env=env, config=apply_overrides(cfg, overrides),
             iterations=base.iterations, description=base.description,
+            env_kwargs={**base_env_kwargs, **env_overrides},
         )
     if algo is None or env is None:
         raise ValueError("need --preset, or both --algo and --env")
@@ -248,4 +302,5 @@ def resolve(
     return Preset(
         algo=algo, env=env, config=apply_overrides(cfg, overrides),
         iterations=1000, description=f"{algo} on {env}",
+        env_kwargs=dict(env_overrides),
     )

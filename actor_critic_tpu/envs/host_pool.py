@@ -109,11 +109,17 @@ class HostEnvPool:
         backend: str = "gym",
         pixel_preprocess: bool = False,
         scale_actions: bool = False,
+        env_kwargs: dict | None = None,
     ):
         self.env_id = env_id
         self.num_envs = num_envs
+        env_kwargs = dict(env_kwargs or {})
         if pixel_preprocess and backend != "gym":
             raise ValueError("pixel_preprocess applies to the gym backend only")
+        if env_kwargs and backend != "gym":
+            raise ValueError(
+                "env_kwargs go to gym.make; the native engine takes none"
+            )
         if backend == "native":
             # First-party C++ batched engine: one C call per batch step
             # (envs/native_pool.py; native/vecenv.cpp).
@@ -125,7 +131,7 @@ class HostEnvPool:
             from gymnasium.vector import AutoresetMode, SyncVectorEnv
 
             def make_one():
-                e = gym.make(env_id)
+                e = gym.make(env_id, **env_kwargs)
                 if pixel_preprocess:
                     from actor_critic_tpu.envs.pixel_wrappers import PixelPreprocess
 
@@ -182,6 +188,7 @@ class HostEnvPool:
         self._returns = np.zeros(num_envs, np.float64)
         self._backend = backend
         self._pixel_preprocess = pixel_preprocess
+        self._env_kwargs = env_kwargs
 
     @property
     def normalizes_obs(self) -> bool:
@@ -208,6 +215,7 @@ class HostEnvPool:
             clip_obs=self._clip_obs, gamma=self._gamma,
             backend=self._backend, pixel_preprocess=self._pixel_preprocess,
             scale_actions=self._scale_actions,
+            env_kwargs=self._env_kwargs,
         )
         pool.obs_rms = self.obs_rms  # aliased on purpose; frozen below
         pool._frozen_stats = True

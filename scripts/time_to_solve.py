@@ -54,7 +54,10 @@ def main() -> int:
         preset = dataclasses.replace(
             preset, config=apply_overrides(preset.config, parse_set_args(args.set))
         )
-    env, fused = build_env(preset.env, preset.algo, preset.config, args.seed)
+    env, fused = build_env(
+        preset.env, preset.algo, preset.config, args.seed,
+        env_kwargs=preset.env_kwargs,
+    )
     if not fused:
         raise SystemExit("time_to_solve drives fused presets only")
     mod = fused_module(preset.algo)

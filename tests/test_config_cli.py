@@ -81,6 +81,46 @@ def test_algo_configs_constructible():
         cls()  # defaults must be valid
 
 
+def test_env_kwargs_plumbing():
+    """Preset env_kwargs flow to the env constructor; --env-set merges
+    over them; changing the preset's env drops its env_kwargs."""
+    from actor_critic_tpu.config import coerce_env_value, parse_env_set_args
+
+    assert parse_env_set_args(["opp_skill=0.5", "frame_skip=4"]) == {
+        "opp_skill": 0.5, "frame_skip": 4,
+    }
+    assert coerce_env_value("true") is True
+    assert coerce_env_value("none") is None
+    assert coerce_env_value("hello") == "hello"
+
+    pre = resolve("impala_pong_learn", None, None, {})
+    assert pre.env_kwargs == {"opp_skill": 0.5, "frame_skip": 4, "size": 36}
+    pre = resolve("impala_pong_learn", None, None, {}, {"opp_skill": 0.75})
+    assert pre.env_kwargs["opp_skill"] == 0.75
+    assert pre.env_kwargs["frame_skip"] == 4
+    # Pointing the preset at a different env keeps only CLI kwargs.
+    pre = resolve("impala_pong_learn", None, "jax:cartpole", {}, {})
+    assert pre.env_kwargs == {}
+
+    import train as train_cli
+
+    env, fused = train_cli.build_env(
+        "jax:pong", "impala", pre.config, 0,
+        env_kwargs={"opp_skill": 0.5, "frame_skip": 4, "size": 36},
+    )
+    assert fused
+    assert env.spec.obs_shape[0] == 36  # size kwarg reached the maker
+    with pytest.raises(SystemExit, match="bad --env-set"):
+        train_cli.build_env(
+            "jax:pong", "impala", pre.config, 0, env_kwargs={"nope": 1}
+        )
+    with pytest.raises(SystemExit, match="native"):
+        train_cli.build_env(
+            "native:CartPole-v1", "ppo", PRESETS["a2c_cartpole"].config, 0,
+            env_kwargs={"x": 1},
+        )
+
+
 @pytest.mark.slow
 def test_cli_end_to_end(tmp_path):
     """train.py runs a tiny fused job, writes JSONL + summary, resumes."""
@@ -190,9 +230,21 @@ def test_check_env_convention_sidecar(tmp_path):
     with warnings.catch_warnings(record=True) as caught:
         warnings.simplefilter("always")
         train_cli.check_env_convention(d, "jax:pendulum", None, resume=True)
+        # None and explicit True are the SAME effective convention on
+        # pendulum (the env scales by default) — neither may warn.
+        train_cli.check_env_convention(d, "jax:pendulum", True, resume=True)
     assert not caught
-    with pytest.warns(UserWarning, match="action\nconvention|other action"):
+    with pytest.warns(UserWarning, match="other action convention"):
         train_cli.check_env_convention(d, "jax:pendulum", False, resume=True)
+    # A fresh (non-resume) run into the same dir overwrites the stale
+    # sidecar, so its own resumes are checked against ITS convention.
+    train_cli.check_env_convention(d, "jax:pendulum", False, resume=False)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        train_cli.check_env_convention(d, "jax:pendulum", False, resume=True)
+    assert not caught
+    with pytest.warns(UserWarning, match="other action convention"):
+        train_cli.check_env_convention(d, "jax:pendulum", None, resume=True)
     # Legacy dir without a sidecar: resume is silent (tolerant).
     legacy = str(tmp_path / "legacy")
     import os
@@ -204,3 +256,65 @@ def test_check_env_convention_sidecar(tmp_path):
     assert not caught
     # No ckpt dir at all: no-op.
     train_cli.check_env_convention(None, "jax:pendulum", True, resume=True)
+
+
+def test_check_env_convention_env_kwargs(tmp_path):
+    """The sidecar also guards env-constructor kwargs: a resume that
+    changes the env's difficulty knobs warns; matched kwargs and legacy
+    (pre-env-kwargs) sidecars stay silent; --env-set scale_actions on
+    pendulum counts as the real convention."""
+    import warnings
+
+    import train as train_cli
+
+    d = str(tmp_path / "ck")
+    kw = {"opp_skill": 0.5, "frame_skip": 4, "size": 36}
+    train_cli.check_env_convention(d, "jax:pong", None, False, env_kwargs=kw)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        train_cli.check_env_convention(d, "jax:pong", None, True, env_kwargs=kw)
+    assert not caught
+    with pytest.warns(UserWarning, match="different environment"):
+        train_cli.check_env_convention(
+            d, "jax:pong", None, True, env_kwargs={**kw, "opp_skill": 1.0}
+        )
+    with pytest.warns(UserWarning, match="different environment"):
+        train_cli.check_env_convention(d, "jax:pong", None, True, env_kwargs={})
+    # Legacy sidecar without the env_kwargs key: tolerant.
+    import json as json_mod
+    import os
+
+    legacy = str(tmp_path / "legacy")
+    os.makedirs(legacy)
+    with open(os.path.join(legacy, "env_convention.json"), "w") as f:
+        json_mod.dump({"env": "jax:pong", "scale_actions": None}, f)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        train_cli.check_env_convention(legacy, "jax:pong", None, True, env_kwargs=kw)
+    assert not caught
+    # --env-set scale_actions=false on pendulum IS the effective
+    # convention when no CLI flag is given (mirrors build_env).
+    d2 = str(tmp_path / "pend")
+    train_cli.check_env_convention(
+        d2, "jax:pendulum", None, False, env_kwargs={"scale_actions": False}
+    )
+    with pytest.warns(UserWarning, match="other action convention"):
+        train_cli.check_env_convention(d2, "jax:pendulum", None, True)
+    # ...and spelling the SAME convention via the CLI flag instead of
+    # --env-set must stay silent (scale_actions is excluded from the
+    # kwargs comparison).
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        train_cli.check_env_convention(d2, "jax:pendulum", False, True)
+    assert not caught
+    # Resuming into a different ENV warns even with matching kwargs.
+    with pytest.warns(UserWarning, match="different environment|belongs to"):
+        train_cli.check_env_convention(d2, "jax:cartpole", None, True)
+    # Host runs: the scale flip is host_loop's checkpoint-metric guard's
+    # job — the sidecar must NOT double-warn it (env/kwargs only).
+    d3 = str(tmp_path / "host")
+    train_cli.check_env_convention(d3, "host:Pendulum-v1", True, False)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        train_cli.check_env_convention(d3, "host:Pendulum-v1", None, True)
+    assert not caught

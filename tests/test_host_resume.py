@@ -132,6 +132,105 @@ def test_offpolicy_host_resume_restores_learner(tmp_path):
     pool2.close()
 
 
+def test_offpolicy_replay_free_checkpoint(tmp_path):
+    """save_replay=False: the checkpoint excludes the ring (orders of
+    magnitude smaller on disk), resume restores params/opt/key exactly,
+    warns about the fresh-buffer semantics, reattaches a zeroed
+    full-capacity ring, and training continues (updates gated until the
+    ring refills past one batch)."""
+    import os
+
+    cfg = _tiny_ddpg_cfg()
+
+    def dir_size(d):
+        return sum(
+            os.path.getsize(os.path.join(r, f))
+            for r, _, fs in os.walk(d) for f in fs
+        )
+
+    pool = HostEnvPool(
+        "Pendulum-v1", num_envs=2, seed=0,
+        normalize_obs=False, normalize_reward=False,
+    )
+    with Checkpointer(tmp_path / "full") as ck:
+        ddpg.train_host(
+            pool, cfg, num_iterations=3, seed=0, log_every=0,
+            ckpt=ck, save_every=3,
+        )
+        ck.wait()
+    pool.close()
+
+    pool = HostEnvPool(
+        "Pendulum-v1", num_envs=2, seed=0,
+        normalize_obs=False, normalize_reward=False,
+    )
+    with Checkpointer(tmp_path / "slim") as ck:
+        learner1, _ = ddpg.train_host(
+            pool, cfg, num_iterations=3, seed=0, log_every=0,
+            ckpt=ck, save_every=3, save_replay=False,
+        )
+        ck.wait()
+    pool.close()
+
+    # Disk: strictly smaller (orbax compresses the mostly-zero ring, so
+    # the margin is modest at toy scale; at Humanoid scale it's ~3 GB).
+    full, slim = dir_size(tmp_path / "full"), dir_size(tmp_path / "slim")
+    assert slim < full, (full, slim)
+    # Structure: the SAVED tree carries a one-slot stub, not the ring.
+    from actor_critic_tpu.algos.host_loop import host_ckpt_state
+
+    pool = HostEnvPool(
+        "Pendulum-v1", num_envs=2, seed=0,
+        normalize_obs=False, normalize_reward=False,
+    )
+    saved_tree = host_ckpt_state(pool, save_replay=False, learner=learner1)
+    pool.close()
+    stub_leaves = jax.tree.leaves(saved_tree["learner"].replay.storage)
+    assert all(leaf.shape[0] == 1 for leaf in stub_leaves)
+    assert replay_capacity(learner1) == cfg.buffer_capacity  # untouched
+
+    # Resume with no extra iterations: exact param restore + the ring
+    # comes back EMPTY at full capacity (not the saved stub).
+    pool = HostEnvPool(
+        "Pendulum-v1", num_envs=2, seed=0,
+        normalize_obs=False, normalize_reward=False,
+    )
+    with Checkpointer(tmp_path / "slim") as ck:
+        with pytest.warns(UserWarning, match="replay-free"):
+            learner2, history = ddpg.train_host(
+                pool, cfg, num_iterations=3, seed=0, log_every=0,
+                ckpt=ck, resume=True, save_replay=False,
+            )
+    pool.close()
+    assert history == []
+    _trees_equal(learner1.actor_params, learner2.actor_params)
+    assert int(learner2.replay.size) == 0
+    assert replay_capacity(learner2) == cfg.buffer_capacity
+
+    # Resume WITH extra iterations: training continues, refilling the
+    # fresh ring (2 iterations x steps_per_iter x num_envs inserts).
+    pool = HostEnvPool(
+        "Pendulum-v1", num_envs=2, seed=0,
+        normalize_obs=False, normalize_reward=False,
+    )
+    with Checkpointer(tmp_path / "slim") as ck:
+        with pytest.warns(UserWarning, match="replay-free"):
+            learner3, history = ddpg.train_host(
+                pool, cfg, num_iterations=5, seed=0, log_every=1,
+                ckpt=ck, resume=True, save_replay=False,
+            )
+    pool.close()
+    assert [it for it, _ in history] == [4, 5]
+    assert int(learner3.replay.size) == 2 * cfg.steps_per_iter * 2
+    assert replay_capacity(learner3) == cfg.buffer_capacity
+
+
+def replay_capacity(learner):
+    import jax
+
+    return jax.tree.leaves(learner.replay.storage)[0].shape[0]
+
+
 @pytest.mark.parametrize("trained_normalized", [True, False],
                          ids=["norm-ckpt-raw-pool", "raw-ckpt-norm-pool"])
 def test_resume_warns_on_normalization_mismatch(tmp_path, trained_normalized):

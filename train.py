@@ -25,22 +25,27 @@ import sys
 import time
 
 
-def build_env(spec: str, algo: str, cfg, seed: int, scale_actions=None):
+def build_env(spec: str, algo: str, cfg, seed: int, scale_actions=None,
+              env_kwargs=None):
     """'jax:<name>' → (JaxEnv, fused=True); 'host:<id>' → (pool, False).
 
     scale_actions is tri-state: None keeps each env's own convention
     (host pools clip — the recorded-run behavior; jax:pendulum scales),
     True/False (--scale-actions / --no-scale-actions) forces it where
-    the env supports the choice."""
+    the env supports the choice.
+
+    env_kwargs (preset env_kwargs merged with --env-set) go to the env
+    CONSTRUCTOR: the jax:* maker (e.g. pong's opp_skill/frame_skip/size)
+    or gym.make for host pools. The native backend's envs take no
+    construction knobs, so kwargs there are an error, not a silent drop."""
     kind, _, name = spec.partition(":")
+    env_kwargs = dict(env_kwargs or {})
     if kind == "jax":
         from actor_critic_tpu import envs as E
 
         makers = {
             "cartpole": E.make_cartpole,
-            "pendulum": lambda: E.make_pendulum(
-                scale_actions=True if scale_actions is None else scale_actions
-            ),
+            "pendulum": E.make_pendulum,
             "pong": E.make_pong,
             "two_state": E.make_two_state_mdp,
             "point_mass": E.make_point_mass,
@@ -48,7 +53,19 @@ def build_env(spec: str, algo: str, cfg, seed: int, scale_actions=None):
         }
         if name not in makers:
             raise SystemExit(f"unknown jax env {name!r}; valid: {sorted(makers)}")
-        return makers[name](), True
+        if name == "pendulum":
+            # One resolution for behavior AND the resume-guard record:
+            # CLI flag wins, then --env-set/preset kwarg, then the env
+            # default (scale) — effective_scale_actions is that order.
+            env_kwargs["scale_actions"] = effective_scale_actions(
+                spec, scale_actions, env_kwargs
+            )
+        try:
+            return makers[name](**env_kwargs), True
+        except TypeError as e:
+            if env_kwargs:
+                raise SystemExit(f"bad --env-set for jax:{name}: {e}") from e
+            raise
     if kind in ("host", "native"):
         from actor_critic_tpu.envs.host_pool import HostEnvPool
 
@@ -64,54 +81,135 @@ def build_env(spec: str, algo: str, cfg, seed: int, scale_actions=None):
         # 'native:<id>' steps the batch in the C++ engine (one C call per
         # step) instead of the Python SyncVectorEnv loop.
         on_policy = algo == "ppo"
-        return (
-            HostEnvPool(
-                name,
-                num_envs=cfg.num_envs,
-                seed=seed,
-                normalize_obs=on_policy,
-                normalize_reward=on_policy,
-                backend="gym" if kind == "host" else "native",
-                scale_actions=bool(scale_actions),
-            ),
-            False,
-        )
+        if kind == "native" and env_kwargs:
+            raise SystemExit(
+                f"--env-set is not supported for native:{name} (the C++ "
+                "engine replicates gymnasium defaults exactly)"
+            )
+        try:
+            return (
+                HostEnvPool(
+                    name,
+                    num_envs=cfg.num_envs,
+                    seed=seed,
+                    normalize_obs=on_policy,
+                    normalize_reward=on_policy,
+                    backend="gym" if kind == "host" else "native",
+                    scale_actions=bool(scale_actions),
+                    env_kwargs=env_kwargs,
+                ),
+                False,
+            )
+        except TypeError as e:
+            # gym.make raises TypeError on unknown constructor kwargs —
+            # same friendly exit as the jax: path's maker check. Only
+            # claim --env-set is at fault when kwargs were actually given.
+            if env_kwargs:
+                raise SystemExit(f"bad --env-set for {spec}: {e}") from e
+            raise
     raise SystemExit(
         f"env must be jax:<name>, host:<gym id>, or native:<id>, got {spec!r}"
     )
 
 
-def check_env_convention(ckpt_dir, env_spec: str, scale_actions, resume: bool):
+def effective_scale_actions(env_spec: str, scale_actions, env_kwargs=None):
+    """Resolve the tri-state CLI flag to the convention the env will
+    actually use, so the resume guard compares BEHAVIOR, not flag
+    spelling: `jax:pendulum` defaults to scaling (build_env maps
+    None→True there), so None and True are the same convention and a
+    resume that makes the default explicit must not warn. The explicit
+    CLI flag wins; an `--env-set scale_actions=...` kwarg comes next
+    (mirroring build_env's setdefault order); then the env default.
+    Envs with no continuous-action convention resolve to None."""
+    if env_spec == "jax:pendulum":
+        if scale_actions is not None:
+            return bool(scale_actions)
+        kw = (env_kwargs or {}).get("scale_actions")
+        return True if kw is None else bool(kw)
+    if env_spec.startswith(("host:", "native:")):
+        # Host pools clip unless the flag forces scaling (build_env
+        # passes bool(scale_actions), so None means clip).
+        return bool(scale_actions)
+    return None
+
+
+def check_env_convention(ckpt_dir, env_spec: str, scale_actions, resume: bool,
+                         env_kwargs=None):
     """Fused-path twin of the host path's `_pool_scale_actions` resume
-    guard (algos/host_loop.py): record the run's action-convention flag
-    in a sidecar JSON next to the checkpoints, and warn when a resume
-    flips it — the restored policy's actions would silently execute
-    under the other convention (e.g. jax:pendulum ±2-scaled vs raw
-    torques). Tolerant of pre-existing checkpoint dirs without the
-    sidecar."""
+    guard (algos/host_loop.py): record the run's EFFECTIVE action
+    convention AND env-constructor kwargs in a sidecar JSON next to the
+    checkpoints, and warn when a resume flips either — the restored
+    policy would silently execute under another action convention
+    (e.g. jax:pendulum ±2-scaled vs raw torques) or inside a
+    different-difficulty env (e.g. pong opp_skill), contaminating the
+    run's curve. Tolerant of pre-existing checkpoint dirs without the
+    sidecar; a fresh (non-resume) run overwrites any stale sidecar left
+    by a previous run in the same dir."""
     if not ckpt_dir:
         return
     import os
     import warnings
 
+    env_kwargs = dict(env_kwargs or {})
+    resolved = effective_scale_actions(env_spec, scale_actions, env_kwargs)
+    # scale_actions is compared via `resolved` (which folds in the CLI
+    # flag); leaving it in the kwargs dict would warn spuriously when one
+    # run spells the same convention via --env-set and the other via the
+    # flag.
+    env_kwargs.pop("scale_actions", None)
     path = os.path.join(ckpt_dir, "env_convention.json")
-    current = {"env": env_spec, "scale_actions": scale_actions}
+    current = {
+        "env": env_spec, "scale_actions": resolved, "env_kwargs": env_kwargs,
+    }
     if resume and os.path.exists(path):
         with open(path) as f:
             saved = json.load(f)
-        if saved.get("scale_actions") != scale_actions:
+        # Old sidecars recorded the raw tri-state flag; resolve it the
+        # same way so None-vs-True on a scaling-default env stays quiet.
+        saved_kwargs = saved.get("env_kwargs")
+        saved_resolved = effective_scale_actions(
+            saved.get("env", env_spec), saved.get("scale_actions"),
+            saved_kwargs,
+        )
+        if saved_kwargs is not None:
+            saved_kwargs = dict(saved_kwargs)
+            saved_kwargs.pop("scale_actions", None)
+        saved_env = saved.get("env")
+        if saved_env is not None and saved_env != env_spec:
             warnings.warn(
-                f"--resume with scale_actions={scale_actions!r} but this "
-                f"run started with {saved.get('scale_actions')!r} — the "
+                f"--resume into {env_spec!r} but this checkpoint dir "
+                f"belongs to a {saved_env!r} run — the restored policy "
+                "trained on a different environment. Use a fresh "
+                "--ckpt-dir or the original env.",
+                stacklevel=2,
+            )
+        # Host pools already guard the scale flag through the checkpoint
+        # metrics (host_loop._pool_scale_actions) — warning here too
+        # would double-report the same flip; the sidecar adds env/kwargs
+        # coverage there, and full coverage for fused envs.
+        host = env_spec.startswith(("host:", "native:"))
+        if not host and saved_resolved != resolved:
+            warnings.warn(
+                f"--resume with scale_actions={resolved!r} but this "
+                f"run started with {saved_resolved!r} — the "
                 "restored policy trained under the other action "
                 "convention. Relaunch with the original flag.",
                 stacklevel=2,
             )
+        # Pre-env-kwargs sidecars (no key) are tolerated like legacy
+        # dirs; a recorded mismatch is a different env, so warn.
+        if saved_kwargs is not None and saved_kwargs != env_kwargs:
+            warnings.warn(
+                f"--resume with env_kwargs={env_kwargs!r} but this run "
+                f"started with {saved_kwargs!r} — the restored policy "
+                "would continue in a different environment. Relaunch "
+                "with the original --env-set/preset.",
+                stacklevel=2,
+            )
         return
-    if not os.path.exists(path):
-        os.makedirs(ckpt_dir, exist_ok=True)
-        with open(path, "w") as f:
-            json.dump(current, f)
+    os.makedirs(ckpt_dir, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(current, f)
 
 
 def fused_module(algo: str):
@@ -206,13 +304,14 @@ def run_host(pool, preset, args, logger) -> dict:
         ckpt=ckpt, save_every=args.save_every, resume=args.resume,
         overlap=not args.no_overlap,
     )
+    offpolicy = dict(common, save_replay=not args.no_save_replay)
     try:
         if preset.algo == "ppo":
             ppo.train_host(pool, preset.config, **common)
         elif preset.algo in ("ddpg", "td3"):
-            ddpg.train_host(pool, preset.config, **common)
+            ddpg.train_host(pool, preset.config, **offpolicy)
         elif preset.algo == "sac":
-            sac.train_host(pool, preset.config, **common)
+            sac.train_host(pool, preset.config, **offpolicy)
         else:
             raise SystemExit(
                 f"{preset.algo} needs a pure-JAX env (fused trainer); "
@@ -247,6 +346,12 @@ def main(argv=None) -> int:
         "--set", action="append", default=[], metavar="KEY=VALUE",
         help="config override (repeatable), e.g. --set lr=1e-4 --set hidden=64,64",
     )
+    p.add_argument(
+        "--env-set", action="append", default=[], metavar="KEY=VALUE",
+        help="env-constructor kwarg (repeatable), e.g. --env-set "
+        "opp_skill=0.5 --env-set frame_skip=4; merges over the preset's "
+        "env_kwargs",
+    )
     p.add_argument("--metrics", default="metrics.jsonl", help="JSONL output path")
     p.add_argument("--log-every", type=int, default=10)
     p.add_argument(
@@ -279,6 +384,14 @@ def main(argv=None) -> int:
     )
     p.add_argument("--ckpt-dir", help="orbax checkpoint dir")
     p.add_argument("--save-every", type=int, default=100)
+    p.add_argument(
+        "--no-save-replay", action="store_true",
+        help="off-policy host runs: exclude the replay ring from "
+        "checkpoints (a Humanoid-scale ring is ~3 GB per save). Resuming "
+        "such a checkpoint restarts with an EMPTY buffer: updates pause "
+        "until it refills past one batch, then continue on fresh "
+        "experience only.",
+    )
     p.add_argument("--resume", action="store_true", help="resume from --ckpt-dir")
     p.add_argument(
         "--stall-timeout", type=float, default=0,
@@ -289,7 +402,9 @@ def main(argv=None) -> int:
     p.add_argument("--list-presets", action="store_true")
     args = p.parse_args(argv)
 
-    from actor_critic_tpu.config import PRESETS, parse_set_args, resolve
+    from actor_critic_tpu.config import (
+        PRESETS, parse_env_set_args, parse_set_args, resolve,
+    )
     from actor_critic_tpu.utils.cadence import finite_or_none
     from actor_critic_tpu.utils.logging import JsonlLogger
 
@@ -298,25 +413,31 @@ def main(argv=None) -> int:
             print(f"{name:18s} {pre.algo:7s} {pre.env:22s} {pre.description}")
         return 0
 
-    preset = resolve(args.preset, args.algo, args.env, parse_set_args(args.set))
+    preset = resolve(
+        args.preset, args.algo, args.env, parse_set_args(args.set),
+        env_overrides=parse_env_set_args(args.env_set),
+    )
     if args.iterations is None:
         args.iterations = preset.iterations
 
     print(
         f"algo={preset.algo} env={preset.env} iterations={args.iterations} "
-        f"config={dataclasses.asdict(preset.config)}",
+        f"config={dataclasses.asdict(preset.config)} "
+        f"env_kwargs={preset.env_kwargs}",
         flush=True,
     )
     env, fused = build_env(
         preset.env, preset.algo, preset.config, args.seed,
-        scale_actions=args.scale_actions,
+        scale_actions=args.scale_actions, env_kwargs=preset.env_kwargs,
     )
-    if fused:
-        # Host pools carry their convention in the checkpoint metrics
-        # (host_loop); fused envs use a ckpt-dir sidecar.
-        check_env_convention(
-            args.ckpt_dir, preset.env, args.scale_actions, args.resume
-        )
+    # Host pools carry their ACTION convention in the checkpoint metrics
+    # too (host_loop's _pool_scale_actions), but env_kwargs exist only
+    # here — the sidecar guards both paths against resuming into a
+    # different env (kwargs) or convention (fused envs).
+    check_env_convention(
+        args.ckpt_dir, preset.env, args.scale_actions, args.resume,
+        env_kwargs=preset.env_kwargs,
+    )
 
     watchdog = None
     if args.stall_timeout > 0:
