@@ -435,7 +435,11 @@ def make_sp_train_step(
             lambda n, o: jnp.where(refresh, n, o), new_params,
             state.actor_params,
         )
-        metrics = {**metrics, **ep_metrics, "avg_return_ema": avg_ret}
+        ep_metrics["avg_return_ema"] = avg_ret
+        # Same derived metric keys as make_train_step (mean_finished_
+        # return, mean_ep_length, ...): upd's metrics are already
+        # mesh-reduced and ep_metrics are global-array sums, so no axis.
+        metrics = aggregate_metrics(metrics, ep_metrics, None)
         new_state = ImpalaTrainState(
             params=new_params,
             actor_params=new_actor_params,

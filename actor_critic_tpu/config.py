@@ -37,15 +37,22 @@ PRESETS: dict[str, Preset] = {
     # BASELINE.json:7 — the ≥1M env-steps/sec north-star config.
     # lr+entropy annealed to 0 over the run: the flat-coefficient config
     # oscillated at eval ≤429 and never converged (round-2 verdict #1).
-    # Annealed, THIS config (E=4096) measured greedy eval 465/458 at
-    # iterations 300/400 (CPU calibration, seed 0); tests/test_a2c.py
-    # guards the same shape at E=256 (eval 462.9). PPO (ppo_cartpole
-    # below) is the preset that certifiably SOLVES ≥475.
+    # Round 4 closed the last 10 points to the 475 solve bar in two
+    # moves (scripts/a2c_anneal_sweep.py): double the rollout to T=64
+    # (halves GAE truncation bias; solved 3/4 seeds at E=256 but still
+    # ceilinged ~465 at E=4096), then scale lr with the 16× batch —
+    # lr=3e-3 reaches greedy eval 491/500 at iters 300/400 at THIS
+    # shape (E=4096, CPU calibration; 1.5e-3 and 2e-3 underfit at
+    # 418-458). Certification (results/a2c_cartpole_solve_*, threshold
+    # 475 on 2 consecutive independent evals): seeds 0/1 solve at iters
+    # 300/325 (finals 491/500); seed 2 oscillates at this lr and does
+    # not settle — see the sweep's stabilizer configs for the ongoing
+    # 3/3 push. tests/test_a2c.py guards a reduced E=256 shape.
     "a2c_cartpole": Preset(
         algo="a2c",
         env="jax:cartpole",
         config=a2c.A2CConfig(
-            num_envs=4096, rollout_steps=32, lr=1e-3,
+            num_envs=4096, rollout_steps=64, lr=3e-3,
             anneal_iters=400, lr_final=0.0,
             entropy_coef=0.01, entropy_coef_final=0.0,
         ),

@@ -35,6 +35,24 @@ CONFIGS: dict[str, dict] = {
     "lrfloor600": dict(iterations=600, anneal_iters=600, lr_final=1e-4),
     # Tighter GAE (lower variance targets late in training).
     "lam90_600": dict(iterations=600, anneal_iters=600, gae_lambda=0.90),
+    # E=4096 preset-scale grid: the E=256 winner (t64_400) ceilinged at
+    # ~465 at E=4096/lr=1e-3 — a 16× batch at the same lr is underfit
+    # per update, so scale lr (and optionally keep exploration alive
+    # longer with a slower entropy anneal).
+    "big_lr15": dict(iterations=400, anneal_iters=400, num_envs=4096,
+                     rollout_steps=64, lr=1.5e-3),
+    "big_lr2": dict(iterations=400, anneal_iters=400, num_envs=4096,
+                    rollout_steps=64, lr=2e-3),
+    "big_lr3": dict(iterations=400, anneal_iters=400, num_envs=4096,
+                    rollout_steps=64, lr=3e-3),
+    "big_lr2_t32": dict(iterations=400, anneal_iters=400, num_envs=4096,
+                        rollout_steps=32, lr=2e-3),
+    # Stabilizers for the lr=3e-3 winner's seed sensitivity (seed 2
+    # oscillated 452->256->443->251 and never settled).
+    "big_lr3_nadv": dict(iterations=400, anneal_iters=400, num_envs=4096,
+                         rollout_steps=64, lr=3e-3, normalize_adv=True),
+    "big_lr25": dict(iterations=400, anneal_iters=400, num_envs=4096,
+                     rollout_steps=64, lr=2.5e-3),
 }
 
 
@@ -51,7 +69,7 @@ def run_one(name: str, spec: dict, seed: int) -> dict:
     base = dict(
         num_envs=256, rollout_steps=32, lr=1e-3, lr_final=0.0,
         entropy_coef=0.01, entropy_coef_final=0.0,
-    )
+    )  # sweep default; configs override num_envs for preset-scale runs
     base.update(spec)
     cfg = a2c.A2CConfig(**base)
     env = make_cartpole()

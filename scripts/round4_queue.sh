@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# Round-4 result-run chain (VERDICT r3 next #1): waits for the SAC
+# Humanoid run to finish, then runs the TD3 Walker2d raw-obs rerun and
+# the PPO HalfCheetah big-net attempt SEQUENTIALLY (1-core host — two
+# trainers would thrash each other). All on XLA:CPU with the axon site
+# hook disarmed; switch to the TPU commands in TODO_NEXT_ROUND.md if the
+# tunnel returns.
+set -u
+cd "$(dirname "$0")/.."
+export PALLAS_AXON_POOL_IPS=
+export JAX_PLATFORMS=cpu
+
+echo "[queue] waiting for SAC Humanoid (pattern: train.py --preset sac_humanoid)"
+while pgrep -f "python train.py --preset sac_humanoid" >/dev/null 2>&1; do
+  sleep 60
+done
+
+echo "[queue] SAC done; starting TD3 Walker2d raw-obs rerun (seed 0)"
+nice -n 10 scripts/run_resumable.sh --preset td3_walker2d --ckpt-dir runs/td3_w2 \
+  --save-every 2000 --eval-every 500 --eval-envs 16 \
+  --metrics runs/td3_walker2d_run2_cpu.jsonl --seed 0 --quiet \
+  > runs/td3_w2_cpu_stdout.log 2>&1
+echo "[queue] TD3 rc=$?"
+
+echo "[queue] starting PPO HalfCheetah 256x256 attempt (seed 0)"
+nice -n 10 scripts/run_resumable.sh --preset ppo_halfcheetah --iterations 2500 \
+  --set hidden=256,256 --set num_envs=16 --set anneal_iters=2500 \
+  --ckpt-dir runs/hc3 --save-every 250 --eval-every 125 --eval-envs 8 \
+  --metrics runs/ppo_halfcheetah_run3_cpu.jsonl --seed 0 --quiet \
+  > runs/hc3_cpu_stdout.log 2>&1
+echo "[queue] PPO HC rc=$?"

@@ -60,12 +60,19 @@ def build_env(spec: str, algo: str, cfg, seed: int, scale_actions=None,
             env_kwargs["scale_actions"] = effective_scale_actions(
                 spec, scale_actions, env_kwargs
             )
-        try:
-            return makers[name](**env_kwargs), True
-        except TypeError as e:
-            if env_kwargs:
-                raise SystemExit(f"bad --env-set for jax:{name}: {e}") from e
-            raise
+        # Validate kwargs against the maker's signature UP FRONT so the
+        # friendly exit fires only for genuinely unknown knobs — a
+        # TypeError raised inside a maker must keep its real traceback.
+        import inspect
+
+        valid = set(inspect.signature(makers[name]).parameters)
+        unknown = sorted(set(env_kwargs) - valid)
+        if unknown:
+            raise SystemExit(
+                f"bad --env-set for jax:{name}: unknown kwargs {unknown}; "
+                f"valid: {sorted(valid)}"
+            )
+        return makers[name](**env_kwargs), True
     if kind in ("host", "native"):
         from actor_critic_tpu.envs.host_pool import HostEnvPool
 
@@ -103,8 +110,10 @@ def build_env(spec: str, algo: str, cfg, seed: int, scale_actions=None,
         except TypeError as e:
             # gym.make raises TypeError on unknown constructor kwargs —
             # same friendly exit as the jax: path's maker check. Only
-            # claim --env-set is at fault when kwargs were actually given.
-            if env_kwargs:
+            # claim --env-set is at fault when kwargs were given AND the
+            # message blames a keyword; other TypeErrors keep their
+            # traceback.
+            if env_kwargs and "keyword" in str(e):
                 raise SystemExit(f"bad --env-set for {spec}: {e}") from e
             raise
     raise SystemExit(
@@ -183,6 +192,11 @@ def check_env_convention(ckpt_dir, env_spec: str, scale_actions, resume: bool,
                 "--ckpt-dir or the original env.",
                 stacklevel=2,
             )
+            # The convention/kwargs comparisons are meaningless across
+            # different envs (and would emit nonsense follow-up advice
+            # like "relaunch with the original flag") — the env warning
+            # already says everything.
+            return
         # Host pools already guard the scale flag through the checkpoint
         # metrics (host_loop._pool_scale_actions) — warning here too
         # would double-report the same flip; the sidecar adds env/kwargs
