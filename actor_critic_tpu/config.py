@@ -45,9 +45,11 @@ PRESETS: dict[str, Preset] = {
     # shape (E=4096, CPU calibration; 1.5e-3 and 2e-3 underfit at
     # 418-458). Certification (results/a2c_cartpole_solve_*, threshold
     # 475 on 2 consecutive independent evals): seeds 0/1 solve at iters
-    # 300/325 (finals 491/500); seed 2 oscillates at this lr and does
-    # not settle — see the sweep's stabilizer configs for the ongoing
-    # 3/3 push. tests/test_a2c.py guards a reduced E=256 shape.
+    # 300/325 (finals 491/500); seed 2 oscillates at this lr — a
+    # measured A2C ceiling (no trust region), not a tuning gap: the
+    # sweep also rejected normalize_adv (collapse), lr 2.5e-3 (noisier)
+    # and max_grad_norm 0.25 (still 2/3); PPO (ppo_cartpole) is the
+    # 3/3 solver. tests/test_a2c.py guards a reduced E=256 shape.
     "a2c_cartpole": Preset(
         algo="a2c",
         env="jax:cartpole",

@@ -53,6 +53,11 @@ CONFIGS: dict[str, dict] = {
                          rollout_steps=64, lr=3e-3, normalize_adv=True),
     "big_lr25": dict(iterations=400, anneal_iters=400, num_envs=4096,
                      rollout_steps=64, lr=2.5e-3),
+    # normalize_adv collapsed to ~230 at this scale (it rescales the
+    # advantage signal the big batch already denoises); try taming
+    # lr=3e-3's oscillation with a tighter grad clip instead.
+    "big_lr3_clip25": dict(iterations=400, anneal_iters=400, num_envs=4096,
+                           rollout_steps=64, lr=3e-3, max_grad_norm=0.25),
 }
 
 

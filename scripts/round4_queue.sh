@@ -10,8 +10,12 @@ cd "$(dirname "$0")/.."
 export PALLAS_AXON_POOL_IPS=
 export JAX_PLATFORMS=cpu
 
-echo "[queue] waiting for SAC Humanoid (pattern: train.py --preset sac_humanoid)"
-while pgrep -f "python train.py --preset sac_humanoid" >/dev/null 2>&1; do
+echo "[queue] waiting for SAC Humanoid (wrapper + trainer patterns)"
+# Watch BOTH the run_resumable wrapper and train.py: the wrapper's
+# stall-restart cycle has moments with no live train.py, and a poll
+# landing in that gap must not conclude the run finished.
+while pgrep -f "run_resumable.sh --preset sac_humanoid" >/dev/null 2>&1 \
+   || pgrep -f "python train.py --preset sac_humanoid" >/dev/null 2>&1; do
   sleep 60
 done
 

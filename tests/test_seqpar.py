@@ -147,7 +147,11 @@ def test_sp_train_step_rollout_to_update_one_program(dp_axis):
             ),
             a, b,
         )
-    for k in ("loss", "mean_rho", "avg_return_ema"):
+    # Identical metric SURFACE (same derived keys via aggregate_metrics)
+    # and matching values for the scalar learner metrics.
+    assert set(metrics_sp) == set(metrics_g)
+    for k in ("loss", "mean_rho", "avg_return_ema", "mean_finished_return",
+              "mean_ep_length"):
         np.testing.assert_allclose(
             float(metrics_sp[k]), float(metrics_g[k]), rtol=1e-4, atol=1e-6,
             err_msg=k,
