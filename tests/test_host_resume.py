@@ -138,27 +138,7 @@ def test_offpolicy_replay_free_checkpoint(tmp_path):
     warns about the fresh-buffer semantics, reattaches a zeroed
     full-capacity ring, and training continues (updates gated until the
     ring refills past one batch)."""
-    import os
-
     cfg = _tiny_ddpg_cfg()
-
-    def dir_size(d):
-        return sum(
-            os.path.getsize(os.path.join(r, f))
-            for r, _, fs in os.walk(d) for f in fs
-        )
-
-    pool = HostEnvPool(
-        "Pendulum-v1", num_envs=2, seed=0,
-        normalize_obs=False, normalize_reward=False,
-    )
-    with Checkpointer(tmp_path / "full") as ck:
-        ddpg.train_host(
-            pool, cfg, num_iterations=3, seed=0, log_every=0,
-            ckpt=ck, save_every=3,
-        )
-        ck.wait()
-    pool.close()
 
     pool = HostEnvPool(
         "Pendulum-v1", num_envs=2, seed=0,
@@ -172,11 +152,12 @@ def test_offpolicy_replay_free_checkpoint(tmp_path):
         ck.wait()
     pool.close()
 
-    # Disk: strictly smaller (orbax compresses the mostly-zero ring, so
-    # the margin is modest at toy scale; at Humanoid scale it's ~3 GB).
-    full, slim = dir_size(tmp_path / "full"), dir_size(tmp_path / "slim")
-    assert slim < full, (full, slim)
-    # Structure: the SAVED tree carries a one-slot stub, not the ring.
+    # Disk sizes are NOT asserted: at toy scale orbax's compression of a
+    # mostly-zero 512-slot ring lands within filesystem/layout noise of
+    # the stub (observed flaking by a few hundred bytes either way).
+    # The structural check below is the real guarantee — the SAVED tree
+    # carries a one-slot stub, so a Humanoid-scale ring (~3 GB) can
+    # never enter the checkpoint.
     from actor_critic_tpu.algos.host_loop import host_ckpt_state
 
     pool = HostEnvPool(
