@@ -84,18 +84,33 @@ def bench_ppo():
 
 
 def bench_impala():
+    # Measured at the `impala_pong_learn` preset's exact settings
+    # (opp_skill=0.5, frame_skip=4, 36px, E=64 T=20 — the config that
+    # demonstrably learns; BASELINE.json:11), so the throughput row and
+    # the learning curve describe the same program. One agent decision
+    # drives frame_skip=4 physics frames.
     from actor_critic_tpu.algos import impala
+    from actor_critic_tpu.config import PRESETS
     from actor_critic_tpu.envs import make_pong
 
-    cfg = impala.ImpalaConfig(num_envs=64, rollout_steps=32)
+    preset = PRESETS["impala_pong_learn"]
+    cfg = preset.config
+    env = make_pong(**preset.env_kwargs)
     sps = _fused_steps_per_sec(
-        impala, make_pong(), cfg, cfg.num_envs * cfg.rollout_steps,
+        impala, env, cfg, cfg.num_envs * cfg.rollout_steps,
         iters_per_call=10, calls=3,
     )
     return {
-        "metric": "impala_jaxpong_fused_throughput",
+        # Renamed from impala_jaxpong_fused_throughput (which measured
+        # default pong at E=64 T=32 in env-steps): same key would make
+        # cross-round trackers compare different quantities.
+        "metric": "impala_pong_learn_fused_throughput",
         "value": round(sps, 1),
-        "unit": "env-steps/sec/chip",
+        "unit": "agent-decisions/sec/chip "
+                f"(x{preset.env_kwargs['frame_skip']} physics frames)",
+        "config": {"num_envs": cfg.num_envs,
+                   "rollout_steps": cfg.rollout_steps,
+                   **preset.env_kwargs},
     }
 
 
