@@ -176,13 +176,14 @@ def resume_or_init(ckpt: Checkpointer, init_state: Any) -> tuple[Any, int]:
 
 
 def checkpointed_train(
-    step_fn: Callable[[Any], tuple[Any, dict]],
+    step_fn: Callable[..., tuple[Any, dict]],
     init_state: Any,
     num_iterations: int,
     ckpt: Optional[Checkpointer] = None,
     save_every: int = 0,
     log_fn: Optional[Callable[[int, dict], None]] = None,
     resume: bool = True,
+    stride: int = 1,
 ) -> tuple[Any, dict]:
     """Restart-idempotent train loop (SURVEY.md §5.3).
 
@@ -194,6 +195,15 @@ def checkpointed_train(
     the same final state as an uninterrupted run, because the state
     pytree carries everything. With `ckpt=None` it is a plain train
     loop — the single implementation every caller shares.
+
+    `stride > 1` is the chunked-dispatch mode: `step_fn` must then take
+    `(state, k)` and advance k iterations in ONE device dispatch
+    (a `lax.scan` over the per-iteration step). The counter advances by
+    `min(stride, remaining)` per call, so arbitrary `num_iterations`
+    and resume points work (the short tail chunk costs one extra
+    compile). Save/log callbacks fire only at chunk boundaries — the
+    caller is responsible for choosing cadences that are multiples of
+    `stride` (train.py snaps them up and says so).
     """
     if ckpt is not None and resume:
         state, done = resume_or_init(ckpt, init_state)
@@ -210,9 +220,17 @@ def checkpointed_train(
     from actor_critic_tpu.utils import watchdog
     from actor_critic_tpu.utils.cadence import should_save
 
-    for it in range(done + 1, num_iterations + 1):
+    it = done
+    while it < num_iterations:
+        # First chunk after a misaligned resume realigns to stride
+        # boundaries (resume at it=1000, stride=64 → k=24 then 64s), so
+        # the snapped log/eval/save cadences — which fire only when
+        # `it % cadence == 0` — keep firing for the rest of the run.
+        k = stride - it % stride if it % stride else stride
+        k = min(k, num_iterations - it)
         watchdog.beat()  # progress heartbeat (utils/watchdog.py)
-        state, metrics = step_fn(state)
+        state, metrics = step_fn(state, k) if stride > 1 else step_fn(state)
+        it += k
         if ckpt is not None and should_save(it, save_every, num_iterations):
             # Sync before handing buffers to the async saver: donation
             # would otherwise let the next step overwrite in-flight reads.
