@@ -1,0 +1,54 @@
+#!/usr/bin/env bash
+# Round-4 second-session TPU queue: waits for the in-flight pong
+# extension to release the single-client tunnel, then runs the
+# remaining TPU-dependent result runs SEQUENTIALLY:
+#   1. A2C CartPole wall-clock-to-solve on TPU, seeds 0/1 (the retuned
+#      preset certifies ≥475 from CPU; this records the TPU cold-start
+#      wall-clock next to PPO's 57-71.5 s row)
+#   2. DDPG Walker2d 1M — BASELINE.json:9's weaker-algorithm variant,
+#      never measured (TD3 currently carries config 4)
+#   3. TD3 Walker2d seed 1 — turns the single-seed 4,414 row into
+#      mean±range
+#   4. SAC Humanoid seed 1 — same for the 5,205 row (longest, last)
+# pgrep patterns deliberately avoid strings present in the driving
+# session's own cmdline (see tpu-tunnel-playbook memory).
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p runs results
+
+echo "[q4b] waiting for the pong extension to release the tunnel"
+while pgrep -f "run_resumable.sh --preset impala_pong_learn" >/dev/null 2>&1; do
+  sleep 60
+done
+sleep 10
+
+for seed in 0 1; do
+  echo "[q4b] A2C time-to-solve TPU seed $seed"
+  timeout 1200 python scripts/time_to_solve.py --preset a2c_cartpole \
+    --threshold 475 --chunk 25 --seed "$seed" \
+    --out "results/a2c_cartpole_solve_tpu_seed${seed}.json" \
+    > "runs/a2c_solve_tpu_s${seed}.log" 2>&1
+  echo "[q4b] a2c seed $seed rc=$?"
+done
+
+echo "[q4b] DDPG Walker2d 1M (TPU learner)"
+nice -n 5 scripts/run_resumable.sh --preset ddpg_walker2d \
+  --ckpt-dir runs/ddpg_w2 --save-every 2000 --eval-every 500 --eval-envs 16 \
+  --no-save-replay --stall-timeout 300 --metrics runs/ddpg_walker2d_run1_tpu.jsonl --seed 0 --quiet \
+  > runs/ddpg_w2_stdout.log 2>&1
+echo "[q4b] ddpg rc=$?"
+
+echo "[q4b] TD3 Walker2d seed 1 (TPU learner)"
+nice -n 5 scripts/run_resumable.sh --preset td3_walker2d \
+  --ckpt-dir runs/td3_w2_s1 --save-every 2000 --eval-every 500 --eval-envs 16 \
+  --no-save-replay --stall-timeout 300 --metrics runs/td3_walker2d_run3_seed1.jsonl --seed 1 --quiet \
+  > runs/td3_w2_s1_stdout.log 2>&1
+echo "[q4b] td3 rc=$?"
+
+echo "[q4b] SAC Humanoid seed 1 (TPU learner)"
+nice -n 5 scripts/run_resumable.sh --preset sac_humanoid \
+  --ckpt-dir runs/sac_hum_s1 --save-every 2000 --eval-every 500 --eval-envs 16 \
+  --no-save-replay --stall-timeout 300 --metrics runs/sac_humanoid_run2_seed1.jsonl --seed 1 --quiet \
+  > runs/sac_hum_s1_stdout.log 2>&1
+echo "[q4b] sac rc=$?"
+echo "[q4b] all done"
