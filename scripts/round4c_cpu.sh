@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# CPU fallback for the round-4b queue: the tunnel died again ~06:03 UTC
+# 2026-07-31 (DDPG run wedged at iter 5360; three watchdog/resume
+# cycles confirmed dead). Same result runs on XLA:CPU, sequential on
+# the 1-core host, watchdog off (CPU cannot wedge):
+#   1. DDPG Walker2d resume from the TPU leg's iter-4000 checkpoint
+#   2. TD3 Walker2d seed 1
+#   3. SAC Humanoid seed 1 (longest; resumable into round 5 if the
+#      round ends first)
+set -u
+cd "$(dirname "$0")/.."
+export PALLAS_AXON_POOL_IPS=
+export JAX_PLATFORMS=cpu
+mkdir -p runs results
+
+echo "[q4c] DDPG Walker2d resume on CPU"
+nice -n 5 scripts/run_resumable.sh --preset ddpg_walker2d \
+  --ckpt-dir runs/ddpg_w2 --save-every 2000 --eval-every 500 --eval-envs 16 \
+  --no-save-replay --resume \
+  --metrics runs/ddpg_walker2d_run1_tpu.jsonl --seed 0 --quiet \
+  > runs/ddpg_w2_cpu_stdout.log 2>&1
+echo "[q4c] ddpg rc=$?"
+
+echo "[q4c] TD3 Walker2d seed 1 on CPU"
+nice -n 5 scripts/run_resumable.sh --preset td3_walker2d \
+  --ckpt-dir runs/td3_w2_s1 --save-every 2000 --eval-every 500 --eval-envs 16 \
+  --no-save-replay --metrics runs/td3_walker2d_run3_seed1.jsonl --seed 1 --quiet \
+  > runs/td3_w2_s1_stdout.log 2>&1
+echo "[q4c] td3 rc=$?"
+
+echo "[q4c] SAC Humanoid seed 1 on CPU"
+nice -n 5 scripts/run_resumable.sh --preset sac_humanoid \
+  --ckpt-dir runs/sac_hum_s1 --save-every 2000 --eval-every 500 --eval-envs 16 \
+  --no-save-replay --metrics runs/sac_humanoid_run2_seed1.jsonl --seed 1 --quiet \
+  > runs/sac_hum_s1_stdout.log 2>&1
+echo "[q4c] sac rc=$?"
+echo "[q4c] all done"
