@@ -1,30 +1,34 @@
 #!/usr/bin/env bash
 # CPU fallback for the round-4b queue: the tunnel died again ~06:03 UTC
 # 2026-07-31 (DDPG run wedged at iter 5360; three watchdog/resume
-# cycles confirmed dead). Same result runs on XLA:CPU, sequential on
-# the 1-core host, watchdog off (CPU cannot wedge):
-#   1. DDPG Walker2d resume from the TPU leg's iter-4000 checkpoint
-#   2. TD3 Walker2d seed 1
-#   3. SAC Humanoid seed 1 (longest; resumable into round 5 if the
-#      round ends first)
+# cycles confirmed dead). Result runs on XLA:CPU, sequential on the
+# 1-core host, watchdog off (CPU cannot wedge).
+#
+# DDPG restarts FRESH rather than resuming the TPU leg: resuming its
+# replay-free checkpoint put 500 iterations of updates against a thin
+# refilled buffer and measurably degraded the restored actor (greedy
+# eval 433 -> 138, q_mean 254 -> 404 overestimation spike) — exactly
+# the documented cost of --no-save-replay resume semantics, fine for
+# crash recovery, wrong for a first-measurement evidence row. Walker2d
+# rings are ~160 MB so replay rides the checkpoint here; only the
+# ~3 GB Humanoid ring warrants --no-save-replay.
 set -u
 cd "$(dirname "$0")/.."
 export PALLAS_AXON_POOL_IPS=
 export JAX_PLATFORMS=cpu
 mkdir -p runs results
 
-echo "[q4c] DDPG Walker2d resume on CPU"
+echo "[q4c] DDPG Walker2d 1M fresh on CPU"
 nice -n 5 scripts/run_resumable.sh --preset ddpg_walker2d \
-  --ckpt-dir runs/ddpg_w2 --save-every 2000 --eval-every 500 --eval-envs 16 \
-  --no-save-replay --resume \
-  --metrics runs/ddpg_walker2d_run1_tpu.jsonl --seed 0 --quiet \
+  --ckpt-dir runs/ddpg_w2_cpu --save-every 2000 --eval-every 500 --eval-envs 16 \
+  --metrics runs/ddpg_walker2d_run1_cpu.jsonl --seed 0 --quiet \
   > runs/ddpg_w2_cpu_stdout.log 2>&1
 echo "[q4c] ddpg rc=$?"
 
 echo "[q4c] TD3 Walker2d seed 1 on CPU"
 nice -n 5 scripts/run_resumable.sh --preset td3_walker2d \
   --ckpt-dir runs/td3_w2_s1 --save-every 2000 --eval-every 500 --eval-envs 16 \
-  --no-save-replay --metrics runs/td3_walker2d_run3_seed1.jsonl --seed 1 --quiet \
+  --metrics runs/td3_walker2d_run3_seed1.jsonl --seed 1 --quiet \
   > runs/td3_w2_s1_stdout.log 2>&1
 echo "[q4c] td3 rc=$?"
 
