@@ -21,6 +21,18 @@ while true; do
       timeout 900 python bench/suite.py pallas > runs/pallas_rows.json 2>> runs/tpu_watch.log
       timeout 600 python bench/suite.py impala > runs/impala_rows.json 2>> runs/tpu_watch.log
       date -u +%FT%TZ > runs/TPU_ALIVE
+      # Round-4 addendum: with the short captures banked, spend the rest
+      # of the window on the queued pong seed-1 curve (chunked dispatch:
+      # ~25 min for the full 205M decisions; resumable if the window
+      # closes first). stall-timeout generously above one chunk's wall
+      # time per the --chunk watchdog contract.
+      echo "$(date -u +%FT%TZ) launching pong seed-1 chunked run" >> runs/tpu_watch.log
+      scripts/run_resumable.sh --preset impala_pong_learn --seed 1 \
+        --iterations 160000 --chunk 20 --eval-every 1000 --log-every 100 \
+        --ckpt-dir runs/pong_s1 --save-every 10000 --stall-timeout 300 \
+        --metrics runs/impala_pong_learn_tpu_s1.jsonl --quiet \
+        >> runs/tpu_watch.log 2>&1
+      echo "$(date -u +%FT%TZ) pong seed-1 rc=$?" >> runs/tpu_watch.log
       exit 0
     fi
     echo "$(date -u +%FT%TZ) probe: dead" >> runs/tpu_watch.log
