@@ -68,6 +68,17 @@ class DDPGConfig:
     target_noise: float = 0.0       # target-policy smoothing std
     target_noise_clip: float = 0.5
     bf16_compute: bool = False
+    # --- n-step returns (replay.sample_sequences consumer) ---
+    # nstep > 1 samples length-n windows of consecutive inserts and
+    # trains the critic on the n-step target
+    #   G = Σ_{k<m} γ^k r_k  +  γ^m (1 − terminated_{m−1}) Q̄(s_m, π̄(s_m)),
+    # where m is the window length up to the first episode end (done
+    # cuts the sum; truncation bootstraps through, exactly like the
+    # 1-step path). Requires num_envs == 1: the ring stores flattened
+    # [K, E] rollouts, so consecutive inserts are one env's consecutive
+    # timesteps only for a single env (replay.sample_sequences guards
+    # the ring seam, not env interleaving).
+    nstep: int = 1
 
 
 def td3_config(**overrides) -> DDPGConfig:
@@ -195,6 +206,46 @@ def make_explore_fn(action_dim: int, cfg: DDPGConfig):
     return act
 
 
+def nstep_batch(
+    seq: OffPolicyTransition, gamma: float
+) -> tuple[OffPolicyTransition, jax.Array]:
+    """[B, n] sequence windows → (1-step-shaped batch, bootstrap discount).
+
+    The returned batch's `reward` carries the masked n-step return prefix
+    G = Σ_{k<m} γ^k r_k (m = steps up to and including the first done;
+    the done step's own reward counts — it is the terminal reward), and
+    `next_obs`/`terminated` are the window-END transition's (first done
+    step, else the last). The bootstrap discount is γ^m, so
+    target = G + γ^m (1 − terminated_end) Q̄(next_obs_end, ·) matches the
+    1-step TD shape exactly — truncations bootstrap through, terminations
+    mask, episodes never splice (`replay.sample_sequences` consumer).
+    """
+    n = seq.reward.shape[1]
+    d = seq.done.astype(jnp.float32)  # [B, n]
+    alive_before = jnp.cumprod(
+        jnp.concatenate([jnp.ones_like(d[:, :1]), 1.0 - d[:, :-1]], axis=1),
+        axis=1,
+    )
+    gammas = gamma ** jnp.arange(n, dtype=jnp.float32)
+    g = jnp.sum(seq.reward * alive_before * gammas, axis=1)
+    any_done = jnp.max(d, axis=1) > 0
+    end_idx = jnp.where(any_done, jnp.argmax(d, axis=1), n - 1)  # [B]
+
+    def at_end(x):
+        idx = end_idx.reshape((-1,) + (1,) * (x.ndim - 1))
+        return jnp.take_along_axis(x, idx, axis=1)[:, 0]
+
+    batch = OffPolicyTransition(
+        obs=seq.obs[:, 0],
+        action=seq.action[:, 0],
+        reward=g,
+        next_obs=at_end(seq.next_obs),
+        terminated=at_end(seq.terminated),
+        done=seq.done[:, 0],
+    )
+    return batch, gamma ** (end_idx.astype(jnp.float32) + 1.0)
+
+
 def make_update_loop(
     action_dim: int,
     cfg: DDPGConfig,
@@ -207,6 +258,14 @@ def make_update_loop(
     (static program) but params/targets/optimizer state are `where`-kept.
     """
     actor, critic = _modules(action_dim, cfg)
+    if cfg.nstep < 1:
+        raise ValueError(f"nstep must be >= 1, got {cfg.nstep}")
+    if cfg.nstep > 1 and cfg.num_envs != 1:
+        raise ValueError(
+            "nstep > 1 requires num_envs == 1: the replay ring stores "
+            "flattened [K, E] rollouts, so consecutive inserts interleave "
+            "envs unless E == 1 (see DDPGConfig.nstep)"
+        )
 
     def critic_loss_fn(critic_params, target_q, batch: OffPolicyTransition):
         q1, q2 = _critic_q(critic, critic_params, batch.obs, batch.action, cfg)
@@ -225,7 +284,14 @@ def make_update_loop(
 
     def one_update(ls: LearnerState, do_update: jax.Array):
         key, skey, tkey = jax.random.split(ls.key, 3)
-        batch: OffPolicyTransition = replay.sample(ls.replay, skey, cfg.batch_size)
+        if cfg.nstep > 1:
+            seq = replay.sample_sequences(
+                ls.replay, skey, cfg.batch_size, cfg.nstep
+            )
+            batch, boot_discount = nstep_batch(seq, cfg.gamma)
+        else:
+            batch = replay.sample(ls.replay, skey, cfg.batch_size)
+            boot_discount = cfg.gamma
 
         # --- TD target from target nets (+TD3 smoothing) ---
         next_a = actor.apply(ls.target_actor, batch.next_obs)
@@ -239,7 +305,7 @@ def make_update_loop(
         tq1, tq2 = _critic_q(critic, ls.target_critic, batch.next_obs, next_a, cfg)
         next_q = tq1 if tq2 is None else jnp.minimum(tq1, tq2)
         target_q = jax.lax.stop_gradient(
-            batch.reward + cfg.gamma * (1.0 - batch.terminated) * next_q
+            batch.reward + boot_discount * (1.0 - batch.terminated) * next_q
         )
 
         # --- critic step (every update) ---

@@ -139,8 +139,11 @@ PRESETS: dict[str, Preset] = {
     # impala_pong, env at the learnable difficulty — opponent tracking
     # at half speed (placed shots score within ~100 steps instead of
     # hundreds), ALE-style frame_skip=4 (ball velocity visible in the
-    # 2-frame stack), 36px frames. 40k iterations ≈ 51.2M decisions
-    # reproduces the recorded curve; eval crosses 0 at ~27M.
+    # 2-frame stack), 36px frames. 40k iterations ≈ 51.2M decisions.
+    # Entropy-collapse timing is strongly seed-dependent: eval crosses 0
+    # anywhere in the ~27M–130M decision band (observed across seeds /
+    # hosts — BASELINE.md's variance note), so plateau runs budget
+    # 160k iterations ≈ 205M decisions.
     "impala_pong_learn": Preset(
         algo="impala",
         env="jax:pong",

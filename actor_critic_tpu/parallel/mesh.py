@@ -50,7 +50,11 @@ def make_mesh(cfg: MeshConfig = MeshConfig(), devices=None) -> Mesh:
     return jax.make_mesh((dp, model), (DP_AXIS, MODEL_AXIS), devices=devices)
 
 
-def multihost_init(coordinator: Optional[str] = None) -> None:
+def multihost_init(
+    coordinator: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
     """Multi-host (DCN) initialization (SURVEY §5.8).
 
     Must be called before anything initializes the XLA backend (JAX's
@@ -60,9 +64,20 @@ def multihost_init(coordinator: Optional[str] = None) -> None:
     auto-detection (SLURM, Open MPI, Cloud TPU pod metadata,
     JAX_COORDINATOR_ADDRESS, ...) decide: if it finds no cluster, its
     error is swallowed and the process runs single-host.
+
+    With an explicit `coordinator` the init is NOT optional — failures
+    propagate. Outside auto-detectable clusters (e.g. a hand-rolled
+    launcher, or the two-process localhost exercise in
+    tests/test_multihost.py) pass `num_processes`/`process_id` too;
+    inside one, JAX infers them.
     """
     if coordinator is not None:
-        jax.distributed.initialize(coordinator_address=coordinator)
+        kwargs = {}
+        if num_processes is not None:
+            kwargs["num_processes"] = num_processes
+        if process_id is not None:
+            kwargs["process_id"] = process_id
+        jax.distributed.initialize(coordinator_address=coordinator, **kwargs)
         return
     try:
         jax.distributed.initialize()

@@ -116,7 +116,9 @@ def sample_sequences(
     oldest transitions into a fabricated sequence). Callers ensure
     size >= seq_len. Returned leaves are [batch_size, seq_len, ...].
     Sequences may still span episode boundaries; consumers mask on their
-    stored `done` flags.
+    stored `done` flags — see `algos.ddpg` `DDPGConfig.nstep`, whose
+    n-step TD target is the in-tree consumer (ADVICE: a sequence/R2D2
+    style recurrent consumer would sit on the same call).
     """
     capacity = capacity_of(state)
     # Oldest valid entry: physical slot 0 until the ring fills, then the

@@ -18,6 +18,7 @@ unmodified.
 from __future__ import annotations
 
 import os
+import time
 from typing import Any, Callable, Optional
 
 import jax
@@ -188,10 +189,13 @@ def checkpointed_train(
     """Restart-idempotent train loop (SURVEY.md §5.3).
 
     Resumes from the latest checkpoint (if any, and `resume`), runs the
-    remaining iterations with `step_fn` (a jitted `(state) → (state,
-    metrics)`), saving every `save_every` iterations (plus once at the
-    end; `save_every<=0` means end-only) and calling `log_fn(it,
-    metrics)` each iteration. Re-running after a mid-loop kill produces
+    remaining iterations with `step_fn` — a jitted `(state) → (state,
+    metrics)` when `stride == 1`, or `(state, k)` advancing k iterations
+    per dispatch when `stride > 1` — saving on the `save_every` cadence
+    (plus once at the end; `save_every<=0` means end-only) and calling
+    `log_fn(it, metrics)` after each DISPATCH: that is every iteration
+    at `stride == 1` but only once per chunk at `stride > 1`, with `it`
+    jumping by the chunk size. Re-running after a mid-loop kill produces
     the same final state as an uninterrupted run, because the state
     pytree carries everything. With `ckpt=None` it is a plain train
     loop — the single implementation every caller shares.
@@ -229,7 +233,21 @@ def checkpointed_train(
         k = stride - it % stride if it % stride else stride
         k = min(k, num_iterations - it)
         watchdog.beat()  # progress heartbeat (utils/watchdog.py)
+        t_dispatch = time.monotonic()
         state, metrics = step_fn(state, k) if stride > 1 else step_fn(state)
+        if stride > 1 and watchdog.armed():
+            # A chunk that legitimately outlasts --stall-timeout must not
+            # be misread as a stall on the NEXT chunk (one beat per chunk;
+            # the kill/resume loop that never clears a chunk is ADVICE.md
+            # round-4 #2). A jitted call returns at ENQUEUE time, so the
+            # true chunk wall is only observable behind a block — block on
+            # the (scalar) metrics, which complete with the chunk program;
+            # only done while a watchdog is armed, so the unwatched path
+            # keeps its async pipelining. A completed chunk is proof of
+            # the real wall time — raise any armed watchdog to 3x that,
+            # with headroom for jit-cache misses on tail chunks.
+            jax.block_until_ready(metrics)
+            watchdog.ensure_timeout_at_least(3.0 * (time.monotonic() - t_dispatch))
         it += k
         if ckpt is not None and should_save(it, save_every, num_iterations):
             # Sync before handing buffers to the async saver: donation

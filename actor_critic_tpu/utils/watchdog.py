@@ -38,6 +38,34 @@ def beat() -> None:
         w.touch()
 
 
+def armed() -> bool:
+    """Whether any watchdog is currently armed (callers use this to skip
+    watchdog-only work, e.g. the chunk-wall measurement block in
+    checkpointed_train that would otherwise cost async pipelining)."""
+    return bool(_ACTIVE)
+
+
+def ensure_timeout_at_least(secs: float) -> None:
+    """Raise every armed watchdog's timeout to at least `secs`.
+
+    Chunked dispatch (`checkpointed_train(stride>1)`) beats once per
+    chunk; a chunk whose legitimate wall time exceeds --stall-timeout
+    would otherwise be killed as a stall on every chunk after the startup
+    grace — a kill/resume loop that never clears a chunk (ADVICE.md
+    round 4 #2). The loop calls this with a multiple of each COMPLETED
+    dispatch's measured wall time: proof of real progress, so widening
+    the stall definition to match is correct, and a genuine wedge is
+    still detected within the widened window."""
+    for w in _ACTIVE:
+        if secs > w.timeout_s:
+            print(
+                f"[watchdog] chunk wall time requires stall timeout "
+                f">= {secs:.0f}s; raising from {w.timeout_s:.0f}s",
+                file=sys.stderr, flush=True,
+            )
+            w.timeout_s = float(secs)
+
+
 class StallWatchdog:
     """Arms a daemon thread that kills the process (exit 42) if `touch()`
     isn't called for `timeout_s` seconds. Use as a context manager around

@@ -46,16 +46,64 @@ UNIT = "env-steps/sec/chip"
 NORTH_STAR = 1_000_000.0
 
 
+def _last_green() -> dict | None:
+    """The most recent committed/captured green benchmark line, embedded in
+    tunnel-dead error payloads so a red BENCH_r*.json is never evidence-free
+    at the artifact the driver reads (VERDICT.md round 4, weak #1). Scans
+    the watcher's capture (`runs/bench_tpu_green.json`) and the committed
+    round evidence (`results/bench_tpu_green_r*.json`) for the newest
+    parseable line with a real value."""
+    import glob
+    import datetime
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    candidates = glob.glob(os.path.join(here, "runs", "bench_tpu_green*.json"))
+    candidates += glob.glob(os.path.join(here, "results", "bench_tpu_green*.json"))
+    best = None
+    for path in candidates:
+        try:
+            with open(path) as f:
+                rec = json.loads(f.read().strip().splitlines()[-1])
+            if not (
+                isinstance(rec, dict)
+                and isinstance(rec.get("value"), (int, float))
+                and rec["value"] > 0
+            ):
+                continue
+            mtime = os.path.getmtime(path)
+            if best is None or mtime > best[0]:
+                best = (mtime, path, rec)
+        except Exception:
+            # One malformed evidence file must never crash the error-
+            # reporting path (this runs precisely when the tunnel is
+            # dead and the contract is ONE parseable JSON line).
+            continue
+    if best is None:
+        return None
+    mtime, path, rec = best
+    return {
+        "value": rec["value"],
+        "unit": rec.get("unit", UNIT),
+        "vs_baseline": rec.get("vs_baseline"),
+        "captured_at": datetime.datetime.fromtimestamp(
+            mtime, datetime.timezone.utc
+        ).strftime("%Y-%m-%dT%H:%M:%SZ"),
+        "evidence_path": os.path.relpath(path, here),
+    }
+
+
 def _error_line(msg: str) -> str:
-    return json.dumps(
-        {
-            "metric": METRIC,
-            "value": 0.0,
-            "unit": UNIT,
-            "vs_baseline": 0.0,
-            "error": msg,
-        }
-    )
+    record = {
+        "metric": METRIC,
+        "value": 0.0,
+        "unit": UNIT,
+        "vs_baseline": 0.0,
+        "error": msg,
+    }
+    green = _last_green()
+    if green is not None:
+        record["last_green"] = green
+    return json.dumps(record)
 
 
 def _allow_cpu() -> bool:

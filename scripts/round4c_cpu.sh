@@ -26,14 +26,17 @@ nice -n 5 scripts/run_resumable.sh --preset ddpg_walker2d \
 echo "[q4c] ddpg rc=$?"
 
 echo "[q4c] TD3 Walker2d seed 1 on CPU"
-nice -n 5 scripts/run_resumable.sh --preset td3_walker2d \
+# --fresh: these dirs were also named by the (wedged) round-4b TPU legs;
+# an evidence run must never silently resume that foreign state
+# (ADVICE.md round 4 #1 — run_resumable.sh refuses if a checkpoint exists).
+nice -n 5 scripts/run_resumable.sh --preset td3_walker2d --fresh \
   --ckpt-dir runs/td3_w2_s1 --save-every 2000 --eval-every 500 --eval-envs 16 \
   --metrics runs/td3_walker2d_run3_seed1.jsonl --seed 1 --quiet \
   > runs/td3_w2_s1_stdout.log 2>&1
 echo "[q4c] td3 rc=$?"
 
 echo "[q4c] SAC Humanoid seed 1 on CPU"
-nice -n 5 scripts/run_resumable.sh --preset sac_humanoid \
+nice -n 5 scripts/run_resumable.sh --preset sac_humanoid --fresh \
   --ckpt-dir runs/sac_hum_s1 --save-every 2000 --eval-every 500 --eval-envs 16 \
   --no-save-replay --metrics runs/sac_humanoid_run2_seed1.jsonl --seed 1 --quiet \
   > runs/sac_hum_s1_stdout.log 2>&1

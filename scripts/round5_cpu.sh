@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Round-5 CPU evidence queue (VERDICT r4 missing #6): second seeds for the
+# two remaining single-seed rows, sequential on the 1-core host.
+#   1. DDPG Walker2d-v5 seed 1 (~95 min) — DDPG's instability band is the
+#      row that benefits most from a second seed.
+#   2. PPO HalfCheetah-v5 seed 1 at hidden=256,256 (~45 min) — run 3's
+#      exact recipe (scripts/round4_queue.sh), new seed.
+# Both use --fresh: evidence runs must start from empty ckpt dirs
+# (ADVICE.md r4 #1; run_resumable.sh refuses otherwise).
+set -u
+cd "$(dirname "$0")/.."
+export PALLAS_AXON_POOL_IPS=
+export JAX_PLATFORMS=cpu
+mkdir -p runs results
+
+echo "[q5] DDPG Walker2d seed 1 on CPU"
+nice -n 5 scripts/run_resumable.sh --preset ddpg_walker2d --fresh \
+  --ckpt-dir runs/ddpg_w2_s1 --save-every 2000 --eval-every 500 --eval-envs 16 \
+  --metrics runs/ddpg_walker2d_run2_seed1.jsonl --seed 1 --quiet \
+  > runs/ddpg_w2_s1_stdout.log 2>&1
+echo "[q5] ddpg seed1 rc=$?"
+
+echo "[q5] PPO HalfCheetah seed 1 (hidden=256,256) on CPU"
+nice -n 5 scripts/run_resumable.sh --preset ppo_halfcheetah --fresh \
+  --iterations 2500 --set hidden=256,256 --set num_envs=16 --set anneal_iters=2500 \
+  --ckpt-dir runs/hc4_s1 --save-every 250 --eval-every 125 --eval-envs 8 \
+  --metrics runs/ppo_halfcheetah_run4_seed1.jsonl --seed 1 --quiet \
+  > runs/hc4_s1_stdout.log 2>&1
+echo "[q5] ppo hc seed1 rc=$?"

@@ -12,15 +12,33 @@
 #
 #   scripts/run_resumable.sh --preset sac_humanoid --ckpt-dir runs/hum \
 #       --save-every 1000 --stall-timeout 300 --eval-every 1000
+#
+# --fresh (consumed here, not passed to train.py): refuse to start if the
+# ckpt-dir already holds a checkpoint. Evidence runs want this — reusing a
+# dir from an earlier leg would silently resume foreign state (worst case
+# a --no-save-replay checkpoint, whose replay-free resume measurably
+# degrades the actor; ADVICE.md round 4 #1).
 set -u
 MAX_RETRIES=${MAX_RETRIES:-10}
 
 ckpt_dir=""
+fresh=0
 prev=""
+args=()
 for a in "$@"; do
+  if [ "$a" = "--fresh" ]; then fresh=1; prev="$a"; continue; fi
   if [ "$prev" = "--ckpt-dir" ]; then ckpt_dir="$a"; fi
+  args+=("$a")
   prev="$a"
 done
+set -- "${args[@]}"
+
+if [ "$fresh" -eq 1 ] && [ -n "$ckpt_dir" ] && [ -d "$ckpt_dir" ] \
+    && ls "$ckpt_dir" 2>/dev/null | grep -qE '^[0-9]+$'; then
+  echo "[run_resumable] --fresh: $ckpt_dir already contains a checkpoint;" \
+       "refusing to start an evidence run over foreign state" >&2
+  exit 3
+fi
 
 latest_step() {
   [ -n "$ckpt_dir" ] && [ -d "$ckpt_dir" ] || { echo -1; return; }

@@ -1,0 +1,111 @@
+"""Sharded-state checkpoint/restore (VERDICT round 4, missing #4).
+
+Every other checkpoint test runs single-device; a real multi-chip
+deployment's first failure mode is saving/restoring a dp-SHARDED
+`OffPolicyState` — the replay ring split over the mesh (SURVEY.md
+§5.3–5.4). This trains a dp-sharded TD3 on the fake 8-device CPU mesh,
+orbax-saves, restores restart-style into a freshly distributed template,
+and asserts (a) the restored ring is still dp-sharded with bitwise-equal
+shard contents, and (b) continuing from the restore reproduces the
+uninterrupted run's metrics and params bitwise.
+"""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from actor_critic_tpu.algos import ddpg
+from actor_critic_tpu.envs import make_point_mass
+from actor_critic_tpu.parallel import (
+    DP_AXIS,
+    distribute_state,
+    make_dp_train_step,
+    make_mesh,
+    offpolicy_state_specs,
+)
+from actor_critic_tpu.utils.checkpoint import Checkpointer
+
+pytestmark = pytest.mark.skipif(
+    jax.device_count() < 8, reason="needs 8 (fake) devices"
+)
+
+
+def _cfg():
+    return ddpg.td3_config(
+        num_envs=16, steps_per_iter=4, updates_per_iter=2,
+        buffer_capacity=512, batch_size=8, warmup_steps=0, hidden=(16,),
+    )
+
+
+def _metrics_np(m):
+    return {k: np.asarray(v) for k, v in m.items()}
+
+
+def test_sharded_offpolicy_checkpoint_roundtrip(tmp_path):
+    env = make_point_mass()
+    cfg = _cfg()
+    mesh = make_mesh()
+    specs = offpolicy_state_specs()
+    step = make_dp_train_step(
+        ddpg.make_train_step(env, cfg, axis_name=DP_AXIS), mesh, specs
+    )
+
+    state = distribute_state(ddpg.init_state(env, cfg, jax.random.key(0)), mesh, specs)
+    for _ in range(3):
+        state, _ = step(state)
+    jax.block_until_ready(state)
+
+    ckpt = Checkpointer(tmp_path)
+    assert ckpt.save(3, state, force=True)
+    ckpt.wait()
+
+    # Snapshot save-time values BEFORE the donating continuation steps
+    # destroy the buffers.
+    saved_ring_obs = np.asarray(state.learner.replay.storage.obs)
+    saved_actor_leaf = np.asarray(
+        jax.tree.leaves(state.learner.actor_params)[0]
+    )
+
+    # Arm A: uninterrupted continuation.
+    cont_metrics = []
+    for _ in range(2):
+        state, m = step(state)
+        cont_metrics.append(_metrics_np(m))
+    jax.block_until_ready(state)
+
+    # Arm B: restart-style restore into a FRESHLY DISTRIBUTED template
+    # (new process semantics: nothing survives but the checkpoint).
+    template = distribute_state(
+        ddpg.init_state(env, cfg, jax.random.key(0)), mesh, specs
+    )
+    restored = ckpt.restore(template, 3)
+    ckpt.close()
+
+    # (a) the restored ring is still dp-sharded, contents bitwise equal.
+    ring = restored.learner.replay.storage.obs
+    assert ring.sharding.spec == P(DP_AXIS), ring.sharding
+    np.testing.assert_array_equal(np.asarray(ring), saved_ring_obs)
+    np.testing.assert_array_equal(
+        np.asarray(jax.tree.leaves(restored.learner.actor_params)[0]),
+        saved_actor_leaf,
+    )
+    # Params replicated (every device bitwise identical), as distributed.
+    leaf = jax.tree.leaves(restored.learner.actor_params)[0]
+    shards = [np.asarray(s.data) for s in leaf.addressable_shards]
+    for s in shards[1:]:
+        np.testing.assert_array_equal(shards[0], s)
+
+    # (b) bitwise metric + param continuation: the restored arm must be
+    # indistinguishable from never having restarted.
+    for i in range(2):
+        restored, m = step(restored)
+        rm = _metrics_np(m)
+        for k, v in cont_metrics[i].items():
+            np.testing.assert_array_equal(v, rm[k], err_msg=f"step {i} {k}")
+    jax.block_until_ready(restored)
+    for a, b in zip(
+        jax.tree.leaves(state.learner.critic_params),
+        jax.tree.leaves(restored.learner.critic_params),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

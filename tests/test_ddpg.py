@@ -171,3 +171,71 @@ class TestHostPath:
         assert int(learner.replay.size) == K * E
         assert int(learner.update_count) == 1
         assert np.isfinite(float(metrics["critic_loss"]))
+
+
+class TestNStep:
+    """DDPGConfig.nstep — the replay.sample_sequences consumer."""
+
+    def _seq(self, rewards, done, terminated, n):
+        """Hand-built [1, n] window with distinguishable obs per step."""
+        r = jnp.asarray([rewards], jnp.float32)
+        return OffPolicyTransition(
+            obs=jnp.arange(n, dtype=jnp.float32).reshape(1, n, 1),
+            action=jnp.full((1, n, 1), 0.5),
+            reward=r,
+            next_obs=(10.0 + jnp.arange(n, dtype=jnp.float32)).reshape(1, n, 1),
+            terminated=jnp.asarray([terminated], jnp.float32),
+            done=jnp.asarray([done], jnp.float32),
+        )
+
+    def test_nstep_batch_no_done(self):
+        g = 0.9
+        seq = self._seq([1.0, 2.0, 4.0], [0, 0, 0], [0, 0, 0], 3)
+        batch, boot = ddpg.nstep_batch(seq, g)
+        assert np.isclose(float(batch.reward[0]), 1.0 + g * 2.0 + g * g * 4.0)
+        assert float(batch.next_obs[0, 0]) == 12.0  # window end = last step
+        assert float(batch.terminated[0]) == 0.0
+        assert np.isclose(float(boot[0]), g**3)
+        assert float(batch.obs[0, 0]) == 0.0 and float(batch.action[0, 0]) == 0.5
+
+    def test_nstep_batch_terminates_mid_window(self):
+        g = 0.9
+        # done+terminated at k=1: G = r0 + g*r1, later rewards masked,
+        # bootstrap discount g^2 but terminated=1 kills the bootstrap.
+        seq = self._seq([1.0, 2.0, 100.0], [0, 1, 0], [0, 1, 0], 3)
+        batch, boot = ddpg.nstep_batch(seq, g)
+        assert np.isclose(float(batch.reward[0]), 1.0 + g * 2.0)
+        assert float(batch.next_obs[0, 0]) == 11.0  # the done step's
+        assert float(batch.terminated[0]) == 1.0
+        assert np.isclose(float(boot[0]), g**2)
+
+    def test_nstep_batch_truncates_first_step(self):
+        g = 0.9
+        # done (time-limit) at k=0 without termination: G = r0 only and the
+        # bootstrap goes THROUGH next_obs_0 at discount g — identical to
+        # the 1-step path for that transition.
+        seq = self._seq([3.0, 7.0, 7.0], [1, 0, 0], [0, 0, 0], 3)
+        batch, boot = ddpg.nstep_batch(seq, g)
+        assert np.isclose(float(batch.reward[0]), 3.0)
+        assert float(batch.next_obs[0, 0]) == 10.0
+        assert float(batch.terminated[0]) == 0.0
+        assert np.isclose(float(boot[0]), g)
+
+    def test_nstep_requires_single_env(self):
+        import pytest
+
+        with pytest.raises(ValueError, match="num_envs == 1"):
+            ddpg.make_update_loop(1, _small_cfg(nstep=3, num_envs=16))
+
+    def test_td3_nstep_learns_point_mass(self):
+        env = make_point_mass()
+        cfg = ddpg.td3_config(
+            num_envs=1, steps_per_iter=16, updates_per_iter=8, nstep=3,
+            buffer_capacity=32768, batch_size=64, hidden=(32, 32),
+            actor_lr=1e-3, critic_lr=1e-3, warmup_steps=256,
+            exploration_noise=0.2,
+        )
+        state, metrics = ddpg.train(env, cfg, num_iterations=250, seed=3)
+        assert np.isfinite(float(metrics["critic_loss"]))
+        ret = _greedy_eval(env, cfg, state)
+        assert ret > -1.0, ret
