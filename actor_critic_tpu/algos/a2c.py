@@ -57,10 +57,15 @@ class A2CConfig:
     # ONE gradient step per rollout, so PPO's value-clip-vs-old would be
     # a mathematical no-op here (value ≡ value_old at the differentiation
     # point); Huber is the stabilizer that DOES engage — it clips each
-    # sample's value-step gradient to ±delta, bounding the value lurches
-    # behind the flagship preset's seed-sensitive oscillation without
-    # touching the policy-gradient estimator (round-4 sweep rejected
-    # normalize_adv / lower lr / tighter grad clip; VERDICT r4 weak #2).
+    # sample's value-step gradient to ±delta without touching the
+    # policy-gradient estimator. Round-5 measurement on the flagship
+    # preset (results/a2c_s{0,2}_huber{5,10}.json): delta=5 certifies
+    # seed 2 but BREAKS seed 0; delta=10 certifies seed 0 and lifts
+    # seed 2's oscillation band to 299–499 without certifying it — the
+    # knob relocates A2C's seed sensitivity, it does not remove it
+    # (consistent with the round-4 sweep rejecting normalize_adv /
+    # lower lr / tighter grad clip). Left off in the preset; available
+    # per-run via --set value_huber_delta=N.
     value_huber_delta: float = 0.0
     # bfloat16 activations for MXU throughput; params/optimizer stay fp32.
     bf16_compute: bool = False
