@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Round-5 depth queue: third seeds for the TD3 Walker2d and SAC Humanoid
+# rows (upgrades mean±range over 2 seeds to 3-seed statistics). Launch
+# AFTER scripts/round5_cpu.sh drains — the 1-core host serializes
+# everything. Same recipe as the recorded seeds, new seed, --fresh dirs.
+set -u
+cd "$(dirname "$0")/.."
+export PALLAS_AXON_POOL_IPS=
+export JAX_PLATFORMS=cpu
+mkdir -p runs results
+
+echo "[q5b] TD3 Walker2d seed 2 on CPU"
+nice -n 5 scripts/run_resumable.sh --preset td3_walker2d --fresh \
+  --ckpt-dir runs/td3_w2_s2 --save-every 2000 --eval-every 500 --eval-envs 16 \
+  --metrics runs/td3_walker2d_run4_seed2.jsonl --seed 2 --quiet \
+  > runs/td3_w2_s2_stdout.log 2>&1
+echo "[q5b] td3 seed2 rc=$?"
+
+echo "[q5b] SAC Humanoid seed 2 on CPU"
+nice -n 5 scripts/run_resumable.sh --preset sac_humanoid --fresh \
+  --ckpt-dir runs/sac_hum_s2 --save-every 2000 --eval-every 500 --eval-envs 16 \
+  --no-save-replay --metrics runs/sac_humanoid_run3_seed2.jsonl --seed 2 --quiet \
+  > runs/sac_hum_s2_stdout.log 2>&1
+echo "[q5b] sac seed2 rc=$?"
