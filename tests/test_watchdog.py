@@ -64,3 +64,53 @@ def test_cli_stall_timeout_clean_run(tmp_path):
         "sys.exit(train.main())\n"
     )
     assert proc.returncode == 0, proc.stderr
+
+
+def test_armed_and_ensure_timeout_at_least():
+    """The chunk-wall auto-raise contract (ADVICE r4 #2): a completed
+    chunk's measured wall time widens armed watchdogs, never narrows."""
+    assert not watchdog.armed()
+    w = watchdog.StallWatchdog(5.0, startup_grace_s=0.0).start()
+    try:
+        assert watchdog.armed()
+        watchdog.ensure_timeout_at_least(2.0)   # below current: no-op
+        assert w.timeout_s == 5.0
+        watchdog.ensure_timeout_at_least(9.0)   # above: raises
+        assert w.timeout_s == 9.0
+        watchdog.ensure_timeout_at_least(9.0)   # equal: no-op
+        assert w.timeout_s == 9.0
+    finally:
+        w.stop()
+    assert not watchdog.armed()
+    watchdog.ensure_timeout_at_least(99.0)      # disarmed: nothing to touch
+
+
+def test_chunked_train_widens_watchdog_from_real_chunk_wall():
+    """End-to-end: checkpointed_train(stride>1) must measure the chunk
+    BEHIND a block (a jitted call returns at enqueue time) and raise an
+    armed watchdog to 3x the measured wall."""
+    import jax
+    import jax.numpy as jnp
+
+    from actor_critic_tpu.utils.checkpoint import checkpointed_train
+
+    def slow_chunk(state, k):
+        time.sleep(0.25)  # stand-in for real device wall time
+        return state + k, {"loss": jnp.asarray(0.0)}
+
+    # Default startup grace shields the FIRST chunk (in production it
+    # shields first-call XLA compilation); the auto-raise must then widen
+    # the armed 0.4s timeout past the 0.25s chunk wall before the grace
+    # window would have expired. (An armed 0.1s/grace-0 variant of this
+    # test correctly dies at the first chunk — that is the documented
+    # pre-grace behavior, not a bug.)
+    w = watchdog.StallWatchdog(0.4).start()
+    try:
+        state, _ = checkpointed_train(
+            slow_chunk, jnp.asarray(0), num_iterations=4, stride=2,
+        )
+        assert int(state) == 4
+        # 3 x ~0.25s measured wall: widened to >= ~0.6 > the armed 0.4.
+        assert w.timeout_s >= 0.6, w.timeout_s
+    finally:
+        w.stop()
