@@ -24,6 +24,8 @@ Package layout (SURVEY.md §7.1):
     replay/    HBM-resident ring replay buffer
     algos/     A2C, PPO, DDPG, TD3, SAC, IMPALA/A3C trainers + greedy eval
     utils/     checkpointing (orbax), logging (JSONL/TB), profiling
+    telemetry/ unified run telemetry: Chrome-trace phase spans, resource
+               sampler, health monitors (train.py --telemetry-dir)
 """
 
 __version__ = "0.1.0"

@@ -15,6 +15,8 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from actor_critic_tpu import telemetry
+
 
 class EpisodeTracker:
     """Raw-return episode accounting across host steps."""
@@ -61,20 +63,24 @@ def host_collect(
 
     from actor_critic_tpu.utils import watchdog
 
-    for _ in range(num_steps):
-        watchdog.beat()  # progress heartbeat (utils/watchdog.py)
-        action, extras = act_fn(obs)
-        out = pool.step(action)
-        record("obs", obs)
-        record("action", action)
-        for k, v in extras.items():
-            record(k, v)
-        record("reward", out.reward)
-        record("done", out.done)
-        record("terminated", out.terminated)
-        record("final_obs", out.final_obs)
-        tracker.update(out.raw_reward, out.done)
-        obs = out.obs
+    # One span per collection block, not per pool step: a MuJoCo run
+    # takes millions of env steps, and the per-phase breakdown needs the
+    # block total, not 10^6 micro-events.
+    with telemetry.span("env_step", steps=num_steps):
+        for _ in range(num_steps):
+            watchdog.beat()  # progress heartbeat (utils/watchdog.py)
+            action, extras = act_fn(obs)
+            out = pool.step(action)
+            record("obs", obs)
+            record("action", action)
+            for k, v in extras.items():
+                record(k, v)
+            record("reward", out.reward)
+            record("done", out.done)
+            record("terminated", out.terminated)
+            record("final_obs", out.final_obs)
+            tracker.update(out.raw_reward, out.done)
+            obs = out.obs
 
     return obs, {k: np.stack(v) for k, v in block.items()}
 
@@ -161,6 +167,11 @@ def host_maybe_save(
     safe, and the disk write completes asynchronously."""
     if ckpt is None or not should_save(it, save_every, num_iterations):
         return
+    with telemetry.span("checkpoint", step=it):
+        _host_save(ckpt, it, pool, metrics, save_replay, device_state)
+
+
+def _host_save(ckpt, it, pool, metrics, save_replay, device_state):
     import jax
 
     jax.block_until_ready(device_state)
@@ -364,80 +375,90 @@ def off_policy_train_host(
             rng = np.random.default_rng(seed + 0x5EED)
 
     for it in range(start_it, num_iterations):
+        # Per-iteration span: the phase spans inside (env_step /
+        # host_to_device / update / eval / log / checkpoint) nest
+        # under it in the trace, giving per-iteration attribution.
+        with telemetry.span("iteration", it=it + 1):
 
-        if host_act is not None:
+            if host_act is not None:
 
-            def explore_act(o):
-                nonlocal env_steps
-                action = host_act(host_params, o, rng, env_steps)
-                env_steps += E
-                return action, {}
+                def explore_act(o):
+                    nonlocal env_steps
+                    action = host_act(host_params, o, rng, env_steps)
+                    env_steps += E
+                    return action, {}
 
-        else:
-
-            def explore_act(o):
-                nonlocal key, env_steps
-                key, akey = jax.random.split(key)
-                action = np.asarray(
-                    act(learner.actor_params, jnp.asarray(o), akey,
-                        jnp.asarray(env_steps, jnp.int32))
-                )
-                env_steps += E
-                return action, {}
-
-        obs, block = host_collect(
-            pool, obs, cfg.steps_per_iter, explore_act, tracker
-        )
-        traj = OffPolicyTransition(
-            obs=jnp.asarray(block["obs"]),
-            action=jnp.asarray(block["action"]),
-            reward=jnp.asarray(block["reward"]),
-            next_obs=jnp.asarray(block["final_obs"]),
-            terminated=jnp.asarray(block["terminated"]),
-            done=jnp.asarray(block["done"]),
-        )
-        if host_act is not None:
-            # Acting params for the NEXT rollout: this update's INPUT
-            # params, fetched BEFORE the dispatch (ingest_update donates
-            # the learner) — concrete already (the previous update
-            # finished during this collection), so the fetch doesn't
-            # wait, and the update dispatched below computes on-device
-            # while the next rollout is collected.
-            host_params = jax.device_get(learner.actor_params)
-        learner, metrics = ingest_update(
-            learner, traj, jnp.asarray(env_steps, jnp.int32)
-        )
-        extra = {"env_steps": env_steps}
-        if eval_pool is not None and (it + 1) % eval_every == 0:
-            # NB: a fresh name — `act` is the jitted explore fn that the
-            # non-mirror explore_act closure reads late-bound; rebinding
-            # it here would crash collection after the first eval.
-            if host_greedy is not None:
-                # Blocks on the in-flight update: eval sees CURRENT params.
-                ev_params = jax.device_get(learner.actor_params)
-                eval_act = lambda o: np.asarray(host_greedy(ev_params, o))  # noqa: E731
             else:
-                eval_act = lambda o: np.asarray(  # noqa: E731
-                    greedy(learner.actor_params, jnp.asarray(o))
-                )
-            extra["eval_return"] = host_evaluate(
-                eval_pool, eval_act, max_steps=eval_steps
+
+                def explore_act(o):
+                    nonlocal key, env_steps
+                    key, akey = jax.random.split(key)
+                    action = np.asarray(
+                        act(learner.actor_params, jnp.asarray(o), akey,
+                            jnp.asarray(env_steps, jnp.int32))
+                    )
+                    env_steps += E
+                    return action, {}
+
+            obs, block = host_collect(
+                pool, obs, cfg.steps_per_iter, explore_act, tracker
             )
-        maybe_log(
-            it, log_every, metrics, tracker, history, log_fn,
-            extra=extra,
-            num_iterations=num_iterations,
-            # Force-log eval rows AND the first post-resume iteration (a
-            # resumed long run must produce evidence immediately, same
-            # rationale as should_log's it==1 clause).
-            force="eval_return" in extra or it == start_it,
-        )
-        host_maybe_save(
-            ckpt, it + 1, save_every, num_iterations, pool, metrics,
-            save_replay=save_replay,
-            learner=learner, key=key,
-            env_steps=np.asarray(env_steps, np.int64),
-        )
+            with telemetry.span("host_to_device"):
+                traj = OffPolicyTransition(
+                    obs=jnp.asarray(block["obs"]),
+                    action=jnp.asarray(block["action"]),
+                    reward=jnp.asarray(block["reward"]),
+                    next_obs=jnp.asarray(block["final_obs"]),
+                    terminated=jnp.asarray(block["terminated"]),
+                    done=jnp.asarray(block["done"]),
+                )
+            if host_act is not None:
+                # Acting params for the NEXT rollout: this update's INPUT
+                # params, fetched BEFORE the dispatch (ingest_update donates
+                # the learner) — concrete already (the previous update
+                # finished during this collection), so the fetch doesn't
+                # wait, and the update dispatched below computes on-device
+                # while the next rollout is collected.
+                host_params = jax.device_get(learner.actor_params)
+            # The jitted call returns at ENQUEUE time (async dispatch);
+            # the span measures host-side cost only — blocking here to
+            # measure device wall would cost the host/device overlap.
+            with telemetry.span("update", dispatch="async"):
+                learner, metrics = ingest_update(
+                    learner, traj, jnp.asarray(env_steps, jnp.int32)
+                )
+            extra = {"env_steps": env_steps}
+            if eval_pool is not None and (it + 1) % eval_every == 0:
+                # NB: a fresh name — `act` is the jitted explore fn that the
+                # non-mirror explore_act closure reads late-bound; rebinding
+                # it here would crash collection after the first eval.
+                if host_greedy is not None:
+                    # Blocks on the in-flight update: eval sees CURRENT params.
+                    ev_params = jax.device_get(learner.actor_params)
+                    eval_act = lambda o: np.asarray(host_greedy(ev_params, o))  # noqa: E731
+                else:
+                    eval_act = lambda o: np.asarray(  # noqa: E731
+                        greedy(learner.actor_params, jnp.asarray(o))
+                    )
+                with telemetry.span("eval"):
+                    extra["eval_return"] = host_evaluate(
+                        eval_pool, eval_act, max_steps=eval_steps
+                    )
+            maybe_log(
+                it, log_every, metrics, tracker, history, log_fn,
+                extra=extra,
+                num_iterations=num_iterations,
+                # Force-log eval rows AND the first post-resume iteration (a
+                # resumed long run must produce evidence immediately, same
+                # rationale as should_log's it==1 clause).
+                force="eval_return" in extra or it == start_it,
+            )
+            host_maybe_save(
+                ckpt, it + 1, save_every, num_iterations, pool, metrics,
+                save_replay=save_replay,
+                learner=learner, key=key,
+                env_steps=np.asarray(env_steps, np.int64),
+            )
     if ckpt is not None:
         ckpt.wait()  # the final async save must be durable before return
     return learner, history
@@ -528,10 +549,14 @@ def maybe_log(
     always logged; `force` for rows that must never drop, e.g. eval)."""
     if not (force or should_log(it + 1, log_every, num_iterations)):
         return
-    m = {k: float(v) for k, v in metrics.items()}
-    m.update(tracker.report())
-    if extra:
-        m.update(extra)
-    history.append((it + 1, m))
-    if log_fn is not None:
-        log_fn(it + 1, m)
+    # The float() coercions are the host loop's first sync point on the
+    # dispatched update — the log span therefore absorbs any remaining
+    # device wait (documented in README "Observability").
+    with telemetry.span("log", it=it + 1):
+        m = {k: float(v) for k, v in metrics.items()}
+        m.update(tracker.report())
+        if extra:
+            m.update(extra)
+        history.append((it + 1, m))
+        if log_fn is not None:
+            log_fn(it + 1, m)

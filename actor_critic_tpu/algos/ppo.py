@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from actor_critic_tpu import telemetry
 from actor_critic_tpu.algos.common import (
     TrainState,
     anneal_fraction,
@@ -452,85 +453,91 @@ def train_host(
             rng = np.random.default_rng(seed + 0x5EED)
 
     for it in range(start_it, num_iterations):
+        with telemetry.span("iteration", it=it + 1):
 
-        if host_policy is not None:
+            if host_policy is not None:
 
-            def policy_act(o):
-                action, logp, value = host_policy(host_params, o, rng)
-                return action, {"log_prob": logp, "value": value}
+                def policy_act(o):
+                    action, logp, value = host_policy(host_params, o, rng)
+                    return action, {"log_prob": logp, "value": value}
 
-        else:
-
-            def policy_act(o):
-                nonlocal key
-                key, akey = jax.random.split(key)
-                action, logp, value = policy_step(params, jnp.asarray(o), akey)
-                return np.asarray(action), {
-                    "log_prob": np.asarray(logp),
-                    "value": np.asarray(value),
-                }
-
-        obs, block = host_collect(
-            pool, obs, cfg.rollout_steps, policy_act, tracker
-        )
-        key, ukey = jax.random.split(key)
-        arrays = {k: jnp.asarray(v) for k, v in block.items()}
-        extra_values = {}
-        if host_policy is not None:
-            # All GAE value baselines from the SAME stale behavior params
-            # as the recorded per-step values (mirror-computed host-side);
-            # mixing parameter versions would bias the TD residuals at
-            # truncation boundaries and the value-clip anchor.
-            T_, E_ = block["reward"].shape
-            fv = host_value(
-                host_params,
-                block["final_obs"].reshape(T_ * E_, *block["final_obs"].shape[2:]),
-            ).reshape(T_, E_)
-            extra_values = dict(
-                final_values=jnp.asarray(fv),
-                bootstrap_value=jnp.asarray(host_value(host_params, obs)),
-            )
-            # Next rollout's acting params: this update's INPUT, fetched
-            # before the dispatch (concrete — the previous update finished
-            # during collection — so no wait); the update dispatched below
-            # then overlaps the next rollout.
-            host_params = jax.device_get(params)
-        if cfg.anneal_iters > 0:
-            extra_values["progress"] = jnp.asarray(
-                min(it / cfg.anneal_iters, 1.0), jnp.float32
-            )
-        params, opt_state, metrics = update(
-            params, opt_state,
-            arrays["obs"], arrays["action"], arrays["log_prob"],
-            arrays["value"], arrays["reward"], arrays["done"],
-            arrays["terminated"], arrays["final_obs"],
-            jnp.asarray(obs), ukey, **extra_values,
-        )
-        extra = {"env_steps": (it + 1) * cfg.rollout_steps * pool.num_envs}
-        if eval_pool is not None and (it + 1) % eval_every == 0:
-            if host_greedy is not None:
-                # device_get blocks until the in-flight update lands, so
-                # eval always sees the CURRENT params.
-                ev_params = jax.device_get(params)
-                eval_act = lambda o: np.asarray(host_greedy(ev_params, o))  # noqa: E731
             else:
-                eval_act = lambda o: np.asarray(  # noqa: E731
-                    greedy(params, jnp.asarray(o))
-                )
-            extra["eval_return"] = host_evaluate(
-                eval_pool, eval_act, max_steps=eval_steps
+
+                def policy_act(o):
+                    nonlocal key
+                    key, akey = jax.random.split(key)
+                    action, logp, value = policy_step(params, jnp.asarray(o), akey)
+                    return np.asarray(action), {
+                        "log_prob": np.asarray(logp),
+                        "value": np.asarray(value),
+                    }
+
+            obs, block = host_collect(
+                pool, obs, cfg.rollout_steps, policy_act, tracker
             )
-        maybe_log(
-            it, log_every, metrics, tracker, history, log_fn,
-            extra=extra,
-            num_iterations=num_iterations,
-            # eval rows and the first post-resume iteration never drop
-            force="eval_return" in extra or it == start_it,
-        )
-        host_maybe_save(
-            ckpt, it + 1, save_every, num_iterations, pool, metrics,
-            params=params, opt_state=opt_state, key=key,
-        )
+            key, ukey = jax.random.split(key)
+            with telemetry.span("host_to_device"):
+                arrays = {k: jnp.asarray(v) for k, v in block.items()}
+            extra_values = {}
+            if host_policy is not None:
+                # All GAE value baselines from the SAME stale behavior params
+                # as the recorded per-step values (mirror-computed host-side);
+                # mixing parameter versions would bias the TD residuals at
+                # truncation boundaries and the value-clip anchor.
+                T_, E_ = block["reward"].shape
+                fv = host_value(
+                    host_params,
+                    block["final_obs"].reshape(T_ * E_, *block["final_obs"].shape[2:]),
+                ).reshape(T_, E_)
+                extra_values = dict(
+                    final_values=jnp.asarray(fv),
+                    bootstrap_value=jnp.asarray(host_value(host_params, obs)),
+                )
+                # Next rollout's acting params: this update's INPUT, fetched
+                # before the dispatch (concrete — the previous update finished
+                # during collection — so no wait); the update dispatched below
+                # then overlaps the next rollout.
+                host_params = jax.device_get(params)
+            if cfg.anneal_iters > 0:
+                extra_values["progress"] = jnp.asarray(
+                    min(it / cfg.anneal_iters, 1.0), jnp.float32
+                )
+            # Async dispatch: the span measures host-side enqueue only
+            # (fencing here would cost the rollout/update overlap).
+            with telemetry.span("update", dispatch="async"):
+                params, opt_state, metrics = update(
+                    params, opt_state,
+                    arrays["obs"], arrays["action"], arrays["log_prob"],
+                    arrays["value"], arrays["reward"], arrays["done"],
+                    arrays["terminated"], arrays["final_obs"],
+                    jnp.asarray(obs), ukey, **extra_values,
+                )
+            extra = {"env_steps": (it + 1) * cfg.rollout_steps * pool.num_envs}
+            if eval_pool is not None and (it + 1) % eval_every == 0:
+                if host_greedy is not None:
+                    # device_get blocks until the in-flight update lands, so
+                    # eval always sees the CURRENT params.
+                    ev_params = jax.device_get(params)
+                    eval_act = lambda o: np.asarray(host_greedy(ev_params, o))  # noqa: E731
+                else:
+                    eval_act = lambda o: np.asarray(  # noqa: E731
+                        greedy(params, jnp.asarray(o))
+                    )
+                with telemetry.span("eval"):
+                    extra["eval_return"] = host_evaluate(
+                        eval_pool, eval_act, max_steps=eval_steps
+                    )
+            maybe_log(
+                it, log_every, metrics, tracker, history, log_fn,
+                extra=extra,
+                num_iterations=num_iterations,
+                # eval rows and the first post-resume iteration never drop
+                force="eval_return" in extra or it == start_it,
+            )
+            host_maybe_save(
+                ckpt, it + 1, save_every, num_iterations, pool, metrics,
+                params=params, opt_state=opt_state, key=key,
+            )
     if ckpt is not None:
         ckpt.wait()  # the final async save must be durable before return
     return params, opt_state, history

@@ -206,7 +206,7 @@ def make_dp_train_step(
     [ndev] key batch; the wrapper unwraps it. `specs` defaults to the
     on-policy TrainState layout.
     """
-    shard_map = jax.shard_map
+    from actor_critic_tpu.parallel.mesh import shard_map
 
     if specs is None:
         specs = train_state_specs()

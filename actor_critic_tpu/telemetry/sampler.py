@@ -1,0 +1,158 @@
+"""Daemon resource sampler (`resources.jsonl`).
+
+A background thread records, on a fixed cadence, the signals that
+explain the two classic silent run-killers — memory creep and recompile
+storms:
+
+- process RSS (``/proc/self/statm``; peak-RSS ``getrusage`` fallback),
+- per-device live/peak bytes from ``jax.local_devices()[i]
+  .memory_stats()`` (``None`` on backends without allocator stats, e.g.
+  CPU — recorded as absent, not zero),
+- a monotonically increasing XLA recompile counter fed by
+  ``jax.monitoring`` backend-compile events.
+
+Sampling never touches the device (``memory_stats()`` is a host-side
+allocator query), so the cadence costs the training loop nothing.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+from typing import IO, Optional
+
+_PAGE = 4096
+try:
+    import resource as _resource
+
+    _PAGE = _resource.getpagesize()
+except Exception:  # pragma: no cover - non-POSIX
+    _resource = None
+
+# Process-global compile counter: jax.monitoring listeners cannot be
+# individually unregistered, so registration happens once per process and
+# the listener outlives any session (an int increment, harmless).
+_compile_count = 0
+_listener_registered = False
+_listener_lock = threading.Lock()
+
+
+def _on_event_duration(name: str, *args, **kwargs) -> None:
+    if name.endswith("backend_compile_duration"):
+        global _compile_count
+        _compile_count += 1
+
+
+def ensure_compile_listener() -> None:
+    """Idempotently hook the XLA backend-compile event stream."""
+    global _listener_registered
+    with _listener_lock:
+        if _listener_registered:
+            return
+        try:
+            import jax.monitoring
+
+            jax.monitoring.register_event_duration_secs_listener(
+                _on_event_duration
+            )
+            _listener_registered = True
+        except Exception:
+            pass  # telemetry must never take a run down
+
+
+def compile_count() -> int:
+    """Backend compiles observed since the listener was installed."""
+    return _compile_count
+
+
+def rss_bytes() -> Optional[int]:
+    """Current resident set size; peak RSS when /proc is unavailable."""
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * _PAGE
+    except (OSError, ValueError, IndexError):
+        if _resource is None:
+            return None
+        # ru_maxrss is kilobytes on Linux but bytes on macOS (both are
+        # peak, the documented degraded mode).
+        maxrss = _resource.getrusage(_resource.RUSAGE_SELF).ru_maxrss
+        return maxrss if sys.platform == "darwin" else maxrss * 1024
+
+
+def device_memory() -> list[dict]:
+    """[{id, platform, live_bytes, peak_bytes}] per local device; devices
+    whose backend exposes no allocator stats are reported without the
+    byte fields rather than with fake zeros."""
+    out: list[dict] = []
+    try:
+        import jax
+
+        for d in jax.local_devices():
+            row: dict = {"id": int(d.id), "platform": str(d.platform)}
+            try:
+                stats = d.memory_stats()
+            except Exception:
+                stats = None
+            if stats:
+                live = stats.get("bytes_in_use")
+                peak = stats.get("peak_bytes_in_use")
+                if live is not None:
+                    row["live_bytes"] = int(live)
+                if peak is not None:
+                    row["peak_bytes"] = int(peak)
+            out.append(row)
+    except Exception:
+        pass
+    return out
+
+
+def sample_row() -> dict:
+    """One resources.jsonl row (also usable synchronously from tests)."""
+    row: dict = {
+        "ts": round(time.time(), 3),
+        "recompiles": compile_count(),
+    }
+    rss = rss_bytes()
+    if rss is not None:
+        row["rss_bytes"] = rss
+    devs = device_memory()
+    if devs:
+        row["devices"] = devs
+    return row
+
+
+class ResourceSampler:
+    """Daemon thread appending `sample_row()` to `fh` every `interval_s`
+    seconds (plus once at start and once at stop, so even a short run
+    gets a first/last pair)."""
+
+    def __init__(self, fh: IO[str], interval_s: float = 5.0):
+        self._fh = fh
+        self._interval = max(float(interval_s), 0.01)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="telemetry-sampler", daemon=True
+        )
+
+    def start(self) -> "ResourceSampler":
+        ensure_compile_listener()
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+
+    def _emit(self) -> None:
+        try:
+            self._fh.write(json.dumps(sample_row(), allow_nan=False) + "\n")
+        except ValueError:
+            pass  # file closed mid-shutdown; nothing to record it in
+
+    def _run(self) -> None:
+        self._emit()
+        while not self._stop.wait(self._interval):
+            self._emit()
+        self._emit()

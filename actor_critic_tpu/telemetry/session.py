@@ -1,0 +1,235 @@
+"""`TelemetrySession` and the module-level current-session API.
+
+The training loops are instrumented UNCONDITIONALLY with the functions
+here (`span`, `instant`, `observe`); each call is near-free when no
+session is installed — a span is two `time.perf_counter()` reads plus a
+list push/pop, kept even without a session so the stall watchdog can
+always name the phase that hung. Installing a session (`train.py
+--telemetry-dir`) turns the same calls into JSONL emission:
+
+    <telemetry-dir>/spans.jsonl      Chrome-trace phase events
+    <telemetry-dir>/resources.jsonl  RSS / device memory / recompiles
+    <telemetry-dir>/events.jsonl     health + lifecycle events
+
+The open-span stack is a plain module-global (the training loop is
+single-threaded; the sampler and watchdog threads only read it), so a
+cross-thread reader always sees a consistent-enough snapshot for a
+diagnosis line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import IO, Optional
+
+from actor_critic_tpu.telemetry.health import (
+    DivergenceMonitor,
+    ThroughputMonitor,
+)
+from actor_critic_tpu.telemetry.sampler import ResourceSampler
+from actor_critic_tpu.telemetry.spans import SpanTracer
+
+_SESSION: Optional["TelemetrySession"] = None
+
+# Open-span stack: (name, entry perf_counter). Appended/popped by _Span
+# on the training thread; read by the watchdog thread on a stall.
+_OPEN: list[tuple[str, float]] = []
+
+
+class _Span:
+    """Context manager for one phase span. Always tracks the open-span
+    stack; emits a Chrome-trace complete event only while a session is
+    installed at EXIT time (so a session installed mid-span still
+    records it)."""
+
+    __slots__ = ("_name", "_args", "_t0")
+
+    def __init__(self, name: str, args: Optional[dict]):
+        self._name = name
+        self._args = args
+
+    def __enter__(self) -> "_Span":
+        self._t0 = time.perf_counter()
+        _OPEN.append((self._name, self._t0))
+        return self
+
+    def __exit__(self, *exc) -> None:
+        dur = time.perf_counter() - self._t0
+        if _OPEN and _OPEN[-1][0] == self._name:
+            _OPEN.pop()
+        s = _SESSION
+        if s is not None:
+            s.tracer.complete(self._name, self._t0, dur, self._args)
+
+
+def span(name: str, **args) -> _Span:
+    """`with telemetry.span("update", it=12):` around a loop phase."""
+    return _Span(name, args or None)
+
+
+def instant(name: str, **args) -> None:
+    """Mark a phase with no separable host duration (fused rollouts)."""
+    s = _SESSION
+    if s is not None:
+        s.tracer.instant(name, args or None)
+
+
+def event(kind: str, **fields) -> None:
+    """Append a structured event row to events.jsonl (no-op untracked)."""
+    s = _SESSION
+    if s is not None:
+        s.event(kind, **fields)
+
+
+def observe(it: int, metrics: dict) -> None:
+    """Feed one logged iteration to the health monitors (no-op when no
+    session is installed)."""
+    s = _SESSION
+    if s is not None:
+        s.observe(it, metrics)
+
+
+def current() -> Optional["TelemetrySession"]:
+    return _SESSION
+
+
+def set_current(session: Optional["TelemetrySession"]) -> None:
+    global _SESSION
+    _SESSION = session
+
+
+def open_spans() -> list[str]:
+    """Names of currently open spans, outermost first."""
+    return [name for name, _ in list(_OPEN)]
+
+
+def last_open_span() -> Optional[tuple[str, float]]:
+    """(name, seconds open) of the innermost open span, if any."""
+    snapshot = list(_OPEN)
+    if not snapshot:
+        return None
+    name, t0 = snapshot[-1]
+    return name, time.perf_counter() - t0
+
+
+def stall_report(stalled_s: float = 0.0) -> str:
+    """One diagnosis clause for the watchdog's exit-42 message: names the
+    phase that was open when progress stopped. Also records a `stall`
+    event while a session is installed (the files are line-buffered, so
+    the row survives the `os._exit` that follows)."""
+    last = last_open_span()
+    s = _SESSION
+    if s is not None:
+        fields = {"stalled_s": round(stalled_s, 1)}
+        if last is not None:
+            fields.update(phase=last[0], phase_open_s=round(last[1], 1))
+        try:
+            s.event("stall", **fields)
+        except Exception:
+            pass
+    if last is None:
+        return ""
+    return (
+        f"; last open telemetry span: {last[0]!r} "
+        f"(open {last[1]:.1f}s)"
+    )
+
+
+class TelemetrySession:
+    """Owns the three telemetry sinks for one run.
+
+    `directory` is created; the files are opened line-buffered append so
+    every completed write survives even an `os._exit` teardown. Install
+    with `set_current` (or use as a context manager) to route the
+    module-level `span`/`instant`/`event`/`observe` calls here.
+    """
+
+    def __init__(
+        self,
+        directory: str | os.PathLike,
+        run_info: Optional[dict] = None,
+        resource_interval_s: float = 5.0,
+        sample_resources: bool = True,
+        throughput_drop_threshold: float = 0.5,
+    ):
+        self.directory = os.fspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._spans_fh = self._open("spans.jsonl")
+        self._resources_fh = self._open("resources.jsonl")
+        self._events_fh = self._open("events.jsonl")
+        # events.jsonl has MULTIPLE writers (health monitors on the
+        # training thread, stall_report on the watchdog thread);
+        # unlocked writes could interleave into torn lines and lose the
+        # stall evidence the sink exists to preserve.
+        self._events_lock = threading.Lock()
+        self.tracer = SpanTracer(self._spans_fh)
+        self._t0 = time.monotonic()
+        self.event("session_start", **(run_info or {}))
+        self._monitors = [
+            ThroughputMonitor(
+                self._emit_health, drop_threshold=throughput_drop_threshold
+            ),
+            DivergenceMonitor(self._emit_health),
+        ]
+        self.sampler: Optional[ResourceSampler] = None
+        if sample_resources:
+            self.sampler = ResourceSampler(
+                self._resources_fh, interval_s=resource_interval_s
+            ).start()
+
+    def _open(self, name: str) -> IO[str]:
+        return open(os.path.join(self.directory, name), "a", buffering=1)
+
+    def _emit_health(self, kind: str, **fields) -> None:
+        self.event(kind, **fields)
+
+    def event(self, kind: str, **fields) -> None:
+        row = {"ts": round(time.time(), 3), "kind": kind, **fields}
+        try:
+            line = json.dumps(row, allow_nan=False, default=str) + "\n"
+        except (TypeError, ValueError):
+            return  # non-finite / unserializable field; never raise
+        # Bounded acquire, not `with`: the watchdog thread calls this
+        # from the stall path while the training thread may be wedged
+        # INSIDE an events write (hung filesystem — the very stall class
+        # the watchdog escapes). Blocking here would stop the exit-42
+        # escape; dropping the row after 1s cannot.
+        if not self._events_lock.acquire(timeout=1.0):
+            return
+        try:
+            self._events_fh.write(line)
+        except ValueError:
+            pass  # closed mid-shutdown
+        finally:
+            self._events_lock.release()
+
+    def observe(self, it: int, metrics: dict) -> None:
+        now = time.monotonic() - self._t0
+        for m in self._monitors:
+            try:
+                m.observe(it, metrics, now)
+            except Exception:
+                pass  # telemetry must never take the run down
+
+    def close(self) -> None:
+        if self.sampler is not None:
+            self.sampler.stop()
+            self.sampler = None
+        self.event("session_end")
+        for fh in (self._spans_fh, self._resources_fh, self._events_fh):
+            try:
+                fh.close()
+            except Exception:
+                pass
+        if _SESSION is self:
+            set_current(None)
+
+    def __enter__(self) -> "TelemetrySession":
+        set_current(self)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,83 @@
+"""Chrome-trace span emission (`spans.jsonl`).
+
+One JSON object per line, each a valid Chrome Trace Event Format entry
+(the `{"traceEvents": [...]}` wrapper is added by
+`scripts/run_report.py --trace`, or with `jq -s '{traceEvents:.}'`).
+Spans are emitted as complete ("ph":"X") events at EXIT time — children
+close before parents, and the format is order-independent, so nesting
+reconstructs from the ts/dur containment Perfetto renders natively.
+
+Timestamps are microseconds on the `perf_counter` clock, zeroed at
+tracer creation; a clock-sync metadata event records the corresponding
+unix epoch so wall-clock can be recovered.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import IO, Optional
+
+
+class SpanTracer:
+    """Serializes span/instant events to a line-buffered JSONL handle."""
+
+    def __init__(self, fh: IO[str]):
+        self._fh = fh
+        self._lock = threading.Lock()
+        self._pid = os.getpid()
+        self._t0 = time.perf_counter()
+        self._write({
+            "name": "process_name", "ph": "M", "pid": self._pid, "tid": 0,
+            "args": {"name": "train"},
+        })
+        self._write({
+            "name": "clock_sync", "ph": "M", "pid": self._pid, "tid": 0,
+            "args": {"unix_epoch_at_ts0": time.time()},
+        })
+
+    def now_us(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e6
+
+    def _write(self, evt: dict) -> None:
+        line = json.dumps(evt, allow_nan=False)
+        with self._lock:
+            self._fh.write(line + "\n")
+
+    def complete(
+        self, name: str, start_pc: float, dur_s: float,
+        args: Optional[dict] = None,
+    ) -> None:
+        """Emit a ph:"X" complete event; `start_pc` is the span's entry
+        `perf_counter()` reading, `dur_s` its duration in seconds."""
+        evt = {
+            "name": name,
+            "ph": "X",
+            "ts": round((start_pc - self._t0) * 1e6, 1),
+            "dur": round(dur_s * 1e6, 1),
+            "pid": self._pid,
+            "tid": threading.get_ident() & 0x7FFFFFFF,
+            "cat": "phase",
+        }
+        if args:
+            evt["args"] = args
+        self._write(evt)
+
+    def instant(self, name: str, args: Optional[dict] = None) -> None:
+        """Emit a ph:"i" instant event (thread scope) — used to mark
+        phases that exist but have no separable host duration (e.g. the
+        env rollout fused into the XLA update program)."""
+        evt = {
+            "name": name,
+            "ph": "i",
+            "s": "t",
+            "ts": round(self.now_us(), 1),
+            "pid": self._pid,
+            "tid": threading.get_ident() & 0x7FFFFFFF,
+            "cat": "phase",
+        }
+        if args:
+            evt["args"] = args
+        self._write(evt)

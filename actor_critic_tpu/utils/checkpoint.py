@@ -87,11 +87,14 @@ class Checkpointer:
         an empty dict (see `checkpointed_train`).
         """
         m = {k: float(v) for k, v in (metrics or {}).items()}
+        # The item is named `run_metrics` because newer orbax reserves
+        # the bare name `metrics` for its own best-checkpoint tracking
+        # and refuses Composite items using it.
         return self._mgr.save(
             step,
             args=ocp.args.Composite(
                 state=ocp.args.StandardSave(pack_keys(state)),
-                metrics=ocp.args.JsonSave(m),
+                run_metrics=ocp.args.JsonSave(m),
             ),
             force=force,
         )
@@ -131,22 +134,26 @@ class Checkpointer:
             step = self.latest_step()
             if step is None:
                 return {}
-        try:
-            out = self._mgr.restore(
-                step, args=ocp.args.Composite(metrics=ocp.args.JsonRestore())
-            )["metrics"]
-            return dict(out or {})
-        except (FileNotFoundError, KeyError, ValueError) as e:
-            import json
+        for item in ("run_metrics", "metrics"):  # current name, then legacy
+            try:
+                out = self._mgr.restore(
+                    step,
+                    args=ocp.args.Composite(**{item: ocp.args.JsonRestore()}),
+                )[item]
+                return dict(out or {})
+            except (FileNotFoundError, KeyError, ValueError) as e:
+                import json
 
-            if isinstance(e, json.JSONDecodeError):
-                # A truncated/corrupt metrics item is NOT "no metrics" —
-                # surface it.
-                raise
-            # Legitimately absent: checkpoint predates the metrics item
-            # (legacy bare-StandardSave layouts raise ValueError on
-            # Composite args).
-            return {}
+                if isinstance(e, json.JSONDecodeError):
+                    # A truncated/corrupt metrics item is NOT "no
+                    # metrics" — surface it.
+                    raise
+                # Legitimately absent under this name: fall through to
+                # the legacy spelling (checkpoints written before the
+                # orbax reserved-name rename), then to {} (legacy bare-
+                # StandardSave layouts raise ValueError on Composite
+                # args).
+        return {}
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
@@ -221,6 +228,7 @@ def checkpointed_train(
         if (ckpt is not None and done and done >= num_iterations)
         else {}
     )
+    from actor_critic_tpu import telemetry
     from actor_critic_tpu.utils import watchdog
     from actor_critic_tpu.utils.cadence import should_save
 
@@ -234,7 +242,13 @@ def checkpointed_train(
         k = min(k, num_iterations - it)
         watchdog.beat()  # progress heartbeat (utils/watchdog.py)
         t_dispatch = time.monotonic()
-        state, metrics = step_fn(state, k) if stride > 1 else step_fn(state)
+        # The span measures enqueue-to-return, not device wall: a jitted
+        # call returns at dispatch, and fencing here would break the
+        # async pipelining (the first sync lands in the log span).
+        with telemetry.span("update", it=it + k, dispatch="async"):
+            state, metrics = (
+                step_fn(state, k) if stride > 1 else step_fn(state)
+            )
         if stride > 1 and watchdog.armed():
             # A chunk that legitimately outlasts --stall-timeout must not
             # be misread as a stall on the NEXT chunk (one beat per chunk;
@@ -249,13 +263,21 @@ def checkpointed_train(
             jax.block_until_ready(metrics)
             watchdog.ensure_timeout_at_least(3.0 * (time.monotonic() - t_dispatch))
         it += k
-        if ckpt is not None and should_save(it, save_every, num_iterations):
-            # Sync before handing buffers to the async saver: donation
-            # would otherwise let the next step overwrite in-flight reads.
-            jax.block_until_ready(state)
-            ckpt.save(it, state, metrics=metrics, force=True)
+        if should_save(it, save_every, num_iterations):
+            # The span is emitted even with ckpt=None (args record
+            # whether a save actually ran): the checkpoint phase
+            # boundary exists in every trace, so run reports can compare
+            # checkpointed and checkpoint-free runs phase-for-phase.
+            with telemetry.span("checkpoint", step=it, saved=ckpt is not None):
+                if ckpt is not None:
+                    # Sync before handing buffers to the async saver:
+                    # donation would otherwise let the next step
+                    # overwrite in-flight reads.
+                    jax.block_until_ready(state)
+                    ckpt.save(it, state, metrics=metrics, force=True)
         if log_fn is not None:
-            log_fn(it, metrics)
+            with telemetry.span("log", it=it):
+                log_fn(it, metrics)
     if ckpt is not None:
         ckpt.wait()
     return state, metrics

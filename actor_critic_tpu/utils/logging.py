@@ -21,6 +21,8 @@ import os
 import time
 from typing import IO, Optional
 
+from actor_critic_tpu.utils.cadence import finite_or_none
+
 
 class JsonlLogger:
     """Append-only JSONL metrics writer with optional stdout echo."""
@@ -50,8 +52,6 @@ class JsonlLogger:
             "iter": int(iteration),
             "wall_s": round(time.time() - self._t0, 3),
         }
-        from actor_critic_tpu.utils.cadence import finite_or_none
-
         for k, v in {**metrics, **extra}.items():
             try:
                 float(v)
@@ -75,8 +75,12 @@ class JsonlLogger:
 
             with self._tb.as_default():
                 for k, v in row.items():
-                    if isinstance(v, float):
-                        tf.summary.scalar(k, v, step=int(iteration))
+                    # Integer scalars (iter, env_steps, episodes_finished)
+                    # must export too — an isinstance(v, float) gate
+                    # silently dropped them; bool is excluded (it passes
+                    # an int check but isn't a scalar metric).
+                    if isinstance(v, (int, float)) and not isinstance(v, bool):
+                        tf.summary.scalar(k, float(v), step=int(iteration))
 
     def close(self) -> None:
         if self._fh is not None:

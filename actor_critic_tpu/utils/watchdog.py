@@ -117,12 +117,22 @@ class StallWatchdog:
                 and now > self._grace_until
                 and stalled > self.timeout_s
             ):
+                # Telemetry names the phase that was open when progress
+                # stopped (the span stack is maintained even without a
+                # --telemetry-dir session) and, with a session, writes a
+                # durable `stall` event before the hard exit.
+                try:
+                    from actor_critic_tpu import telemetry
+
+                    phase = telemetry.stall_report(stalled)
+                except Exception:
+                    phase = ""
                 print(
                     f"[stall-watchdog] no training progress for "
                     f"{stalled:.0f}s (> {self.timeout_s:.0f}s) — device "
                     "tunnel presumed wedged; exiting "
                     f"{STALL_EXIT_CODE} so a retry loop can --resume "
-                    "from the last checkpoint",
+                    f"from the last checkpoint{phase}",
                     file=sys.stderr,
                     flush=True,
                 )

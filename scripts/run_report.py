@@ -1,0 +1,362 @@
+#!/usr/bin/env python
+"""Render one telemetry directory into a single markdown run report.
+
+Reads the three sinks a `train.py --telemetry-dir DIR` run writes —
+`spans.jsonl` (Chrome-trace phase events), `resources.jsonl` (RSS /
+device memory / XLA recompiles), `events.jsonl` (health + lifecycle
+events) — plus the run's `--metrics` JSONL when present, and prints a
+markdown report with the per-phase time breakdown the ISSUE's freeze
+post-mortems needed (which phase ate the wall clock, whether memory
+crept, which health events fired).
+
+    python scripts/run_report.py /tmp/t
+    python scripts/run_report.py /tmp/t --metrics runs/m.jsonl
+    python scripts/run_report.py /tmp/t --trace          # + trace.json
+
+`--trace` additionally wraps the span lines into `{"traceEvents":
+[...]}` at DIR/trace.json, the file Perfetto (https://ui.perfetto.dev)
+and chrome://tracing open directly; the JSONL itself is one event per
+line so a torn final line (stall-kill teardown) costs one event, not
+the file.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def read_jsonl(path: str) -> list[dict]:
+    """Rows of a JSONL file; a torn final line (process killed
+    mid-write, the exact scenario telemetry exists to explain) is
+    dropped rather than aborting the report."""
+    rows: list[dict] = []
+    if not os.path.exists(path):
+        return rows
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rows.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return rows
+
+
+def _fmt_s(seconds: float) -> str:
+    if seconds >= 60:
+        return f"{seconds / 60:.1f}m"
+    if seconds >= 1:
+        return f"{seconds:.2f}s"
+    return f"{seconds * 1e3:.1f}ms"
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024
+    return f"{n:.1f} GiB"
+
+
+def _self_durations(complete: list[dict]) -> list[tuple[str, float]]:
+    """(name, self_us) per complete event: its duration minus the time
+    covered by spans nested inside it (same process/thread, interval
+    containment). Phases can nest — the fused loop's `eval` runs inside
+    the `log` span — and raw durations would count the inner seconds in
+    BOTH rows (and twice in a summed-phase denominator); self time
+    attributes every host second to exactly one phase."""
+    groups: dict[tuple, list[dict]] = {}
+    for e in complete:
+        groups.setdefault((e.get("pid"), e.get("tid")), []).append(e)
+    out: list[list] = []
+    for evs in groups.values():
+        # Spans are written at EXIT, so file order is end order; sort by
+        # start, parents (longer) before the children they open with.
+        evs.sort(
+            key=lambda e: (float(e.get("ts", 0.0)), -float(e.get("dur", 0.0)))
+        )
+        stack: list[tuple[float, list]] = []  # (end_us, row)
+        for e in evs:
+            ts = float(e.get("ts", 0.0))
+            dur = float(e.get("dur", 0.0))
+            while stack and ts >= stack[-1][0]:
+                stack.pop()
+            row = [e.get("name", "?"), dur]
+            if stack:
+                parent = stack[-1][1]
+                parent[1] = max(0.0, parent[1] - dur)
+            out.append(row)
+            stack.append((ts + dur, row))
+    return [(name, self_us) for name, self_us in out]
+
+
+def phase_breakdown(spans: list[dict]) -> list[str]:
+    """Markdown lines for the per-phase table. `iteration` is the
+    enclosing span (one per loop iteration); every other complete event
+    is a phase nested inside it, so phase %s are of summed iteration
+    wall, the denominator a freeze post-mortem cares about. Phase time
+    is SELF time (nested spans subtracted, see `_self_durations`)."""
+    complete = [e for e in spans if e.get("ph") == "X"]
+    instants = [e for e in spans if e.get("ph") == "i"]
+    if not complete and not instants:
+        return ["*(no span events)*"]
+    iters = [e for e in complete if e.get("name") == "iteration"]
+    iter_total_us = sum(float(e.get("dur", 0.0)) for e in iters)
+    phases: dict[str, dict] = {}
+    for name, self_us in _self_durations(complete):
+        if name == "iteration":
+            continue
+        p = phases.setdefault(name, {"count": 0, "total_us": 0.0, "max_us": 0.0})
+        p["count"] += 1
+        p["total_us"] += self_us
+        p["max_us"] = max(p["max_us"], self_us)
+    denom_us = iter_total_us or sum(p["total_us"] for p in phases.values())
+    out = []
+    if iters:
+        out.append(
+            f"{len(iters)} iteration span(s), "
+            f"{_fmt_s(iter_total_us / 1e6)} total "
+            f"({_fmt_s(iter_total_us / 1e6 / len(iters))}/iter mean); "
+            f"shares are of summed iteration wall."
+        )
+    else:
+        out.append(
+            "No enclosing iteration spans (fused loop); shares are of "
+            "summed phase time."
+        )
+    out.append("")
+    out.append("| phase | count | total | mean | max | share |")
+    out.append("|---|---:|---:|---:|---:|---:|")
+    for name, p in sorted(
+        phases.items(), key=lambda kv: -kv[1]["total_us"]
+    ):
+        pct = 100.0 * p["total_us"] / denom_us if denom_us else 0.0
+        out.append(
+            f"| {name} | {p['count']} | {_fmt_s(p['total_us'] / 1e6)} "
+            f"| {_fmt_s(p['total_us'] / 1e6 / p['count'])} "
+            f"| {_fmt_s(p['max_us'] / 1e6)} | {pct:.1f}% |"
+        )
+    if instants:
+        by_name: dict[str, int] = {}
+        for e in instants:
+            by_name[e.get("name", "?")] = by_name.get(e.get("name", "?"), 0) + 1
+        marks = ", ".join(f"{k} ×{v}" for k, v in sorted(by_name.items()))
+        out.append("")
+        out.append(
+            f"Instant marks (phases fused into the XLA program, no "
+            f"separable host duration): {marks}."
+        )
+    return out
+
+
+def resource_summary(rows: list[dict]) -> list[str]:
+    if not rows:
+        return ["*(no resource samples)*"]
+    out = [f"{len(rows)} samples over {_fmt_s(rows[-1]['ts'] - rows[0]['ts'])}."]
+    rss = [r["rss_bytes"] for r in rows if "rss_bytes" in r]
+    if rss:
+        out.append(
+            f"- **RSS**: start {_fmt_bytes(rss[0])}, end {_fmt_bytes(rss[-1])}, "
+            f"peak {_fmt_bytes(max(rss))} "
+            f"(drift {_fmt_bytes(rss[-1] - rss[0])})"
+        )
+    # Startup compilation is expected; compiles in the LAST HALF of the
+    # samples are the recompile-storm signal (the silent throughput
+    # killer this sampler exists to catch). The counter is per-process
+    # and the files append across resume retries, so a decrease marks a
+    # new process: sum positive deltas, never raw endpoints.
+    rec = [r.get("recompiles", 0) for r in rows]
+
+    def growth(seq):
+        return (seq[0] if seq else 0) + sum(
+            max(0, b - a) for a, b in zip(seq, seq[1:])
+        )
+
+    late = growth(rec[len(rec) // 2:]) - rec[len(rec) // 2]
+    # A handful of mid-run compiles is legitimate (first eval jit, a
+    # chunk re-jit); a storm re-compiles every iteration. Flag only past
+    # the legitimate-singles scale.
+    storm = " — RECOMPILE STORM?" if late >= 10 else ""
+    out.append(
+        f"- **XLA recompiles**: {growth(rec)} total; {late} in the last "
+        f"half of the samples{storm}"
+    )
+    # Per-device peaks across the run (devices without allocator stats,
+    # e.g. CPU, appear with no byte fields and are reported as such).
+    dev_peak: dict[int, dict] = {}
+    for r in rows:
+        for d in r.get("devices", []):
+            cur = dev_peak.setdefault(d["id"], dict(d))
+            for k in ("live_bytes", "peak_bytes"):
+                if k in d:
+                    cur[k] = max(cur.get(k, 0), d[k])
+    for did in sorted(dev_peak):
+        d = dev_peak[did]
+        if "peak_bytes" in d or "live_bytes" in d:
+            out.append(
+                f"- **device {did}** ({d.get('platform', '?')}): "
+                f"peak {_fmt_bytes(d.get('peak_bytes', d.get('live_bytes', 0)))}, "
+                f"max live {_fmt_bytes(d.get('live_bytes', 0))}"
+            )
+        else:
+            out.append(
+                f"- **device {did}** ({d.get('platform', '?')}): "
+                f"no allocator stats on this backend"
+            )
+    return out
+
+
+def event_summary(rows: list[dict]) -> list[str]:
+    lifecycle = {"session_start", "session_end"}
+    health = [r for r in rows if r.get("kind") not in lifecycle]
+    starts = [r for r in rows if r.get("kind") == "session_start"]
+    out = []
+    if starts:
+        # The sinks append across resume retries (run_resumable.sh /
+        # exit-42 loops): each process adds a session_start. Report the
+        # LAST one's config (the live session) and the segment count.
+        info = {k: v for k, v in starts[-1].items() if k not in ("ts", "kind")}
+        if info:
+            out.append("Run: `" + json.dumps(info, default=str) + "`")
+        if len(starts) > 1:
+            out.append(
+                f"{len(starts)} session segments (resumed/retried run)."
+            )
+        if info or len(starts) > 1:
+            out.append("")
+    if not health:
+        out.append("No health events — no throughput regression, no "
+                   "divergence, no stall.")
+        return out
+    out.append("| ts | kind | detail |")
+    out.append("|---|---|---|")
+    t0 = rows[0].get("ts", 0.0) if rows else 0.0
+    for r in health:
+        detail = {k: v for k, v in r.items() if k not in ("ts", "kind")}
+        out.append(
+            f"| +{_fmt_s(r.get('ts', t0) - t0)} | **{r.get('kind')}** "
+            f"| `{json.dumps(detail, default=str)}` |"
+        )
+    return out
+
+
+def metrics_summary(rows: list[dict]) -> list[str]:
+    if not rows:
+        return ["*(no metrics rows)*"]
+    last = rows[-1]
+    out = [
+        f"{len(rows)} logged rows; final iter {last.get('iter')}, "
+        f"env_steps {last.get('env_steps', 'n/a')}, "
+        f"wall {_fmt_s(float(last.get('wall_s', 0.0)))}."
+    ]
+    for key in ("recent_return", "avg_return_ema", "loss"):
+        if isinstance(last.get(key), (int, float)):
+            out.append(f"- final `{key}`: {last[key]:.4g}")
+    evals = [r for r in rows if isinstance(r.get("eval_return"), (int, float))]
+    if evals:
+        best = max(evals, key=lambda r: r["eval_return"])
+        out.append(
+            f"- eval: best {best['eval_return']:.1f} @ iter {best.get('iter')}, "
+            f"final {evals[-1]['eval_return']:.1f} ({len(evals)} evals)"
+        )
+    return out
+
+
+def write_trace(spans: list[dict], path: str) -> None:
+    """Wrap span lines into the `{"traceEvents": [...]}` container.
+
+    Span `ts` is zeroed at each process's tracer creation, and the file
+    appends across resume retries — rendering segments unadjusted would
+    overlap them all at t=0. Each segment's `clock_sync` metadata event
+    carries the unix epoch of its ts=0, so later segments are shifted
+    onto the first segment's clock and Perfetto shows retries end to
+    end (restore/compile gaps included)."""
+    out = []
+    base_epoch = None
+    offset_us = 0.0
+    for e in spans:
+        if e.get("ph") == "M" and e.get("name") == "clock_sync":
+            epoch = (e.get("args") or {}).get("unix_epoch_at_ts0")
+            if epoch is not None:
+                if base_epoch is None:
+                    base_epoch = epoch
+                offset_us = (epoch - base_epoch) * 1e6
+        if offset_us and "ts" in e:
+            e = dict(e, ts=e["ts"] + offset_us)
+        out.append(e)
+    with open(path, "w") as f:
+        json.dump({"traceEvents": out}, f)
+
+
+def render(
+    telemetry_dir: str,
+    metrics_path: str | None = None,
+    spans: list[dict] | None = None,
+) -> str:
+    if spans is None:
+        spans = read_jsonl(os.path.join(telemetry_dir, "spans.jsonl"))
+    resources = read_jsonl(os.path.join(telemetry_dir, "resources.jsonl"))
+    events = read_jsonl(os.path.join(telemetry_dir, "events.jsonl"))
+    lines = [f"# Run report — `{telemetry_dir}`", ""]
+    lines += ["## Events & health", ""] + event_summary(events) + [""]
+    lines += ["## Phase breakdown", ""] + phase_breakdown(spans) + [""]
+    lines += ["## Resources", ""] + resource_summary(resources) + [""]
+    if metrics_path is None:
+        cand = os.path.join(telemetry_dir, "metrics.jsonl")
+        metrics_path = cand if os.path.exists(cand) else None
+    if metrics_path:
+        lines += (
+            [f"## Metrics (`{metrics_path}`)", ""]
+            + metrics_summary(read_jsonl(metrics_path))
+            + [""]
+        )
+    lines.append(
+        "*Open the trace in Perfetto: `python scripts/run_report.py "
+        f"{telemetry_dir} --trace` then load `trace.json` at "
+        "https://ui.perfetto.dev.*"
+    )
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("telemetry_dir", help="directory a --telemetry-dir run wrote")
+    p.add_argument(
+        "--metrics",
+        help="metrics JSONL of the same run (default: "
+        "TELEMETRY_DIR/metrics.jsonl when present)",
+    )
+    p.add_argument(
+        "--trace", action="store_true",
+        help="also write TELEMETRY_DIR/trace.json ({traceEvents: [...]}) "
+        "for Perfetto / chrome://tracing",
+    )
+    p.add_argument("-o", "--output", help="write the markdown here instead of stdout")
+    args = p.parse_args(argv)
+    if not os.path.isdir(args.telemetry_dir):
+        print(f"not a directory: {args.telemetry_dir}", file=sys.stderr)
+        return 2
+    spans = None
+    if args.trace:
+        # Parse once; a long run's spans.jsonl is the report's dominant
+        # I/O, so the rows are shared with render().
+        spans = read_jsonl(os.path.join(args.telemetry_dir, "spans.jsonl"))
+        out = os.path.join(args.telemetry_dir, "trace.json")
+        write_trace(spans, out)
+        print(f"wrote {out} ({len(spans)} events)", file=sys.stderr)
+    report = render(args.telemetry_dir, args.metrics, spans=spans)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(report)
+    else:
+        print(report, end="")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

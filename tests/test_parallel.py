@@ -37,7 +37,7 @@ def test_sharded_grad_equals_full_batch_grad():
     MirroredStrategy/NCCL-equivalence property, SURVEY §2.4)."""
     from jax.sharding import PartitionSpec as P
 
-    shard_map = jax.shard_map
+    from actor_critic_tpu.parallel.mesh import shard_map
 
     env = make_two_state_mdp()
     cfg = a2c.A2CConfig(num_envs=8, rollout_steps=4, hidden=(16,))

@@ -83,7 +83,7 @@ def test_ppo_a2c_pixel_networks_use_cnn():
         params = net.init(jax.random.key(0), obs)
         assert any(
             "conv" in "/".join(str(p.key) for p in path)
-            for path, _ in jax.tree.flatten_with_path(params)[0]
+            for path, _ in jax.tree_util.tree_flatten_with_path(params)[0]
         ), "pixel obs did not route through the CNN torso"
         dist, value = net.apply(params, obs)
         assert value.shape == (2,)

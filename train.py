@@ -24,6 +24,8 @@ import json
 import sys
 import time
 
+from actor_critic_tpu import telemetry
+
 
 def build_env(spec: str, algo: str, cfg, seed: int, scale_actions=None,
               env_kwargs=None):
@@ -305,22 +307,42 @@ def run_fused(env, preset, args, logger) -> dict:
         if eval_fn is not None and (
             it % args.eval_every == 0 or it == args.iterations
         ):
-            extra["eval_return"] = float(eval_fn(state_box[0], eval_key))
+            with telemetry.span("eval", it=it):
+                extra["eval_return"] = float(eval_fn(state_box[0], eval_key))
             do_log = True
         if do_log:
-            logger.log(it, {**metrics, **extra}, env_steps=it * spi)
+            # Health monitors see the materialized row — AFTER the eval
+            # merge (so eval_return reaches the divergence detector) and
+            # only on the log cadence: the float() coercions are the
+            # loop's first device sync, and syncing every dispatch would
+            # serialize host on device, the pipelining this loop exists
+            # to preserve. Non-floatable values stringify, same tolerance
+            # as JsonlLogger.log.
+            row = {}
+            for k, v in metrics.items():
+                try:
+                    row[k] = float(v)
+                except (TypeError, ValueError):
+                    row[k] = str(v)
+            row.update(extra, env_steps=it * spi)
+            telemetry.observe(it, row)
+            logger.log(it, row)
 
     # log_fn needs the CURRENT state for eval; checkpointed_train owns the
     # loop, so expose it via a one-cell box updated by a wrapped step.
     state_box = [state]
 
     def step_tracking(s, *k):
+        # jax:* envs fuse the rollout INTO the update program, so the
+        # env_step phase has no separable host duration — record it as a
+        # Chrome-trace instant so traces still carry the phase.
+        telemetry.instant("env_step", fused=True)
         out, m = step(s, *k)
         state_box[0] = out
         return out, m
 
     state, metrics = checkpointed_train(
-        step_tracking if eval_fn is not None else step, state, args.iterations,
+        step_tracking, state, args.iterations,
         ckpt=ckpt, save_every=args.save_every, log_fn=log_fn,
         resume=args.resume, stride=chunk,
     )
@@ -336,6 +358,7 @@ def run_host(pool, preset, args, logger) -> dict:
     last: dict = {}
 
     def log_fn(it, m):
+        telemetry.observe(it, m)
         last.clear()
         last.update(m)
         logger.log(it, m)
@@ -401,6 +424,16 @@ def main(argv=None) -> int:
         "env_kwargs",
     )
     p.add_argument("--metrics", default="metrics.jsonl", help="JSONL output path")
+    p.add_argument(
+        "--telemetry-dir",
+        help="unified run telemetry: write spans.jsonl (Chrome-trace "
+        "phase events; render with scripts/run_report.py --trace or open "
+        "in Perfetto), resources.jsonl (RSS / device memory / XLA "
+        "recompiles), and events.jsonl (health + lifecycle events) under "
+        "this directory. Phase instrumentation is always on and "
+        "near-free; this flag only adds the file sinks + the resource "
+        "sampler thread.",
+    )
     p.add_argument("--log-every", type=int, default=10)
     p.add_argument(
         "--chunk", type=int, default=1,
@@ -495,6 +528,20 @@ def main(argv=None) -> int:
         env_kwargs=preset.env_kwargs,
     )
 
+    telemetry_session = None
+    if args.telemetry_dir:
+        telemetry_session = telemetry.TelemetrySession(
+            args.telemetry_dir,
+            run_info={
+                "algo": preset.algo,
+                "env": preset.env,
+                "iterations": args.iterations,
+                "seed": args.seed,
+                "config": dataclasses.asdict(preset.config),
+            },
+        )
+        telemetry.set_current(telemetry_session)
+
     watchdog = None
     if args.stall_timeout > 0:
         from actor_critic_tpu.utils.watchdog import StallWatchdog
@@ -522,6 +569,8 @@ def main(argv=None) -> int:
     finally:
         if watchdog is not None:
             watchdog.stop()
+        if telemetry_session is not None:
+            telemetry_session.close()
     wall = time.time() - t0
     print(
         json.dumps(
