@@ -391,8 +391,14 @@ def make_train_step(
         rbuf = replay.add_batch(ls.replay, flat)
 
         # --- J gradient updates (gated until warmup + one batch in ring) ---
+        # The floor is max(batch_size, nstep): sample_sequences clamps a
+        # length-n window's start so the window fits inside [0, size), and
+        # a ring holding fewer than n inserts would clamp windows into
+        # zero-initialized slots — the first updates would train on
+        # fabricated transitions.
         do_update = jnp.logical_and(
-            env_steps >= cfg.warmup_steps, rbuf.size >= cfg.batch_size
+            env_steps >= cfg.warmup_steps,
+            rbuf.size >= max(cfg.batch_size, cfg.nstep),
         )
         ls, metrics = update_loop(
             ls._replace(replay=rbuf, key=key), do_update
@@ -459,8 +465,11 @@ def make_host_ingest_update(action_dim: int, cfg: DDPGConfig):
     def ingest_update(ls: LearnerState, traj: OffPolicyTransition, env_steps):
         flat = jax.tree.map(lambda x: x.reshape(-1, *x.shape[2:]), traj)
         rbuf = replay.add_batch(ls.replay, flat)
+        # Same max(batch_size, nstep) floor as the fused path: n-step
+        # windows must never clamp into zero-initialized ring slots.
         do_update = jnp.logical_and(
-            env_steps >= cfg.warmup_steps, rbuf.size >= cfg.batch_size
+            env_steps >= cfg.warmup_steps,
+            rbuf.size >= max(cfg.batch_size, cfg.nstep),
         )
         return update_loop(ls._replace(replay=rbuf), do_update)
 

@@ -10,6 +10,7 @@ don't each carry a diverging copy.
 
 from __future__ import annotations
 
+import time
 import warnings
 from typing import Callable, Optional
 
@@ -42,47 +43,131 @@ class EpisodeTracker:
         }
 
 
+class BlockBuffers:
+    """Preallocated, double-buffered time-major [K, E, ...] block storage.
+
+    The old collect path appended per-step arrays to Python lists and
+    `np.stack`ed them into a fresh block every iteration — one full-block
+    allocation + copy per iteration, forever. BlockBuffers instead writes
+    each step straight into preallocated [K, E, ...] arrays (allocated
+    lazily from the first recorded value's shape/dtype, then reused).
+
+    DOUBLE buffering is the correctness half: `begin_block()` flips
+    between two buffer sets, so the arrays handed to the device transfer
+    for block N stay untouched while block N+1 is collected into the
+    other set. That lets the (async-dispatched) host→device transfer and
+    jitted update of block N overlap collection of block N+1 — the
+    transfer-stage extension of the `overlap=True` stale-params
+    machinery; a block's buffers are only rewritten two `begin_block()`s
+    later, after its update has long been consumed.
+    """
+
+    def __init__(self, num_steps: int):
+        if num_steps < 1:
+            raise ValueError(f"num_steps must be >= 1, got {num_steps}")
+        self.num_steps = int(num_steps)
+        self._bufs: tuple[dict, dict] = ({}, {})
+        self._active = 0
+        self._seen: set[str] = set()
+
+    def begin_block(self) -> None:
+        """Flip to the other buffer set; its previous contents (block
+        N-2) are dead by contract."""
+        self._active ^= 1
+        self._seen = set()
+
+    def record(self, t: int, name: str, value) -> None:
+        value = np.asarray(value)
+        buf = self._bufs[self._active]
+        arr = buf.get(name)
+        if (
+            arr is None
+            or arr.shape[1:] != value.shape
+            or arr.dtype != value.dtype
+        ):
+            arr = np.empty((self.num_steps, *value.shape), value.dtype)
+            buf[name] = arr
+        arr[t] = value  # copies into the preallocated slot
+        self._seen.add(name)
+
+    def block(self) -> dict[str, np.ndarray]:
+        """The CURRENT block's arrays: only keys recorded since
+        `begin_block()` — a key an earlier block recorded but this one
+        didn't must not leak two-block-stale data into the update."""
+        buf = self._bufs[self._active]
+        return {k: buf[k] for k in buf if k in self._seen}
+
+
 def host_collect(
     pool,
     obs: np.ndarray,
     num_steps: int,
     act_fn: Callable[[np.ndarray], tuple[np.ndarray, dict[str, np.ndarray]]],
     tracker: EpisodeTracker,
+    buffers: Optional[BlockBuffers] = None,
 ) -> tuple[np.ndarray, dict[str, np.ndarray]]:
-    """Step the pool `num_steps` times; return (last obs, stacked block).
+    """Step the pool `num_steps` times; return (last obs, [K, E] block).
 
     `act_fn(obs) -> (action, extras)`; extras (e.g. log_prob/value for
     on-policy) are recorded alongside the standard fields. The block's
-    arrays are time-major [K, E, ...] float/int numpy — exactly one
-    device transfer's worth.
+    arrays are time-major [K, E, ...] numpy — exactly one device
+    transfer's worth, written into `buffers` (a loop-lived BlockBuffers;
+    the trainers pass one so blocks reuse preallocated double-buffered
+    storage). With `buffers=None` a private BlockBuffers is allocated
+    per call — correct, just without the reuse.
     """
-    block: dict[str, list[np.ndarray]] = {}
-
-    def record(name: str, value: np.ndarray) -> None:
-        block.setdefault(name, []).append(value)
+    if buffers is None:
+        buffers = BlockBuffers(num_steps)
+    elif buffers.num_steps != num_steps:
+        raise ValueError(
+            f"buffers hold {buffers.num_steps}-step blocks, collect asked "
+            f"for {num_steps}"
+        )
+    buffers.begin_block()
+    record = buffers.record
 
     from actor_critic_tpu.utils import watchdog
+
+    # Per-worker spans come from the sharded pool's busy counters —
+    # block-level deltas, only while a telemetry session is installed.
+    busy0 = None
+    if telemetry.current() is not None:
+        busy_fn = getattr(pool, "worker_busy_s", None)
+        busy0 = busy_fn() if busy_fn is not None else None
+    t_block = time.perf_counter()
 
     # One span per collection block, not per pool step: a MuJoCo run
     # takes millions of env steps, and the per-phase breakdown needs the
     # block total, not 10^6 micro-events.
     with telemetry.span("env_step", steps=num_steps):
-        for _ in range(num_steps):
+        for t in range(num_steps):
             watchdog.beat()  # progress heartbeat (utils/watchdog.py)
             action, extras = act_fn(obs)
             out = pool.step(action)
-            record("obs", obs)
-            record("action", action)
+            record(t, "obs", obs)
+            record(t, "action", action)
             for k, v in extras.items():
-                record(k, v)
-            record("reward", out.reward)
-            record("done", out.done)
-            record("terminated", out.terminated)
-            record("final_obs", out.final_obs)
+                record(t, k, v)
+            record(t, "reward", out.reward)
+            record(t, "done", out.done)
+            record(t, "terminated", out.terminated)
+            record(t, "final_obs", out.final_obs)
             tracker.update(out.raw_reward, out.done)
             obs = out.obs
 
-    return obs, {k: np.stack(v) for k, v in block.items()}
+    if busy0 is not None:
+        # One "env_step_worker" span per pool worker per block: its
+        # duration is that worker's simulator busy time within the block,
+        # so the trace shows load imbalance next to the block total.
+        busy1 = pool.worker_busy_s()
+        if busy1 is not None:
+            for w, d in enumerate(np.asarray(busy1) - np.asarray(busy0)):
+                telemetry.complete_span(
+                    "env_step_worker", t_block, float(d),
+                    worker=w, steps=num_steps,
+                )
+
+    return obs, buffers.block()
 
 
 def host_evaluate(
@@ -363,6 +448,9 @@ def off_policy_train_host(
     tracker = EpisodeTracker(E)
     history: list = []
     metrics: dict = {}
+    # Loop-lived double-buffered block storage: the transfer/update of
+    # block N reads buffers the collection of block N+1 cannot touch.
+    buffers = BlockBuffers(cfg.steps_per_iter)
 
     host_act = host_params = None
     if overlap and make_host_explore is not None:
@@ -401,7 +489,8 @@ def off_policy_train_host(
                     return action, {}
 
             obs, block = host_collect(
-                pool, obs, cfg.steps_per_iter, explore_act, tracker
+                pool, obs, cfg.steps_per_iter, explore_act, tracker,
+                buffers=buffers,
             )
             with telemetry.span("host_to_device"):
                 traj = OffPolicyTransition(

@@ -399,6 +399,7 @@ def train_host(
     import numpy as np
 
     from actor_critic_tpu.algos.host_loop import (
+        BlockBuffers,
         EpisodeTracker,
         host_ckpt_state,
         host_collect,
@@ -440,6 +441,10 @@ def train_host(
     obs = pool.reset()
     tracker = EpisodeTracker(pool.num_envs)
     history: list = []
+    # Double-buffered [T, E] block storage shared across iterations: the
+    # async-dispatched transfer/update of block N overlaps collection of
+    # block N+1 into the other buffer (host_loop.BlockBuffers).
+    buffers = BlockBuffers(cfg.rollout_steps)
 
     host_policy = host_params = host_value = None
     if overlap:
@@ -473,7 +478,8 @@ def train_host(
                     }
 
             obs, block = host_collect(
-                pool, obs, cfg.rollout_steps, policy_act, tracker
+                pool, obs, cfg.rollout_steps, policy_act, tracker,
+                buffers=buffers,
             )
             key, ukey = jax.random.split(key)
             with telemetry.span("host_to_device"):

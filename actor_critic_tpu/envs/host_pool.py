@@ -94,6 +94,13 @@ class HostEnvPool:
     never taken; scaling removes the mismatch and restores full actuator
     authority). Off by default: recorded runs used clip semantics, and
     the flag must never change under a resumed process.
+
+    `workers=W > 1` shards the gym backend's E envs across W worker
+    processes (envs/shard_pool.py): shared-memory step exchange, global
+    per-env seeding, SAME_STEP autoreset per shard — trajectories AND
+    normalization statistics identical to `workers=1` at fixed seeds,
+    but slow simulator steps overlap across workers. `workers=1`
+    (default) is the in-process SyncVectorEnv, unchanged.
     """
 
     def __init__(
@@ -110,6 +117,7 @@ class HostEnvPool:
         pixel_preprocess: bool = False,
         scale_actions: bool = False,
         env_kwargs: dict | None = None,
+        workers: int = 1,
     ):
         self.env_id = env_id
         self.num_envs = num_envs
@@ -120,6 +128,14 @@ class HostEnvPool:
             raise ValueError(
                 "env_kwargs go to gym.make; the native engine takes none"
             )
+        if workers < 1:
+            raise ValueError(f"workers must be >= 1, got {workers}")
+        if workers > 1 and backend != "gym":
+            raise ValueError(
+                "workers applies to the gym backend only (the native "
+                "engine already steps the whole batch in one C call)"
+            )
+        self._workers = int(workers)
         if backend == "native":
             # First-party C++ batched engine: one C call per batch step
             # (envs/native_pool.py; native/vecenv.cpp).
@@ -127,39 +143,57 @@ class HostEnvPool:
 
             self._envs = NativeVecEnv(env_id, num_envs)
         elif backend == "gym":
-            import gymnasium as gym
-            from gymnasium.vector import AutoresetMode, SyncVectorEnv
+            if self._workers > 1:
+                # Sharded multi-process pool (envs/shard_pool.py): same
+                # env factory, same SAME_STEP semantics per shard, global
+                # per-env seeding — trajectories match the workers=1 path
+                # bit-for-bit at fixed seeds (tests/test_shard_pool.py).
+                from actor_critic_tpu.envs.shard_pool import ShardedVecEnv
 
-            def make_one():
-                e = gym.make(env_id, **env_kwargs)
-                if pixel_preprocess:
-                    from actor_critic_tpu.envs.pixel_wrappers import PixelPreprocess
+                self._envs = ShardedVecEnv(
+                    env_id, num_envs, workers=self._workers,
+                    env_kwargs=env_kwargs,
+                    pixel_preprocess=pixel_preprocess,
+                )
+            else:
+                from gymnasium.vector import AutoresetMode, SyncVectorEnv
 
-                    e = PixelPreprocess(e)
-                return e
+                from actor_critic_tpu.envs.shard_pool import make_host_env
 
-            self._envs = SyncVectorEnv(
-                [make_one for _ in range(num_envs)],
-                autoreset_mode=AutoresetMode.SAME_STEP,
-            )
+                self._envs = SyncVectorEnv(
+                    [
+                        (lambda: make_host_env(
+                            env_id, env_kwargs, pixel_preprocess
+                        ))
+                        for _ in range(num_envs)
+                    ],
+                    autoreset_mode=AutoresetMode.SAME_STEP,
+                )
         else:
             raise ValueError(f"backend must be 'gym' or 'native', got {backend!r}")
-        space = self._envs.single_action_space
-        obs_space = self._envs.single_observation_space
-        self._discrete = hasattr(space, "n")
-        if self._discrete:
-            action_dim = int(space.n)
-            self._act_low = self._act_high = None
-        else:
-            action_dim = int(np.prod(space.shape))
-            self._act_low = np.asarray(space.low, np.float32)
-            self._act_high = np.asarray(space.high, np.float32)
-        if scale_actions and not scalable_bounds(
-            self._discrete, self._act_low, self._act_high
-        ):
-            raise ValueError(
-                "scale_actions needs a finite continuous action Box"
-            )
+        try:
+            space = self._envs.single_action_space
+            obs_space = self._envs.single_observation_space
+            self._discrete = hasattr(space, "n")
+            if self._discrete:
+                action_dim = int(space.n)
+                self._act_low = self._act_high = None
+            else:
+                action_dim = int(np.prod(space.shape))
+                self._act_low = np.asarray(space.low, np.float32)
+                self._act_high = np.asarray(space.high, np.float32)
+            if scale_actions and not scalable_bounds(
+                self._discrete, self._act_low, self._act_high
+            ):
+                raise ValueError(
+                    "scale_actions needs a finite continuous action Box"
+                )
+        except Exception:
+            # The backend is already live (sharded pools hold worker
+            # PROCESSES and a registered sampler gauge) — a validation
+            # failure must tear it down, not leak it.
+            self._envs.close()
+            raise
         self._scale_actions = scale_actions
         if scale_actions:
             self._act_mid = 0.5 * (self._act_high + self._act_low)
@@ -216,6 +250,8 @@ class HostEnvPool:
             backend=self._backend, pixel_preprocess=self._pixel_preprocess,
             scale_actions=self._scale_actions,
             env_kwargs=self._env_kwargs,
+            # Eval pools inherit the sharding (capped by their smaller E).
+            workers=min(self._workers, num_envs),
         )
         pool.obs_rms = self.obs_rms  # aliased on purpose; frozen below
         pool._frozen_stats = True
@@ -268,15 +304,19 @@ class HostEnvPool:
         trunc = np.asarray(trunc)
         done = (term | trunc).astype(np.float32)
 
-        final_obs = np.asarray(obs).copy()  # dtype-preserving (uint8 pixels)
-        if "final_obs" in info:
-            fos = info["final_obs"]
-            if isinstance(fos, np.ndarray) and fos.dtype != object:
-                # Native engine: full [E, ...] numeric array, already
-                # correct for non-done envs — vectorized, no per-env loop.
-                # (gymnasium uses an object array of Optional rows instead.)
-                final_obs = fos.astype(np.float32, copy=False)
-            else:
+        raw_obs = np.asarray(obs)
+        fos = info.get("final_obs")
+        if isinstance(fos, np.ndarray) and fos.dtype != object:
+            # Native engine and the sharded pool: full [E, ...] numeric
+            # array, already correct for non-done envs — no obs copy, no
+            # per-env loop. Dtype-preserving (astype to the env's obs
+            # dtype): uint8 pixel final_obs must stay uint8 here.
+            final_obs = fos.astype(raw_obs.dtype, copy=False)
+        else:
+            # gymnasium object array of Optional rows (or no done envs):
+            # start from a dtype-preserving obs copy, patch done rows.
+            final_obs = raw_obs.copy()
+            if fos is not None:
                 for i, fo in enumerate(fos):
                     if fo is not None:
                         final_obs[i] = fo
@@ -298,6 +338,19 @@ class HostEnvPool:
             terminated=term.astype(np.float32),
             final_obs=nfinal,
         )
+
+    # -- telemetry ---------------------------------------------------------
+    def worker_busy_s(self) -> Optional[np.ndarray]:
+        """Cumulative per-worker busy seconds when the backend is the
+        sharded multi-process pool, else None (host_collect uses deltas
+        of this for per-worker block spans)."""
+        fn = getattr(self._envs, "worker_busy_s", None)
+        return None if fn is None else fn()
+
+    def worker_stats(self) -> Optional[list[dict]]:
+        """Per-worker step accounting (sharded backend only)."""
+        fn = getattr(self._envs, "worker_stats", None)
+        return None if fn is None else fn()
 
     # -- checkpointable state --------------------------------------------
     def get_state(self) -> dict[str, Any]:

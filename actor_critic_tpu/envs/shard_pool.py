@@ -1,0 +1,387 @@
+"""Sharded multi-process host env pool (ISSUE 2 tentpole).
+
+`HostEnvPool`'s gym backend steps E envs serially inside one
+SyncVectorEnv, so a single slow simulator step stalls the whole batch
+and a pool step costs E × per-env wall time (the host bound of SURVEY
+§7.0/§7.2). `ShardedVecEnv` shards the E envs across W worker
+processes — the GA3C / Accelerated-Methods batched-simulation design
+(PAPERS.md 1611.06256, 1803.02811) — each worker holding its own
+`gym.make` stack inside a per-shard SyncVectorEnv with SAME_STEP
+autoreset, so step/reset/final_obs semantics are exactly the
+single-process pool's. Per-step data moves through preallocated
+shared-memory blocks:
+
+    parent:   actions → shm, broadcast "step"        (one send per worker)
+    worker w: SyncVectorEnv.step(act[lo:hi]) → obs / reward / terminated /
+              truncated / final_obs slices written into shm[lo:hi]
+    parent:   barrier (one ack per worker) → batched step output
+
+One broadcast + one barrier per batch step; observations never pass
+through pickle. Seeding is per-shard deterministic over GLOBAL env
+indices: worker w seeds its SyncVectorEnv with [seed+lo .. seed+hi-1],
+exactly the list one big SyncVectorEnv.reset(seed) derives, so a
+sharded pool reproduces the single-process pool's trajectories
+bit-for-bit at fixed seeds (tests/test_shard_pool.py).
+
+Workers are SPAWNED, not forked: the parent has jax (and possibly the
+axon TPU plugin) initialized, and forking a process with live XLA
+threads can wedge the child. Spawn re-runs this container's axon site
+hook, so the parent exports the disarm pair (JAX_PLATFORMS=cpu plus
+empty PALLAS_AXON_POOL_IPS — the same pair as
+`__graft_entry__.disarm_axon`, inlined here because the package cannot
+import the repo-root entry module) around the spawns; workers never
+touch a device.
+
+Spawn's standard caveat applies: the constructing script must be
+import-safe (pool construction behind `if __name__ == "__main__"` or
+inside a function) — train.py and pytest both are.
+
+Failure contract: a worker crash (env exception or process death)
+surfaces as a RuntimeError from the pending barrier — never a hang.
+Telemetry: per-worker busy seconds accumulate in a shared stats block
+(`worker_busy_s()` feeds host_collect's per-worker block spans) and a
+pool-utilization gauge registers with the 5s resource sampler
+(telemetry/sampler.py `register_gauge`).
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+
+def make_host_env(env_id: str, env_kwargs: dict, pixel_preprocess: bool):
+    """One gym env exactly as HostEnvPool's gym backend builds it (shared
+    by the in-process SyncVectorEnv, the sharded workers, and the parent's
+    space probe, so all three see identical spaces/wrappers)."""
+    import gymnasium as gym
+
+    e = gym.make(env_id, **env_kwargs)
+    if pixel_preprocess:
+        from actor_critic_tpu.envs.pixel_wrappers import PixelPreprocess
+
+        e = PixelPreprocess(e)
+    return e
+
+
+def shard_bounds(num_envs: int, workers: int) -> list[tuple[int, int]]:
+    """[lo, hi) global env-index range per worker; remainders go to the
+    first shards so sizes differ by at most one."""
+    base, extra = divmod(num_envs, workers)
+    bounds, lo = [], 0
+    for w in range(workers):
+        hi = lo + base + (1 if w < extra else 0)
+        bounds.append((lo, hi))
+        lo = hi
+    return bounds
+
+
+def _shared_raw(ctx, dtype: np.dtype, shape: tuple[int, ...]):
+    """Anonymous shared-memory block sized for (dtype, shape). RawArray
+    (not named shared_memory): inheritable through Process args under
+    spawn with no name-registry cleanup to leak."""
+    n = max(int(np.prod(shape)), 1) * np.dtype(dtype).itemsize
+    return ctx.RawArray("b", n)
+
+
+def _np_view(raw, dtype: np.dtype, shape: tuple[int, ...]) -> np.ndarray:
+    return np.frombuffer(raw, dtype=dtype).reshape(shape)
+
+
+def _worker_main(
+    conn, wid, env_id, env_kwargs, pixel_preprocess, lo, hi, raw, specs
+):
+    """Worker loop: own gym stack, commands in, shm slices out. Any
+    exception is sent back as ("error", traceback) — the parent raises it
+    at the barrier, so a crash is an error, not a hang."""
+    import traceback
+
+    try:
+        from gymnasium.vector import AutoresetMode, SyncVectorEnv
+
+        views = {k: _np_view(raw[k], *specs[k]) for k in raw}
+        n = hi - lo
+        envs = SyncVectorEnv(
+            [
+                (lambda: make_host_env(env_id, env_kwargs, pixel_preprocess))
+                for _ in range(n)
+            ],
+            autoreset_mode=AutoresetMode.SAME_STEP,
+        )
+        stats = views["stats"]
+        while True:
+            cmd, payload = conn.recv()
+            if cmd == "reset":
+                obs, _ = envs.reset(seed=payload)
+                views["obs"][lo:hi] = obs
+                conn.send(("ok", None))
+            elif cmd == "step":
+                t0 = time.perf_counter()
+                obs, rew, term, trunc, info = envs.step(
+                    np.array(views["act"][lo:hi])
+                )
+                views["obs"][lo:hi] = obs
+                views["reward"][lo:hi] = rew
+                views["terminated"][lo:hi] = term
+                views["truncated"][lo:hi] = trunc
+                # Full numeric final_obs slice (pre-reset rows where done,
+                # == obs elsewhere) — same contract as the native engine,
+                # so the parent never unpacks gymnasium's object array.
+                final = views["final_obs"]
+                final[lo:hi] = obs
+                fos = info.get("final_obs")
+                if fos is not None:
+                    for j, fo in enumerate(fos):
+                        if fo is not None:
+                            final[lo + j] = fo
+                dt = time.perf_counter() - t0
+                stats[wid, 0] += dt       # cumulative busy seconds
+                stats[wid, 1] += n        # cumulative env steps
+                stats[wid, 2] = dt        # last batch-step wall
+                conn.send(("ok", None))
+            elif cmd == "close":
+                envs.close()
+                conn.send(("ok", None))
+                return
+    except (EOFError, KeyboardInterrupt):
+        return  # parent went away; daemon worker just exits
+    except Exception:
+        try:
+            conn.send(("error", traceback.format_exc()))
+        except Exception:
+            pass
+
+
+class ShardedVecEnv:
+    """E gym envs sharded over W spawned workers behind the SyncVectorEnv
+    surface HostEnvPool consumes (`single_*_space`, `reset(seed=...)`,
+    `step(actions) -> (obs, reward, term, trunc, info)`, `close()`).
+
+    `info["final_obs"]` is a full [E, ...] numeric array in the env's
+    native obs dtype (the native-engine convention), already correct for
+    non-done rows.
+    """
+
+    def __init__(
+        self,
+        env_id: str,
+        num_envs: int,
+        workers: int,
+        env_kwargs: Optional[dict] = None,
+        pixel_preprocess: bool = False,
+        step_timeout_s: float = 300.0,
+    ):
+        if workers < 1:
+            raise ValueError(f"workers must be >= 1, got {workers}")
+        if workers > num_envs:
+            raise ValueError(
+                f"workers={workers} exceeds num_envs={num_envs}; an empty "
+                "shard would idle a whole process"
+            )
+        self.num_envs = E = int(num_envs)
+        self.num_workers = W = int(workers)
+        env_kwargs = dict(env_kwargs or {})
+        self._step_timeout_s = float(step_timeout_s)
+
+        # Probe one env in-process for the spaces (wrappers included).
+        probe = make_host_env(env_id, env_kwargs, pixel_preprocess)
+        self.single_observation_space = probe.observation_space
+        self.single_action_space = probe.action_space
+        probe.close()
+        obs_space = self.single_observation_space
+        obs_dtype = np.dtype(obs_space.dtype)
+        if hasattr(self.single_action_space, "n"):
+            act_spec = (np.dtype(np.int64), (E,))
+        else:
+            # HostEnvPool delivers clipped/scaled float32 Box actions.
+            act_spec = (np.dtype(np.float32), (E, *self.single_action_space.shape))
+        specs: dict[str, tuple[np.dtype, tuple[int, ...]]] = {
+            "act": act_spec,
+            "obs": (obs_dtype, (E, *obs_space.shape)),
+            "final_obs": (obs_dtype, (E, *obs_space.shape)),
+            "reward": (np.dtype(np.float64), (E,)),
+            "terminated": (np.dtype(np.bool_), (E,)),
+            "truncated": (np.dtype(np.bool_), (E,)),
+            "stats": (np.dtype(np.float64), (W, 3)),
+        }
+        ctx = mp.get_context("spawn")
+        raw = {k: _shared_raw(ctx, dt, shp) for k, (dt, shp) in specs.items()}
+        self._views = {k: _np_view(raw[k], *specs[k]) for k in specs}
+        self._bounds = shard_bounds(E, W)
+        self._conns: list[Any] = []
+        self._procs: list[Any] = []
+        # Spawned children re-run the axon site hook at interpreter start;
+        # export the disarm pair for the spawn window so a worker can never
+        # hang on the single-client TPU tunnel (pair documented in
+        # __graft_entry__.disarm_axon).
+        saved = {
+            k: os.environ.get(k)
+            for k in ("JAX_PLATFORMS", "PALLAS_AXON_POOL_IPS")
+        }
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["PALLAS_AXON_POOL_IPS"] = ""
+        try:
+            for w, (lo, hi) in enumerate(self._bounds):
+                parent_conn, child_conn = ctx.Pipe()
+                proc = ctx.Process(
+                    target=_worker_main,
+                    args=(
+                        child_conn, w, env_id, env_kwargs, pixel_preprocess,
+                        lo, hi, raw, specs,
+                    ),
+                    daemon=True,
+                    name=f"env-shard-{w}",
+                )
+                proc.start()
+                child_conn.close()
+                self._conns.append(parent_conn)
+                self._procs.append(proc)
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        self._closed = False
+        self._gauge_prev = (time.monotonic(), 0.0)
+        from actor_critic_tpu.telemetry import sampler as _sampler
+
+        self._gauge_name = _sampler.register_gauge("host_pool", self._gauge)
+
+    # -- parent⇄worker plumbing -------------------------------------------
+    def _death_msg(self, w: int) -> str:
+        rc = self._procs[w].exitcode
+        return (
+            f"env worker {w} died (exitcode={rc}) — the sharded pool is "
+            "unusable; checkpoint-restart the run"
+        )
+
+    def _send(self, w: int, msg) -> None:
+        try:
+            self._conns[w].send(msg)
+        except (BrokenPipeError, OSError):
+            raise RuntimeError(self._death_msg(w)) from None
+
+    def _await(self, w: int):
+        conn, proc = self._conns[w], self._procs[w]
+        deadline = time.monotonic() + self._step_timeout_s
+        while True:
+            try:
+                if conn.poll(0.2):
+                    kind, payload = conn.recv()
+                    if kind == "error":
+                        raise RuntimeError(
+                            f"env worker {w} crashed:\n{payload}"
+                        )
+                    return payload
+            except (EOFError, ConnectionResetError, OSError):
+                raise RuntimeError(self._death_msg(w)) from None
+            if not proc.is_alive() and not conn.poll(0.2):
+                raise RuntimeError(self._death_msg(w))
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"env worker {w} gave no answer within "
+                    f"{self._step_timeout_s:.0f}s (simulator wedged?)"
+                )
+
+    def _barrier(self) -> None:
+        for w in range(self.num_workers):
+            self._await(w)
+
+    # -- SyncVectorEnv surface --------------------------------------------
+    def reset(self, seed=None, options=None):
+        if isinstance(seed, int):
+            # SyncVectorEnv's int→list rule over GLOBAL indices, so shard
+            # layout never changes which env gets which seed.
+            seeds = [seed + i for i in range(self.num_envs)]
+        elif seed is None:
+            seeds = [None] * self.num_envs
+        else:
+            seeds = list(seed)
+        for w, (lo, hi) in enumerate(self._bounds):
+            self._send(w, ("reset", seeds[lo:hi]))
+        self._barrier()
+        return self._views["obs"].copy(), {}
+
+    def step(self, actions: np.ndarray):
+        self._views["act"][:] = actions
+        for w in range(self.num_workers):
+            self._send(w, ("step", None))
+        self._barrier()
+        v = self._views
+        # Copies, not views: callers hold step outputs across the next
+        # step, and the shm blocks are rewritten in place.
+        return (
+            v["obs"].copy(),
+            v["reward"].copy(),
+            v["terminated"].copy(),
+            v["truncated"].copy(),
+            {"final_obs": v["final_obs"].copy()},
+        )
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        from actor_critic_tpu.telemetry import sampler as _sampler
+
+        _sampler.unregister_gauge(self._gauge_name)
+        for conn in self._conns:
+            try:
+                conn.send(("close", None))
+            except (BrokenPipeError, OSError):
+                pass
+        for proc in self._procs:
+            proc.join(timeout=5.0)
+            if proc.is_alive():
+                proc.terminate()
+                proc.join(timeout=5.0)
+        for conn in self._conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # -- telemetry ---------------------------------------------------------
+    def worker_busy_s(self) -> np.ndarray:
+        """Cumulative per-worker busy seconds (simulator wall inside the
+        worker's step handler) — host_collect turns deltas of this into
+        per-worker block spans."""
+        return self._views["stats"][:, 0].copy()
+
+    def worker_stats(self) -> list[dict]:
+        stats = self._views["stats"]
+        return [
+            {
+                "worker": w,
+                "envs": hi - lo,
+                "busy_s": round(float(stats[w, 0]), 4),
+                "env_steps": int(stats[w, 1]),
+                "last_step_s": round(float(stats[w, 2]), 6),
+            }
+            for w, (lo, hi) in enumerate(self._bounds)
+        ]
+
+    def _gauge(self) -> dict:
+        """Pool-utilization row for the 5s resource sampler: the busy
+        fraction of the worker fleet since the previous sample — the
+        number that says whether the pool or the device is the
+        bottleneck."""
+        now = time.monotonic()
+        stats = self._views["stats"]
+        busy = float(stats[:, 0].sum())
+        prev_t, prev_busy = self._gauge_prev
+        dt = max(now - prev_t, 1e-9)
+        util = (busy - prev_busy) / (dt * self.num_workers)
+        self._gauge_prev = (now, busy)
+        return {
+            "workers": self.num_workers,
+            "num_envs": self.num_envs,
+            "env_steps": int(stats[:, 1].sum()),
+            "busy_s": round(busy, 3),
+            "utilization": round(min(max(util, 0.0), 1.0), 4),
+        }

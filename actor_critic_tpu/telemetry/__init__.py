@@ -25,6 +25,7 @@ watchdog can name the hung phase in its exit-42 diagnosis.
 
 from actor_critic_tpu.telemetry.session import (  # noqa: F401
     TelemetrySession,
+    complete_span,
     current,
     event,
     instant,

@@ -9,7 +9,10 @@ storms:
   .memory_stats()`` (``None`` on backends without allocator stats, e.g.
   CPU — recorded as absent, not zero),
 - a monotonically increasing XLA recompile counter fed by
-  ``jax.monitoring`` backend-compile events.
+  ``jax.monitoring`` backend-compile events,
+- any registered gauges (``register_gauge``) — e.g. the sharded host
+  env pool's utilization row (envs/shard_pool.py), so pool-vs-device
+  bottleneck attribution rides the same 5s cadence.
 
 Sampling never touches the device (``memory_stats()`` is a host-side
 allocator query), so the cadence costs the training loop nothing.
@@ -21,7 +24,7 @@ import json
 import sys
 import threading
 import time
-from typing import IO, Optional
+from typing import IO, Callable, Optional
 
 _PAGE = 4096
 try:
@@ -65,6 +68,33 @@ def ensure_compile_listener() -> None:
 def compile_count() -> int:
     """Backend compiles observed since the listener was installed."""
     return _compile_count
+
+
+# Gauge registry: components with run-long state (e.g. the sharded env
+# pool) register a zero-argument callable whose return value rides every
+# resources.jsonl row under the registered key. Process-global like the
+# compile counter — gauges outlive sessions, and sample_row() is also
+# called synchronously from tests.
+_gauges: dict[str, Callable[[], object]] = {}
+_gauges_lock = threading.Lock()
+
+
+def register_gauge(name: str, fn: Callable[[], object]) -> str:
+    """Register `fn` under `name` (suffixed `_2`, `_3`, ... on collision,
+    e.g. a train pool and its eval pool both registering "host_pool").
+    Returns the unique key actually used — pass it to unregister_gauge."""
+    with _gauges_lock:
+        key, i = name, 1
+        while key in _gauges:
+            i += 1
+            key = f"{name}_{i}"
+        _gauges[key] = fn
+        return key
+
+
+def unregister_gauge(name: str) -> None:
+    with _gauges_lock:
+        _gauges.pop(name, None)
 
 
 def rss_bytes() -> Optional[int]:
@@ -120,6 +150,13 @@ def sample_row() -> dict:
     devs = device_memory()
     if devs:
         row["devices"] = devs
+    with _gauges_lock:
+        gauges = list(_gauges.items())
+    for name, fn in gauges:
+        try:
+            row[name] = fn()
+        except Exception:
+            pass  # a broken gauge must never take the sampler down
     return row
 
 

@@ -77,6 +77,17 @@ def instant(name: str, **args) -> None:
         s.tracer.instant(name, args or None)
 
 
+def complete_span(name: str, start_pc: float, dur_s: float, **args) -> None:
+    """Emit a Chrome-trace complete event for a span measured EXTERNALLY
+    (e.g. a sharded-pool worker's busy time within a collection block,
+    aggregated host-side). `start_pc` is a `perf_counter()` reading.
+    Unlike `span()`, it does not touch the open-span stack — the
+    measured work happened in another process."""
+    s = _SESSION
+    if s is not None:
+        s.tracer.complete(name, start_pc, dur_s, args or None)
+
+
 def event(kind: str, **fields) -> None:
     """Append a structured event row to events.jsonl (no-op untracked)."""
     s = _SESSION

@@ -17,6 +17,7 @@ unmodified.
 
 from __future__ import annotations
 
+import json
 import os
 import time
 from typing import Any, Callable, Optional
@@ -155,6 +156,12 @@ class Checkpointer:
                 # args).
         return {}
 
+    @property
+    def directory(self) -> str:
+        """The checkpoint root — sidecar files (e.g. the learned chunk
+        wall) live next to the step directories."""
+        return str(self._mgr.directory)
+
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
@@ -172,6 +179,37 @@ class Checkpointer:
 
     def __exit__(self, *exc):
         self.close()
+
+
+def _read_chunk_wall(path: str) -> Optional[float]:
+    """The persisted steady-state chunk wall seconds, or None (absent /
+    unreadable / non-positive — all mean "nothing learned yet")."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return None
+    # Valid-but-foreign JSON (a bare number, a list) must read as
+    # "nothing learned", not crash — this sidecar is advisory.
+    wall = data.get("chunk_wall_s") if isinstance(data, dict) else None
+    if isinstance(wall, (int, float)) and not isinstance(wall, bool):
+        return float(wall) if wall > 0 else None
+    return None
+
+
+def _persist_chunk_wall(path: str, wall_s: float) -> None:
+    """Record the largest steady-state (post-compile) chunk wall observed
+    so a RESUMED process can widen its armed watchdog before its own
+    chunk 1 — whose wall is compile-inflated and deliberately never
+    ratcheted from."""
+    prev = _read_chunk_wall(path)
+    if prev is not None and prev >= wall_s:
+        return
+    try:
+        with open(path, "w") as f:
+            json.dump({"chunk_wall_s": round(float(wall_s), 3)}, f)
+    except OSError:
+        pass  # advisory sidecar; never take the run down
 
 
 def resume_or_init(ckpt: Checkpointer, init_state: Any) -> tuple[Any, int]:
@@ -232,7 +270,19 @@ def checkpointed_train(
     from actor_critic_tpu.utils import watchdog
     from actor_critic_tpu.utils.cadence import should_save
 
+    chunk_wall_path = None
+    if stride > 1 and ckpt is not None:
+        chunk_wall_path = os.path.join(ckpt.directory, "chunk_wall.json")
+        learned = _read_chunk_wall(chunk_wall_path)
+        if learned is not None:
+            # A resumed process recompiles from scratch and its first
+            # dispatch is skipped by the ratchet below, so without this
+            # the run would enter chunk 2 still on the CLI timeout even
+            # when a previous leg proved chunks legitimately run longer.
+            watchdog.ensure_timeout_at_least(3.0 * learned)
+
     it = done
+    timed_k = None  # stride of the last compile-paid dispatch (see below)
     while it < num_iterations:
         # First chunk after a misaligned resume realigns to stride
         # boundaries (resume at it=1000, stride=64 → k=24 then 64s), so
@@ -261,7 +311,24 @@ def checkpointed_train(
             # the real wall time — raise any armed watchdog to 3x that,
             # with headroom for jit-cache misses on tail chunks.
             jax.block_until_ready(metrics)
-            watchdog.ensure_timeout_at_least(3.0 * (time.monotonic() - t_dispatch))
+            chunk_wall = time.monotonic() - t_dispatch
+            if k != timed_k:
+                # A dispatch with a k this process hasn't timed yet paid
+                # XLA compile (each static k is its own program: the
+                # process's first chunk, the resume-realignment chunk,
+                # the short tail chunk — ~60s observed here). Ratcheting
+                # or persisting ITS wall would bake compile time into 3x
+                # the stall timeout permanently, weakening wedge
+                # detection for the rest of the run and (via the
+                # sidecar) every future leg. Shield the NEXT chunk with
+                # a temporary grace extension instead; the first same-k
+                # dispatch supplies the clean wall.
+                watchdog.extend_grace(3.0 * chunk_wall)
+                timed_k = k
+            else:
+                watchdog.ensure_timeout_at_least(3.0 * chunk_wall)
+                if chunk_wall_path is not None:
+                    _persist_chunk_wall(chunk_wall_path, chunk_wall)
         it += k
         if should_save(it, save_every, num_iterations):
             # The span is emitted even with ckpt=None (args record

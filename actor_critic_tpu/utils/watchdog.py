@@ -45,6 +45,21 @@ def armed() -> bool:
     return bool(_ACTIVE)
 
 
+def extend_grace(secs: float) -> None:
+    """Shield every armed watchdog from firing for the next `secs`
+    seconds (raises the startup-grace deadline, never lowers it).
+
+    For slow-but-legitimate windows that must NOT widen the PERMANENT
+    stall timeout: chunked dispatch uses it after a compile-carrying
+    dispatch (process-first, resume-realignment, or the tail chunk —
+    each static k is its own XLA program), whose measured wall mixes
+    compile time with run time. The temporary shield covers the next
+    chunk; the first same-k dispatch then supplies a clean wall for the
+    real `ensure_timeout_at_least` ratchet."""
+    for w in _ACTIVE:
+        w.extend_grace(secs)
+
+
 def ensure_timeout_at_least(secs: float) -> None:
     """Raise every armed watchdog's timeout to at least `secs`.
 
@@ -89,6 +104,13 @@ class StallWatchdog:
 
     def touch(self) -> None:
         self._last = time.monotonic()
+
+    def extend_grace(self, secs: float) -> None:
+        """Push the no-fire grace deadline to at least `secs` from now
+        (module-level `extend_grace` broadcasts to all armed instances)."""
+        deadline = time.monotonic() + float(secs)
+        if deadline > self._grace_until:
+            self._grace_until = deadline
 
     def start(self) -> "StallWatchdog":
         _ACTIVE.append(self)

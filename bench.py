@@ -46,14 +46,37 @@ UNIT = "env-steps/sec/chip"
 NORTH_STAR = 1_000_000.0
 
 
+def _record_timestamp(rec: dict) -> float | None:
+    """The capture timestamp recorded INSIDE a green-evidence JSON line:
+    a positive numeric unix `ts`, else an ISO-8601 `captured_at`. None
+    when the line carries neither (callers then fall back to file mtime
+    — which for COMMITTED results is checkout time, not capture time,
+    hence the in-record preference)."""
+    import datetime
+
+    ts = rec.get("ts")
+    if isinstance(ts, (int, float)) and not isinstance(ts, bool) and ts > 0:
+        return float(ts)
+    cap = rec.get("captured_at")
+    if isinstance(cap, str):
+        try:
+            return datetime.datetime.fromisoformat(
+                cap.replace("Z", "+00:00")
+            ).timestamp()
+        except ValueError:
+            pass
+    return None
+
+
 def _last_green(root: str | None = None) -> dict | None:
     """The most recent committed/captured green benchmark line, embedded in
     tunnel-dead error payloads so a red BENCH_r*.json is never evidence-free
     at the artifact the driver reads (VERDICT.md round 4, weak #1). Scans
     the watcher's capture (`runs/bench_tpu_green.json`) and the committed
     round evidence (`results/bench_tpu_green_r*.json`) for the newest
-    parseable line with a real value. `root` overrides the repo root
-    (tests point it at a fixture tree)."""
+    parseable line with a real value; recency prefers a timestamp recorded
+    in the line itself (`_record_timestamp`) over file mtime. `root`
+    overrides the repo root (tests point it at a fixture tree)."""
     import glob
     import datetime
 
@@ -65,15 +88,22 @@ def _last_green(root: str | None = None) -> dict | None:
         try:
             with open(path) as f:
                 rec = json.loads(f.read().strip().splitlines()[-1])
+            value = rec.get("value") if isinstance(rec, dict) else None
+            # bool is excluded explicitly: JSON `true` is a Python bool,
+            # which IS an int — `isinstance(True, (int, float))` passes
+            # and `True > 0` holds, so a `{"value": true}` line would
+            # otherwise masquerade as green evidence.
             if not (
-                isinstance(rec, dict)
-                and isinstance(rec.get("value"), (int, float))
-                and rec["value"] > 0
+                isinstance(value, (int, float))
+                and not isinstance(value, bool)
+                and value > 0
             ):
                 continue
-            mtime = os.path.getmtime(path)
-            if best is None or mtime > best[0]:
-                best = (mtime, path, rec)
+            ts = _record_timestamp(rec)
+            if ts is None:
+                ts = os.path.getmtime(path)
+            if best is None or ts > best[0]:
+                best = (ts, path, rec)
         except Exception:
             # One malformed evidence file must never crash the error-
             # reporting path (this runs precisely when the tunnel is
@@ -81,13 +111,13 @@ def _last_green(root: str | None = None) -> dict | None:
             continue
     if best is None:
         return None
-    mtime, path, rec = best
+    ts, path, rec = best
     return {
         "value": rec["value"],
         "unit": rec.get("unit", UNIT),
         "vs_baseline": rec.get("vs_baseline"),
         "captured_at": datetime.datetime.fromtimestamp(
-            mtime, datetime.timezone.utc
+            ts, datetime.timezone.utc
         ).strftime("%Y-%m-%dT%H:%M:%SZ"),
         "evidence_path": os.path.relpath(path, here),
     }

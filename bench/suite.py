@@ -282,6 +282,45 @@ def bench_pallas_ops():
     }
 
 
+def bench_host_pool_scaling():
+    """Sharded host-pool scaling (ISSUE 2 acceptance row): steps/s of the
+    SAME pool at workers ∈ {1, 2, 4} on the sleep-padded testbed env
+    (envs/sleep_pad.py). The 10 ms/step sleep models a simulator bound by
+    per-env WALL time (MuJoCo-shaped), not CPU, so worker overlap is
+    measurable in CI on a single-core host with no TPU tunnel — and it is
+    long enough that sleep() timer slack and IPC costs (measured ~1 ms/env
+    and ~5 ms/batch-step here) don't mask the overlap. The headline value
+    is the workers=4 speedup over workers=1 (target >= 2x).
+    """
+    from actor_critic_tpu.envs.host_pool import HostEnvPool
+    from actor_critic_tpu.envs.sleep_pad import QUALIFIED_ENV_ID
+
+    E, T, sleep_s = 8, 30, 0.010
+    rates = {}
+    for W in (1, 2, 4):
+        pool = HostEnvPool(
+            QUALIFIED_ENV_ID, E, seed=0, workers=W,
+            normalize_obs=False, normalize_reward=False,
+            env_kwargs={"sleep_s": sleep_s},
+        )
+        pool.reset()
+        acts = np.zeros(E, np.int64)
+        pool.step(acts)  # warm the worker pipes / first-step costs
+        t0 = time.perf_counter()
+        for _ in range(T):
+            pool.step(acts)
+        rates[W] = E * T / (time.perf_counter() - t0)
+        pool.close()
+    return {
+        "metric": "host_pool_scaling",
+        "value": round(rates[4] / rates[1], 2),
+        "unit": "x steps/s at workers=4 vs workers=1 (sleep-padded testbed)",
+        "steps_per_s": {f"workers={w}": round(r, 1) for w, r in rates.items()},
+        "speedup_w2": round(rates[2] / rates[1], 2),
+        "config": {"num_envs": E, "steps": T, "sleep_s": sleep_s},
+    }
+
+
 def bench_mujoco_host():
     """Raw MuJoCo host-stepping rate through HostEnvPool (E=8,
     HalfCheetah-v5) — the 1-core host bound that caps every host-env
@@ -321,6 +360,7 @@ BENCHES = {
     "sac": bench_sac_updates,
     "ddpg": bench_ddpg_updates,
     "host": bench_host_native,
+    "host_pool_scaling": bench_host_pool_scaling,
     "mujoco": bench_mujoco_host,
     "pallas": bench_pallas_ops,
 }

@@ -25,13 +25,33 @@ ckpt_dir=""
 fresh=0
 prev=""
 args=()
+# train.py options that take a VALUE: a literal "--fresh" right after one
+# of these is that option's argument, not our flag (e.g. a metrics file
+# named --fresh), and must pass through untouched. Mirrors train.py's
+# argparse spec; boolean flags (--quiet, --resume, ...) are absent on
+# purpose.
+takes_value() {
+  case "$1" in
+    --preset|--algo|--env|--iterations|--seed|--set|--env-set|--metrics|\
+    --telemetry-dir|--log-every|--chunk|--eval-every|--eval-envs|\
+    --eval-steps|--workers|--ckpt-dir|--save-every|--stall-timeout)
+      return 0 ;;
+  esac
+  return 1
+}
 for a in "$@"; do
-  if [ "$a" = "--fresh" ]; then fresh=1; prev="$a"; continue; fi
+  if [ "$a" = "--fresh" ] && ! takes_value "$prev"; then
+    fresh=1; prev="$a"; continue
+  fi
   if [ "$prev" = "--ckpt-dir" ]; then ckpt_dir="$a"; fi
   args+=("$a")
   prev="$a"
 done
-set -- "${args[@]}"
+# ${args[@]+...}: bash < 4.4 treats expanding an EMPTY array as an unset-
+# variable error under `set -u`; the parameter-expansion guard is the
+# portable spelling (a bare "${args[@]}" aborts the wrapper when train.py
+# is invoked with --fresh as its only argument).
+set -- ${args[@]+"${args[@]}"}
 
 if [ "$fresh" -eq 1 ] && [ -n "$ckpt_dir" ] && [ -d "$ckpt_dir" ] \
     && ls "$ckpt_dir" 2>/dev/null | grep -qE '^[0-9]+$'; then

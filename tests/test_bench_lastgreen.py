@@ -58,6 +58,48 @@ def test_last_green_none_when_no_evidence(tmp_path):
     assert bench._last_green(root=str(tmp_path)) is None
 
 
+def test_last_green_rejects_bool_value(tmp_path):
+    # JSON `true` is a Python bool, which IS an int: isinstance(True,
+    # (int, float)) passes and True > 0 holds, so without the explicit
+    # bool exclusion a {"value": true} line would pass as green evidence.
+    _write(tmp_path / "runs" / "bench_tpu_green.json", '{"value": true}')
+    assert bench._last_green(root=str(tmp_path)) is None
+    # ...and a bool must not shadow a REAL record either.
+    _write(
+        tmp_path / "results" / "bench_tpu_green_r01.json",
+        json.dumps({"value": 7.0e8, "unit": "u"}),
+    )
+    green = bench._last_green(root=str(tmp_path))
+    assert green is not None and green["value"] == 7.0e8
+
+
+def test_last_green_prefers_in_record_timestamp_over_mtime(tmp_path):
+    """A committed results file's mtime is CHECKOUT time; a timestamp
+    recorded inside the JSON line (numeric `ts` or ISO `captured_at`)
+    must win the recency comparison and feed the reported captured_at."""
+    older_mtime = tmp_path / "results" / "bench_tpu_green_r01.json"
+    _write(
+        older_mtime,
+        json.dumps({"value": 1.0e9, "unit": "u", "ts": 2_000_000_000}),
+    )
+    os.utime(older_mtime, (1_000, 1_000))  # ancient mtime, newest in-record ts
+    newer_mtime = tmp_path / "runs" / "bench_tpu_green.json"
+    _write(newer_mtime, json.dumps({"value": 2.0e9, "unit": "u"}))  # mtime = now
+    green = bench._last_green(root=str(tmp_path))
+    assert green is not None
+    assert green["value"] == 1.0e9  # in-record ts (2033) beats checkout mtime
+    assert green["captured_at"] == "2033-05-18T03:33:20Z"
+
+    # ISO captured_at works the same way.
+    _write(
+        newer_mtime,
+        json.dumps({"value": 3.0e9, "unit": "u",
+                    "captured_at": "2034-01-01T00:00:00Z"}),
+    )
+    green = bench._last_green(root=str(tmp_path))
+    assert green is not None and green["value"] == 3.0e9
+
+
 def test_error_line_embeds_green_and_stays_parseable(tmp_path):
     # The whole point: the error payload must carry the evidence embed
     # when evidence exists — asserted unconditionally against a fixture

@@ -1,0 +1,268 @@
+"""Sharded multi-process host env pool + block buffers (ISSUE 2).
+
+Contracts:
+- `HostEnvPool(workers=W)` reproduces the `workers=1` (SyncVectorEnv)
+  pool EXACTLY at fixed seeds: obs, rewards, dones, final_obs and the
+  RunningMeanStd normalizer state, including uneven shards (E % W != 0).
+- A worker crash (env exception) surfaces as a raised RuntimeError from
+  the next barrier — never a hang — and `close()` after a crash returns.
+- Checkpoint/resume works with `workers > 1` (normalizer stats restore
+  through the same `get_state`/`set_state` path; training continues).
+- `BlockBuffers` double-buffers: block N's arrays stay intact while
+  block N+1 is recorded, and buffers are REUSED (no per-block allocs).
+- The sharded pool feeds telemetry: per-worker `env_step_worker` block
+  spans via host_collect, and a pool-utilization gauge in the sampler.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+gym = pytest.importorskip("gymnasium")
+
+from actor_critic_tpu.envs.host_pool import HostEnvPool
+from actor_critic_tpu.envs.shard_pool import shard_bounds
+
+SLEEP_PAD = "actor_critic_tpu.envs.sleep_pad:SleepPad-v0"
+
+
+def _rollout(pool, steps, seed):
+    rng = np.random.default_rng(seed)
+    obs = pool.reset()
+    frames = [("reset", obs)]
+    for _ in range(steps):
+        acts = rng.integers(0, 2, pool.num_envs).astype(np.int64)
+        out = pool.step(acts)
+        frames.append(
+            (out.obs, out.reward, out.done, out.terminated, out.final_obs)
+        )
+        obs = out.obs
+    return frames
+
+
+def test_shard_bounds_cover_and_balance():
+    assert shard_bounds(8, 4) == [(0, 2), (2, 4), (4, 6), (6, 8)]
+    assert shard_bounds(5, 2) == [(0, 3), (3, 5)]
+    assert shard_bounds(3, 3) == [(0, 1), (1, 2), (2, 3)]
+
+
+def test_workers_validation():
+    with pytest.raises(ValueError, match="workers"):
+        HostEnvPool("CartPole-v1", num_envs=2, workers=0)
+    with pytest.raises(ValueError, match="workers"):
+        HostEnvPool("CartPole-v1", num_envs=2, workers=3)
+    with pytest.raises(ValueError, match="gym backend"):
+        HostEnvPool("CartPole-v1", num_envs=2, backend="native", workers=2)
+
+
+def test_sharded_matches_sync_bit_for_bit():
+    """E=5 over W=2 (uneven shards) must equal the SyncVectorEnv pool
+    exactly — trajectories AND normalization statistics — at fixed
+    seeds; global per-env seeding makes shard layout invisible."""
+    E, K = 5, 120
+    sync = HostEnvPool("CartPole-v1", E, seed=3)
+    shard = HostEnvPool("CartPole-v1", E, seed=3, workers=2)
+    try:
+        fa = _rollout(sync, K, seed=7)
+        fb = _rollout(shard, K, seed=7)
+        for a, b in zip(fa, fb):
+            for xa, xb in zip(a, b):
+                if isinstance(xa, str):
+                    continue
+                np.testing.assert_array_equal(xa, xb)
+        # RunningMeanStd state identical (obs + reward normalizers).
+        np.testing.assert_array_equal(sync.obs_rms.mean, shard.obs_rms.mean)
+        np.testing.assert_array_equal(sync.obs_rms.var, shard.obs_rms.var)
+        assert sync.obs_rms.count == shard.obs_rms.count
+        np.testing.assert_array_equal(sync.ret_rms.mean, shard.ret_rms.mean)
+        np.testing.assert_array_equal(sync.ret_rms.var, shard.ret_rms.var)
+        assert sync.ret_rms.count == shard.ret_rms.count
+    finally:
+        sync.close()
+        shard.close()
+
+
+def test_worker_crash_raises_not_hangs():
+    """An env exception inside a worker must surface as a RuntimeError at
+    the pending barrier (the watchdog-free failure contract); close()
+    afterwards must return, not hang."""
+    pool = HostEnvPool(
+        SLEEP_PAD, 4, seed=0, workers=2,
+        normalize_obs=False, normalize_reward=False,
+        env_kwargs={"crash_at_step": 3},
+    )
+    pool.reset()
+    acts = np.zeros(4, np.int64)
+    with pytest.raises(RuntimeError, match="worker"):
+        for _ in range(10):
+            pool.step(acts)
+    pool.close()
+
+
+def test_sharded_pool_validation_failure_closes_workers():
+    """A post-construction validation failure (scale_actions on a
+    discrete env) must tear the live backend down: no orphan worker
+    processes, no gauge bound to an unreachable pool."""
+    import multiprocessing as mp
+
+    from actor_critic_tpu.telemetry.sampler import sample_row
+
+    with pytest.raises(ValueError, match="finite continuous"):
+        HostEnvPool("CartPole-v1", num_envs=2, workers=2, scale_actions=True)
+    assert "host_pool" not in sample_row()
+    leftovers = [
+        p for p in mp.active_children() if p.name.startswith("env-shard")
+    ]
+    assert leftovers == [], leftovers
+
+
+def test_ppo_host_resume_with_sharded_pool(tmp_path):
+    """Checkpoint/resume with workers>1: same contract as the workers=1
+    resume tests (device state + normalizer stats restore; training
+    continues from the saved iteration)."""
+    from actor_critic_tpu.algos import ppo
+    from actor_critic_tpu.utils.checkpoint import Checkpointer
+
+    cfg = ppo.PPOConfig(
+        num_envs=2, rollout_steps=8, epochs=1, num_minibatches=1, hidden=(16,)
+    )
+    pool = HostEnvPool("CartPole-v1", num_envs=2, seed=0, workers=2)
+    with Checkpointer(tmp_path / "ck") as ck:
+        ppo.train_host(
+            pool, cfg, num_iterations=2, seed=0, log_every=0,
+            ckpt=ck, save_every=1,
+        )
+        ck.wait()
+        saved_count = pool.obs_rms.count
+    pool.close()
+
+    pool2 = HostEnvPool("CartPole-v1", num_envs=2, seed=0, workers=2)
+    with Checkpointer(tmp_path / "ck") as ck:
+        _, _, history = ppo.train_host(
+            pool2, cfg, num_iterations=4, seed=0, log_every=1,
+            ckpt=ck, save_every=1, resume=True,
+        )
+        assert ck.latest_step() == 4
+    # Only iterations 3..4 ran, and the restored stats carried over
+    # (resume pushes obs_rms back through pool.set_state, then training
+    # keeps accumulating past the saved count).
+    assert [it for it, _ in history] == [3, 4]
+    assert pool2.obs_rms.count > saved_count
+    pool2.close()
+
+
+def test_sharded_pool_telemetry(tmp_path):
+    """host_collect must emit one env_step_worker span per worker per
+    collection block, and the sampler row must carry the pool gauge while
+    the pool lives (and drop it after close)."""
+    from actor_critic_tpu import telemetry
+    from actor_critic_tpu.algos import ppo
+    from actor_critic_tpu.telemetry.sampler import sample_row
+
+    cfg = ppo.PPOConfig(
+        num_envs=2, rollout_steps=4, epochs=1, num_minibatches=1, hidden=(16,)
+    )
+    pool = HostEnvPool("CartPole-v1", num_envs=2, seed=0, workers=2)
+    with telemetry.TelemetrySession(tmp_path, sample_resources=False):
+        ppo.train_host(pool, cfg, num_iterations=2, seed=0, log_every=0)
+        gauge = sample_row().get("host_pool")
+    assert gauge is not None
+    assert gauge["workers"] == 2 and gauge["num_envs"] == 2
+    assert 0.0 <= gauge["utilization"] <= 1.0
+    assert gauge["env_steps"] >= 2 * cfg.rollout_steps * cfg.num_envs
+    pool.close()
+    assert "host_pool" not in sample_row()
+
+    with open(tmp_path / "spans.jsonl") as f:
+        events = [json.loads(line) for line in f if line.strip()]
+    workers_seen = {
+        e["args"]["worker"]
+        for e in events
+        if e.get("name") == "env_step_worker" and e["ph"] == "X"
+    }
+    assert workers_seen == {0, 1}, workers_seen
+    spans = [e for e in events if e.get("name") == "env_step_worker"]
+    # one span per worker per iteration block
+    assert len(spans) == 2 * 2, spans
+    assert all(e["dur"] >= 0 for e in spans)
+
+
+def test_block_buffers_double_buffer_and_reuse():
+    from actor_critic_tpu.algos.host_loop import BlockBuffers
+
+    bufs = BlockBuffers(3)
+    bufs.begin_block()
+    for t in range(3):
+        bufs.record(t, "x", np.full(2, t, np.float32))
+    b1 = bufs.block()["x"]
+    np.testing.assert_array_equal(b1[:, 0], [0, 1, 2])
+
+    bufs.begin_block()
+    for t in range(3):
+        bufs.record(t, "x", np.full(2, 10 + t, np.float32))
+    b2 = bufs.block()["x"]
+    assert b1 is not b2
+    # Block 1's arrays are INTACT while block 2 is live — the property
+    # that lets block 1's device transfer overlap block 2's collection.
+    np.testing.assert_array_equal(b1[:, 0], [0, 1, 2])
+    np.testing.assert_array_equal(b2[:, 0], [10, 11, 12])
+
+    bufs.begin_block()
+    for t in range(3):
+        bufs.record(t, "x", np.full(2, 20 + t, np.float32))
+    # Steady state reuses block 1's storage: no per-block allocation.
+    assert bufs.block()["x"] is b1
+    np.testing.assert_array_equal(b1[:, 0], [20, 21, 22])
+
+
+def test_block_buffers_never_leak_stale_keys():
+    """A key recorded in an earlier block but not the current one must
+    be absent from block() — not silently served two blocks stale."""
+    from actor_critic_tpu.algos.host_loop import BlockBuffers
+
+    bufs = BlockBuffers(2)
+    bufs.begin_block()
+    for t in range(2):
+        bufs.record(t, "x", np.zeros(1, np.float32))
+        bufs.record(t, "aux", np.ones(1, np.float32))
+    assert set(bufs.block()) == {"x", "aux"}
+    bufs.begin_block()
+    bufs.begin_block()  # back on the buffer set that once held "aux"
+    for t in range(2):
+        bufs.record(t, "x", np.full(1, 5.0, np.float32))
+    assert set(bufs.block()) == {"x"}
+
+
+def test_host_collect_block_matches_legacy_stacking():
+    """The preallocated-buffer path must produce the exact [K, E, ...]
+    block the old list-append+np.stack path produced, extras included."""
+    from actor_critic_tpu.algos.host_loop import (
+        BlockBuffers,
+        EpisodeTracker,
+        host_collect,
+    )
+
+    def run(buffers):
+        pool = HostEnvPool("CartPole-v1", num_envs=3, seed=5)
+        rng = np.random.default_rng(11)
+
+        def act(obs):
+            a = rng.integers(0, 2, 3).astype(np.int64)
+            return a, {"aux": obs.sum(axis=-1)}
+
+        obs, block = host_collect(
+            pool, pool.reset(), 6, act, EpisodeTracker(3), buffers=buffers
+        )
+        pool.close()
+        return obs, block
+
+    obs_a, block_a = run(None)                  # per-call buffers
+    obs_b, block_b = run(BlockBuffers(6))       # loop-lived buffers
+    np.testing.assert_array_equal(obs_a, obs_b)
+    assert set(block_a) == {
+        "obs", "action", "aux", "reward", "done", "terminated", "final_obs"
+    }
+    for k in block_a:
+        assert block_a[k].shape[0] == 6, k
+        np.testing.assert_array_equal(block_a[k], block_b[k])

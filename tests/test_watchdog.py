@@ -88,8 +88,8 @@ def test_armed_and_ensure_timeout_at_least():
 def test_chunked_train_widens_watchdog_from_real_chunk_wall():
     """End-to-end: checkpointed_train(stride>1) must measure the chunk
     BEHIND a block (a jitted call returns at enqueue time) and raise an
-    armed watchdog to 3x the measured wall."""
-    import jax
+    armed watchdog to 3x the measured wall — from the SECOND dispatch on
+    (the first is compile-inflated and skipped by design)."""
     import jax.numpy as jnp
 
     from actor_critic_tpu.utils.checkpoint import checkpointed_train
@@ -110,7 +110,58 @@ def test_chunked_train_widens_watchdog_from_real_chunk_wall():
             slow_chunk, jnp.asarray(0), num_iterations=4, stride=2,
         )
         assert int(state) == 4
-        # 3 x ~0.25s measured wall: widened to >= ~0.6 > the armed 0.4.
+        # 3 x ~0.25s measured wall (second dispatch): widened past 0.4.
         assert w.timeout_s >= 0.6, w.timeout_s
     finally:
         w.stop()
+
+
+def test_chunked_train_first_dispatch_never_ratchets_and_wall_persists(tmp_path):
+    """ISSUE 2 satellite: (a) the FIRST dispatch of a process — which in
+    production carries full XLA compile — must not drive the auto-raise
+    (it would bake compile time into 3x the stall timeout for the whole
+    run); (b) the steady-state chunk wall persists to a ckpt-dir sidecar;
+    (c) a resumed process widens its armed watchdog from the sidecar
+    BEFORE its own (skipped) chunk 1."""
+    import json
+
+    import jax.numpy as jnp
+
+    from actor_critic_tpu.utils.checkpoint import Checkpointer, checkpointed_train
+
+    calls = []
+
+    def chunk(state, k):
+        time.sleep(0.5 if not calls else 0.05)  # dispatch 1 "compiles"
+        calls.append(k)
+        return {"n": state["n"] + k}, {"loss": jnp.asarray(0.0)}
+
+    init = {"n": jnp.asarray(0)}
+    w = watchdog.StallWatchdog(0.4).start()  # default grace shields chunk 1
+    try:
+        with Checkpointer(tmp_path / "ck") as ck:
+            state, _ = checkpointed_train(
+                chunk, init, num_iterations=6, stride=2, ckpt=ck,
+            )
+        assert int(state["n"]) == 6 and len(calls) == 3
+        # The 0.5s first dispatch did NOT ratchet (3 x 0.5 = 1.5 would
+        # show); the 0.05s steady chunks ratchet 0.15 < 0.4 — a no-op.
+        assert w.timeout_s == 0.4, w.timeout_s
+    finally:
+        w.stop()
+    with open(tmp_path / "ck" / "chunk_wall.json") as f:
+        wall = json.load(f)["chunk_wall_s"]
+    assert 0 < wall < 0.3, wall  # steady wall, not the compile-inflated one
+
+    # Resume leg: the persisted wall widens a narrower armed watchdog
+    # before any dispatch runs (here: zero dispatches remain).
+    w2 = watchdog.StallWatchdog(0.01).start()
+    try:
+        with Checkpointer(tmp_path / "ck") as ck:
+            state, _ = checkpointed_train(
+                chunk, init, num_iterations=6, stride=2, ckpt=ck,
+            )
+        assert int(state["n"]) == 6 and len(calls) == 3  # nothing re-ran
+        assert w2.timeout_s >= 3.0 * wall - 1e-6, w2.timeout_s
+    finally:
+        w2.stop()

@@ -28,7 +28,7 @@ from actor_critic_tpu import telemetry
 
 
 def build_env(spec: str, algo: str, cfg, seed: int, scale_actions=None,
-              env_kwargs=None):
+              env_kwargs=None, workers: int = 1):
     """'jax:<name>' → (JaxEnv, fused=True); 'host:<id>' → (pool, False).
 
     scale_actions is tri-state: None keeps each env's own convention
@@ -95,6 +95,11 @@ def build_env(spec: str, algo: str, cfg, seed: int, scale_actions=None,
                 f"--env-set is not supported for native:{name} (the C++ "
                 "engine replicates gymnasium defaults exactly)"
             )
+        if kind == "native" and workers > 1:
+            raise SystemExit(
+                "--workers applies to host:<id> pools only (the native "
+                "engine already steps the whole batch in one C call)"
+            )
         try:
             return (
                 HostEnvPool(
@@ -106,6 +111,7 @@ def build_env(spec: str, algo: str, cfg, seed: int, scale_actions=None,
                     backend="gym" if kind == "host" else "native",
                     scale_actions=bool(scale_actions),
                     env_kwargs=env_kwargs,
+                    workers=workers,
                 ),
                 False,
             )
@@ -405,6 +411,9 @@ def run_host(pool, preset, args, logger) -> dict:
 
 
 def main(argv=None) -> int:
+    # NB: when ADDING an option that takes a VALUE, also add it to
+    # `takes_value()` in scripts/run_resumable.sh — the wrapper parses
+    # this argv shape to tell its own --fresh flag from option values.
     p = argparse.ArgumentParser(
         description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
     )
@@ -454,6 +463,14 @@ def main(argv=None) -> int:
     p.add_argument(
         "--eval-steps", type=int, default=1000,
         help="host trainers: max steps per eval sweep (first episode only)",
+    )
+    p.add_argument(
+        "--workers", type=int, default=1,
+        help="host pools: worker processes the env batch shards across "
+        "(envs/shard_pool.py; shared-memory step exchange, per-shard "
+        "seeding identical to the in-process pool). 1 = in-process "
+        "SyncVectorEnv, today's exact semantics; scaling measured by "
+        "`bench/suite.py host_pool_scaling`",
     )
     p.add_argument("--quiet", action="store_true", help="no stdout metric echo")
     p.add_argument(
@@ -518,7 +535,11 @@ def main(argv=None) -> int:
     env, fused = build_env(
         preset.env, preset.algo, preset.config, args.seed,
         scale_actions=args.scale_actions, env_kwargs=preset.env_kwargs,
+        workers=args.workers,
     )
+    if fused and args.workers > 1:
+        print("--workers applies to host pools only; ignored for jax:* "
+              "envs (their rollouts are fused on-device)", flush=True)
     # Host pools carry their ACTION convention in the checkpoint metrics
     # too (host_loop's _pool_scale_actions), but env_kwargs exist only
     # here — the sidecar guards both paths against resuming into a
