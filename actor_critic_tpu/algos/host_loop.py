@@ -128,13 +128,13 @@ def host_collect(
 
     from actor_critic_tpu.utils import watchdog
 
-    # Per-worker spans come from the sharded pool's busy counters —
-    # block-level deltas, only while a telemetry session is installed.
-    busy0 = None
+    # Per-worker spans, only while a telemetry session is installed: the
+    # sharded pool relays the workers' OWN per-step records after the
+    # block (drain_telemetry — real pid lanes in the trace; 0 records
+    # for backends without worker processes).
+    drain_fn = None
     if telemetry.current() is not None:
-        busy_fn = getattr(pool, "worker_busy_s", None)
-        busy0 = busy_fn() if busy_fn is not None else None
-    t_block = time.perf_counter()
+        drain_fn = getattr(pool, "drain_telemetry", None)
 
     # One span per collection block, not per pool step: a MuJoCo run
     # takes millions of env steps, and the per-phase breakdown needs the
@@ -155,17 +155,16 @@ def host_collect(
             tracker.update(out.raw_reward, out.done)
             obs = out.obs
 
-    if busy0 is not None:
-        # One "env_step_worker" span per pool worker per block: its
-        # duration is that worker's simulator busy time within the block,
-        # so the trace shows load imbalance next to the block total.
-        busy1 = pool.worker_busy_s()
-        if busy1 is not None:
-            for w, d in enumerate(np.asarray(busy1) - np.asarray(busy0)):
-                telemetry.complete_span(
-                    "env_step_worker", t_block, float(d),
-                    worker=w, steps=num_steps,
-                )
+    if drain_fn is not None:
+        # Worker→parent relay: the workers buffered one span per batch
+        # step during the block; one drain round-trip per worker ships
+        # them into spans.jsonl under the workers' real pids.
+        try:
+            drain_fn()
+        except RuntimeError:
+            raise  # dead worker: same contract as a step failure
+        except Exception:
+            pass  # telemetry must never take the run down
 
     return obs, buffers.block()
 
@@ -463,6 +462,10 @@ def off_policy_train_host(
             rng = np.random.default_rng(seed + 0x5EED)
 
     for it in range(start_it, num_iterations):
+        # Iteration boundary for any armed on-demand profile window
+        # (telemetry/profiler.py): a capture starts/ends here so it
+        # covers whole iterations.
+        telemetry.profiler_tick()
         # Per-iteration span: the phase spans inside (env_step /
         # host_to_device / update / eval / log / checkpoint) nest
         # under it in the trace, giving per-iteration attribution.

@@ -458,6 +458,8 @@ def train_host(
             rng = np.random.default_rng(seed + 0x5EED)
 
     for it in range(start_it, num_iterations):
+        # Iteration boundary for any armed on-demand profile window.
+        telemetry.profiler_tick()
         with telemetry.span("iteration", it=it + 1):
 
             if host_policy is not None:

@@ -340,12 +340,12 @@ class HostEnvPool:
         )
 
     # -- telemetry ---------------------------------------------------------
-    def worker_busy_s(self) -> Optional[np.ndarray]:
-        """Cumulative per-worker busy seconds when the backend is the
-        sharded multi-process pool, else None (host_collect uses deltas
-        of this for per-worker block spans)."""
-        fn = getattr(self._envs, "worker_busy_s", None)
-        return None if fn is None else fn()
+    def drain_telemetry(self) -> int:
+        """Relay the sharded backend's buffered per-worker span records
+        into the installed telemetry session (envs/shard_pool.py); 0 for
+        backends without worker processes."""
+        fn = getattr(self._envs, "drain_telemetry", None)
+        return 0 if fn is None else fn()
 
     def worker_stats(self) -> Optional[list[dict]]:
         """Per-worker step accounting (sharded backend only)."""

@@ -38,10 +38,14 @@ inside a function) — train.py and pytest both are.
 
 Failure contract: a worker crash (env exception or process death)
 surfaces as a RuntimeError from the pending barrier — never a hang.
-Telemetry: per-worker busy seconds accumulate in a shared stats block
-(`worker_busy_s()` feeds host_collect's per-worker block spans) and a
-pool-utilization gauge registers with the 5s resource sampler
-(telemetry/sampler.py `register_gauge`).
+Telemetry: workers buffer one span record per batch step (a bounded
+deque), relayed to the parent once per collection block
+(`drain_telemetry`, called by host_collect) and merged into
+spans.jsonl under each worker's REAL pid — one Perfetto lane per
+worker process; per-worker busy seconds also accumulate in a shared
+stats block feeding `worker_stats()` and the pool-utilization gauge
+registered with the resource sampler (telemetry/sampler.py
+`register_gauge`).
 """
 
 from __future__ import annotations
@@ -92,6 +96,18 @@ def _np_view(raw, dtype: np.dtype, shape: tuple[int, ...]) -> np.ndarray:
     return np.frombuffer(raw, dtype=dtype).reshape(shape)
 
 
+# Per-worker telemetry ring: one (epoch_start, dur_s) record per batch
+# step, buffered locally and shipped to the parent on "drain" (once per
+# collection block while a session is installed). Bounded so a run
+# WITHOUT telemetry — which never drains — holds at most this many
+# records per worker, the oldest rolling off.
+_TELEMETRY_RING = 4096
+
+# Phase name of the relayed records (the *_PHASE suffix keeps it visible
+# to tests/test_span_names.py's canonical-vocabulary scan).
+_WORKER_PHASE = "env_step_worker"
+
+
 def _worker_main(
     conn, wid, env_id, env_kwargs, pixel_preprocess, lo, hi, raw, specs
 ):
@@ -99,6 +115,7 @@ def _worker_main(
     exception is sent back as ("error", traceback) — the parent raises it
     at the barrier, so a crash is an error, not a hang."""
     import traceback
+    from collections import deque
 
     try:
         from gymnasium.vector import AutoresetMode, SyncVectorEnv
@@ -113,13 +130,26 @@ def _worker_main(
             autoreset_mode=AutoresetMode.SAME_STEP,
         )
         stats = views["stats"]
+        tel: deque = deque(maxlen=_TELEMETRY_RING)
+        tel_dropped = 0
         while True:
             cmd, payload = conn.recv()
             if cmd == "reset":
                 obs, _ = envs.reset(seed=payload)
                 views["obs"][lo:hi] = obs
                 conn.send(("ok", None))
+            elif cmd == "drain":
+                # Ship the buffered span records (wall-clock epoch start
+                # + duration; time.time() is shared across processes on
+                # one host, so the parent can place them on its tracer's
+                # ts axis) and start a fresh buffer.
+                conn.send(
+                    ("ok", {"records": list(tel), "dropped": tel_dropped})
+                )
+                tel.clear()
+                tel_dropped = 0
             elif cmd == "step":
+                t_epoch = time.time()
                 t0 = time.perf_counter()
                 obs, rew, term, trunc, info = envs.step(
                     np.array(views["act"][lo:hi])
@@ -142,6 +172,9 @@ def _worker_main(
                 stats[wid, 0] += dt       # cumulative busy seconds
                 stats[wid, 1] += n        # cumulative env steps
                 stats[wid, 2] = dt        # last batch-step wall
+                if len(tel) == tel.maxlen:
+                    tel_dropped += 1
+                tel.append((t_epoch, dt))
                 conn.send(("ok", None))
             elif cmd == "close":
                 envs.close()
@@ -248,6 +281,15 @@ class ShardedVecEnv:
                     os.environ[k] = v
         self._closed = False
         self._gauge_prev = (time.monotonic(), 0.0)
+        self._gauge_last_util = 0.0
+        # The gauge is a stateful rate integrator with TWO independent
+        # consumers since ISSUE 3 — the 5s sampler thread AND every
+        # /metrics HTTP scrape (exporter → sample_row) — so its
+        # read-modify-write needs a lock, and a scrape must not shrink
+        # the sampler's utilization window to a meaningless sliver.
+        import threading
+
+        self._gauge_lock = threading.Lock()
         from actor_critic_tpu.telemetry import sampler as _sampler
 
         self._gauge_name = _sampler.register_gauge("host_pool", self._gauge)
@@ -347,11 +389,45 @@ class ShardedVecEnv:
                 pass
 
     # -- telemetry ---------------------------------------------------------
-    def worker_busy_s(self) -> np.ndarray:
-        """Cumulative per-worker busy seconds (simulator wall inside the
-        worker's step handler) — host_collect turns deltas of this into
-        per-worker block spans."""
-        return self._views["stats"][:, 0].copy()
+    def drain_telemetry(self) -> int:
+        """Ship each worker's buffered per-step span records into the
+        installed session's spans.jsonl with the worker's REAL pid, so
+        Perfetto renders one lane per worker process (idle gaps between
+        batch steps included) instead of the parent's synthetic busy-sum
+        reconstruction. Called by host_collect once per collection block;
+        returns the number of records merged (0 without a session)."""
+        from actor_critic_tpu import telemetry
+
+        s = telemetry.current()
+        if s is None or self._closed:
+            return 0
+        # Consume EVERY worker's reply before emitting anything: an
+        # emission failure mid-loop (e.g. spans.jsonl hitting ENOSPC)
+        # must not leave unread "drain" acks in the pipes — the next
+        # "step" barrier would consume a stale ack and every subsequent
+        # exchange would read one-step-old shared memory.
+        for w in range(self.num_workers):
+            self._send(w, ("drain", None))
+        payloads = [self._await(w) for w in range(self.num_workers)]
+        batch = []
+        for w, (lo, hi) in enumerate(self._bounds):
+            payload = payloads[w]
+            pid = self._procs[w].pid
+            s.tracer.name_process(pid, f"env-shard-{w}")
+            args = {"worker": w, "envs": hi - lo}
+            batch.extend(
+                (_WORKER_PHASE, t_epoch, dur, pid, 0, args)
+                for t_epoch, dur in payload["records"]
+            )
+            if payload["dropped"]:
+                telemetry.event(
+                    "worker_telemetry_dropped",
+                    worker=w, dropped=payload["dropped"],
+                )
+        # One locked write for the whole block's records (hot path:
+        # runs on the training thread once per collection block).
+        s.tracer.complete_foreign_many(batch)
+        return len(batch)
 
     def worker_stats(self) -> list[dict]:
         stats = self._views["stats"]
@@ -366,22 +442,32 @@ class ShardedVecEnv:
             for w, (lo, hi) in enumerate(self._bounds)
         ]
 
+    # Calls closer together than this reuse the previous utilization
+    # instead of resetting the window: back-to-back /metrics scrapes (or
+    # a scrape racing the sampler tick) would otherwise measure a
+    # sliver-of-a-second window and report noise.
+    _GAUGE_MIN_WINDOW_S = 1.0
+
     def _gauge(self) -> dict:
-        """Pool-utilization row for the 5s resource sampler: the busy
-        fraction of the worker fleet since the previous sample — the
-        number that says whether the pool or the device is the
-        bottleneck."""
-        now = time.monotonic()
+        """Pool-utilization row for the resource sampler AND /metrics
+        scrapes: the busy fraction of the worker fleet over the window
+        since the previous (window-resetting) call — the number that
+        says whether the pool or the device is the bottleneck."""
         stats = self._views["stats"]
         busy = float(stats[:, 0].sum())
-        prev_t, prev_busy = self._gauge_prev
-        dt = max(now - prev_t, 1e-9)
-        util = (busy - prev_busy) / (dt * self.num_workers)
-        self._gauge_prev = (now, busy)
+        with self._gauge_lock:
+            now = time.monotonic()
+            prev_t, prev_busy = self._gauge_prev
+            dt = now - prev_t
+            if dt >= self._GAUGE_MIN_WINDOW_S:
+                util = (busy - prev_busy) / (dt * self.num_workers)
+                self._gauge_last_util = round(min(max(util, 0.0), 1.0), 4)
+                self._gauge_prev = (now, busy)
+            util = self._gauge_last_util
         return {
             "workers": self.num_workers,
             "num_envs": self.num_envs,
             "env_steps": int(stats[:, 1].sum()),
             "busy_s": round(busy, 3),
-            "utilization": round(min(max(util, 0.0), 1.0), 4),
+            "utilization": util,
         }

@@ -15,6 +15,15 @@ stalled, whether device memory crept, when throughput regressed:
               structured events (`events.jsonl`).
 - `session` — `TelemetrySession` owning the three sinks, plus the
               module-level current-session API the training loops call.
+- `exporter`— live-introspection HTTP daemon (ISSUE 3): `/metrics`
+              (Prometheus text), `/healthz` (watchdog staleness + open
+              span), `/profile?iters=N` (arm an on-demand capture);
+              `train.py --telemetry-port`.
+- `profiler`— armable windowed `jax.profiler` capture (endpoint or
+              SIGUSR2) and the compile listener that turns every XLA
+              compilation into a structured `compile` event with
+              cost_analysis() FLOPs/bytes and the abstract argument
+              signature.
 
 Instrumentation is ALWAYS on (a span is two `time.perf_counter()` calls
 and a list push/pop — no device syncs); the three JSONL sinks only
@@ -23,6 +32,9 @@ open-span stack is maintained even without a session so the stall
 watchdog can name the hung phase in its exit-42 diagnosis.
 """
 
+from actor_critic_tpu.telemetry.profiler import (  # noqa: F401
+    tick as profiler_tick,
+)
 from actor_critic_tpu.telemetry.session import (  # noqa: F401
     TelemetrySession,
     complete_span,
@@ -36,3 +48,4 @@ from actor_critic_tpu.telemetry.session import (  # noqa: F401
     span,
     stall_report,
 )
+from actor_critic_tpu.telemetry.spans import CANONICAL_PHASES  # noqa: F401

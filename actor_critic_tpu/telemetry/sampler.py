@@ -185,8 +185,11 @@ class ResourceSampler:
     def _emit(self) -> None:
         try:
             self._fh.write(json.dumps(sample_row(), allow_nan=False) + "\n")
-        except ValueError:
-            pass  # file closed mid-shutdown; nothing to record it in
+        except (OSError, ValueError):
+            # OSError (disk full) would otherwise kill the daemon thread
+            # and silently end sampling for the rest of the run; skip
+            # the row and keep ticking — the disk may come back.
+            pass
 
     def _run(self) -> None:
         self._emit()

@@ -29,10 +29,21 @@ from actor_critic_tpu.telemetry.health import (
     DivergenceMonitor,
     ThroughputMonitor,
 )
-from actor_critic_tpu.telemetry.sampler import ResourceSampler
+from actor_critic_tpu.telemetry.sampler import (
+    ResourceSampler,
+    ensure_compile_listener,
+)
 from actor_critic_tpu.telemetry.spans import SpanTracer
 
 _SESSION: Optional["TelemetrySession"] = None
+
+# Event kinds that are a run's last words: after writing one, all three
+# sinks are flushed AND fsynced so a SIGKILL'd run (or a machine losing
+# power mid-stall) keeps its final stall/divergence evidence on disk —
+# line buffering alone only guarantees the row reached the page cache.
+DURABLE_EVENT_KINDS = frozenset(
+    {"stall", "divergence", "throughput_regression"}
+)
 
 # Open-span stack: (name, entry perf_counter). Appended/popped by _Span
 # on the training thread; read by the watchdog thread on a stall.
@@ -165,6 +176,8 @@ class TelemetrySession:
         resource_interval_s: float = 5.0,
         sample_resources: bool = True,
         throughput_drop_threshold: float = 0.5,
+        serve_port: Optional[int] = None,
+        profile: bool = True,
     ):
         self.directory = os.fspath(directory)
         os.makedirs(self.directory, exist_ok=True)
@@ -178,6 +191,14 @@ class TelemetrySession:
         self._events_lock = threading.Lock()
         self.tracer = SpanTracer(self._spans_fh)
         self._t0 = time.monotonic()
+        # Live-introspection state the exporter reads: the most recent
+        # observe() row and the rates derived from consecutive rows.
+        self.last_observation: Optional[dict] = None
+        self._rates: dict[str, float] = {}
+        self._prev_observe: Optional[tuple[int, Optional[float], float]] = None
+        # The recompile counter must count even when the sampler thread
+        # is off (the exporter's /metrics reads it directly).
+        ensure_compile_listener()
         self.event("session_start", **(run_info or {}))
         self._monitors = [
             ThroughputMonitor(
@@ -185,11 +206,26 @@ class TelemetrySession:
             ),
             DivergenceMonitor(self._emit_health),
         ]
+        self.profiler = None
+        if profile:
+            from actor_critic_tpu.telemetry.profiler import (
+                WindowedProfiler,
+                ensure_compile_introspection,
+            )
+
+            self.profiler = WindowedProfiler(self.directory)
+            ensure_compile_introspection()
         self.sampler: Optional[ResourceSampler] = None
         if sample_resources:
             self.sampler = ResourceSampler(
                 self._resources_fh, interval_s=resource_interval_s
             ).start()
+        self.exporter = None
+        if serve_port is not None:
+            from actor_critic_tpu.telemetry.exporter import TelemetryExporter
+
+            self.exporter = TelemetryExporter(self, port=serve_port)
+            self.event("exporter_start", port=self.exporter.port)
 
     def _open(self, name: str) -> IO[str]:
         return open(os.path.join(self.directory, name), "a", buffering=1)
@@ -212,10 +248,31 @@ class TelemetrySession:
             return
         try:
             self._events_fh.write(line)
-        except ValueError:
-            pass  # closed mid-shutdown
+        except (OSError, ValueError):
+            pass  # disk full / closed mid-shutdown
         finally:
             self._events_lock.release()
+        if kind in DURABLE_EVENT_KINDS:
+            self._durable_flush()
+
+    def _durable_flush(self, timeout_s: float = 2.0) -> None:
+        """Flush + fsync all three sinks so the row that was just written
+        survives a SIGKILL. Runs in a bounded side thread: the stall path
+        calls event() from the watchdog thread moments before os._exit,
+        and an fsync hanging on the very filesystem stall being reported
+        must not block the exit-42 escape."""
+
+        def _sync():
+            for fh in (self._spans_fh, self._resources_fh, self._events_fh):
+                try:
+                    fh.flush()
+                    os.fsync(fh.fileno())
+                except (OSError, ValueError):
+                    pass  # closed, or a sink on a non-fsyncable fs
+
+        t = threading.Thread(target=_sync, daemon=True)
+        t.start()
+        t.join(timeout=timeout_s)
 
     def observe(self, it: int, metrics: dict) -> None:
         now = time.monotonic() - self._t0
@@ -224,8 +281,48 @@ class TelemetrySession:
                 m.observe(it, metrics, now)
             except Exception:
                 pass  # telemetry must never take the run down
+        # Live-introspection snapshot for /metrics: the row itself plus
+        # iters/s and env-steps/s from consecutive observe() calls.
+        env_steps = metrics.get("env_steps")
+        try:
+            env_steps = None if env_steps is None else float(env_steps)
+        except (TypeError, ValueError):
+            env_steps = None
+        prev = self._prev_observe
+        if prev is not None:
+            p_it, p_steps, p_t = prev
+            dt = now - p_t
+            if it > p_it and dt > 0:
+                self._rates["iters_per_s"] = (it - p_it) / dt
+                if env_steps is not None and p_steps is not None:
+                    self._rates["env_steps_per_s"] = (
+                        env_steps - p_steps
+                    ) / dt
+        self._prev_observe = (it, env_steps, now)
+        # Reserved keys LAST: a training metric named "it"/"age_t" must
+        # not overwrite the bookkeeping /healthz and /metrics read.
+        self.last_observation = {**metrics, "it": it, "age_t": now}
+
+    def rates(self) -> dict[str, float]:
+        """{'iters_per_s', 'env_steps_per_s'} from the last two observe()
+        calls (empty until two logged iterations have landed)."""
+        return dict(self._rates)
+
+    def uptime_s(self) -> float:
+        return time.monotonic() - self._t0
+
+    def last_observe_age_s(self) -> Optional[float]:
+        if self.last_observation is None:
+            return None
+        return self.uptime_s() - self.last_observation["age_t"]
 
     def close(self) -> None:
+        if self.exporter is not None:
+            self.exporter.close()
+            self.exporter = None
+        if self.profiler is not None:
+            self.profiler.close()
+            self.profiler = None
         if self.sampler is not None:
             self.sampler.stop()
             self.sampler = None

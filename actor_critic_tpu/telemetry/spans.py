@@ -20,6 +20,24 @@ import threading
 import time
 from typing import IO, Optional
 
+# Canonical phase-span vocabulary. Every `telemetry.span(...)` /
+# `complete_span(...)` / `instant(...)` name in the codebase must come
+# from this set (tests/test_span_names.py statically enforces it): the
+# per-phase breakdown in scripts/run_report.py groups rows by name, so a
+# typo'd phase would not error anywhere — it would just silently grow a
+# one-off row nobody aggregates. Add new phases HERE first.
+CANONICAL_PHASES = frozenset({
+    "iteration",        # one host-loop iteration (encloses the rest)
+    "env_step",         # host env collection block (or fused instant)
+    "env_step_worker",  # sharded-pool worker simulator time (relayed)
+    "host_to_device",   # block transfer onto the device
+    "update",           # jitted learner update (async dispatch)
+    "eval",             # greedy eval sweep
+    "log",              # metrics materialization + sinks
+    "checkpoint",       # orbax save boundary
+    "profile",          # on-demand jax.profiler capture window
+})
+
 
 class SpanTracer:
     """Serializes span/instant events to a line-buffered JSONL handle."""
@@ -29,22 +47,33 @@ class SpanTracer:
         self._lock = threading.Lock()
         self._pid = os.getpid()
         self._t0 = time.perf_counter()
+        # Epoch of ts=0, kept for converting FOREIGN timestamps (worker
+        # processes report wall-clock epochs; time.time() is the one
+        # clock all processes on the host share).
+        self._epoch0 = time.time()
+        self._named_pids: set[int] = set()
         self._write({
             "name": "process_name", "ph": "M", "pid": self._pid, "tid": 0,
             "args": {"name": "train"},
         })
         self._write({
             "name": "clock_sync", "ph": "M", "pid": self._pid, "tid": 0,
-            "args": {"unix_epoch_at_ts0": time.time()},
+            "args": {"unix_epoch_at_ts0": self._epoch0},
         })
 
     def now_us(self) -> float:
         return (time.perf_counter() - self._t0) * 1e6
 
     def _write(self, evt: dict) -> None:
-        line = json.dumps(evt, allow_nan=False)
-        with self._lock:
-            self._fh.write(line + "\n")
+        try:
+            line = json.dumps(evt, allow_nan=False)
+            with self._lock:
+                self._fh.write(line + "\n")
+        except (OSError, ValueError):
+            # ENOSPC / closed handle: telemetry must never take the run
+            # down — a span emission failing on the training thread
+            # would otherwise crash a multi-day run over a full disk.
+            pass
 
     def complete(
         self, name: str, start_pc: float, dur_s: float,
@@ -64,6 +93,68 @@ class SpanTracer:
         if args:
             evt["args"] = args
         self._write(evt)
+
+    def name_process(self, pid: int, name: str) -> None:
+        """Emit a process_name metadata event for a FOREIGN pid (e.g. an
+        env-shard worker) so Perfetto labels its lane; idempotent per
+        pid so the relay can call it on every drain."""
+        if pid in self._named_pids:
+            return
+        self._named_pids.add(pid)
+        self._write({
+            "name": "process_name", "ph": "M", "pid": int(pid), "tid": 0,
+            "args": {"name": name},
+        })
+
+    def _foreign_evt(
+        self, name: str, epoch_start: float, dur_s: float,
+        pid: int, tid: int, args: Optional[dict],
+    ) -> dict:
+        evt = {
+            "name": name,
+            "ph": "X",
+            "ts": round((epoch_start - self._epoch0) * 1e6, 1),
+            "dur": round(dur_s * 1e6, 1),
+            "pid": int(pid),
+            "tid": int(tid),
+            "cat": "phase",
+        }
+        if args:
+            evt["args"] = args
+        return evt
+
+    def complete_foreign(
+        self, name: str, epoch_start: float, dur_s: float,
+        pid: int, tid: int = 0, args: Optional[dict] = None,
+    ) -> None:
+        """Emit a ph:"X" event measured in ANOTHER process. `epoch_start`
+        is a `time.time()` reading from that process — converted onto
+        this tracer's ts axis via the epoch anchor recorded at creation,
+        so worker lanes line up with the parent's spans. The record
+        keeps the worker's real pid (its own Perfetto lane)."""
+        self._write(self._foreign_evt(name, epoch_start, dur_s, pid, tid, args))
+
+    def complete_foreign_many(
+        self, items: list[tuple[str, float, float, int, int, Optional[dict]]]
+    ) -> None:
+        """Batched `complete_foreign`: one lock acquisition and ONE write
+        for the whole list of (name, epoch_start, dur_s, pid, tid, args)
+        tuples. The shard-pool relay drains hundreds of per-step records
+        per collection block on the training thread — a write syscall
+        per record would be real hot-loop overhead."""
+        try:
+            lines = [
+                json.dumps(
+                    self._foreign_evt(*item), allow_nan=False
+                )
+                for item in items
+            ]
+            if not lines:
+                return
+            with self._lock:
+                self._fh.write("\n".join(lines) + "\n")
+        except (OSError, ValueError):
+            pass  # same never-take-the-run-down contract as _write
 
     def instant(self, name: str, args: Optional[dict] = None) -> None:
         """Emit a ph:"i" instant event (thread scope) — used to mark

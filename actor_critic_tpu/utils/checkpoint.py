@@ -291,6 +291,10 @@ def checkpointed_train(
         k = stride - it % stride if it % stride else stride
         k = min(k, num_iterations - it)
         watchdog.beat()  # progress heartbeat (utils/watchdog.py)
+        # Dispatch boundary for any armed on-demand profile window
+        # (telemetry/profiler.py; one "iter" here = one chunk at
+        # stride > 1 — the capturable unit of fused work).
+        telemetry.profiler_tick()
         t_dispatch = time.monotonic()
         # The span measures enqueue-to-return, not device wall: a jitted
         # call returns at dispatch, and fencing here would break the

@@ -32,14 +32,27 @@ named_scope = jax.named_scope
 _log = logging.getLogger(__name__)
 
 
+def start_trace(logdir: str) -> None:
+    """Begin a profiler capture into `logdir` (Perfetto trace included).
+    Split out of `trace` so windowed captures that cannot hold a context
+    manager open across loop iterations (telemetry/profiler.py's
+    on-demand `/profile?iters=N` window) share the same configuration."""
+    jax.profiler.start_trace(logdir, create_perfetto_trace=True)
+
+
+def stop_trace() -> None:
+    """End the capture `start_trace` opened."""
+    jax.profiler.stop_trace()
+
+
 @contextlib.contextmanager
 def trace(logdir: str):
     """`with trace("runs/prof"):` around the iterations to profile."""
-    jax.profiler.start_trace(logdir, create_perfetto_trace=True)
+    start_trace(logdir)
     try:
         yield
     finally:
-        jax.profiler.stop_trace()
+        stop_trace()
 
 
 def time_fn(

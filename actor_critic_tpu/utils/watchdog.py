@@ -25,6 +25,7 @@ import os
 import sys
 import threading
 import time
+from typing import Optional
 
 STALL_EXIT_CODE = 42
 
@@ -43,6 +44,25 @@ def armed() -> bool:
     watchdog-only work, e.g. the chunk-wall measurement block in
     checkpointed_train that would otherwise cost async pipelining)."""
     return bool(_ACTIVE)
+
+
+def status() -> Optional[dict]:
+    """Staleness snapshot of the armed watchdog for live introspection
+    (telemetry/exporter.py's /healthz): seconds since the last heartbeat,
+    the configured timeout, and whether the startup grace still shields
+    firing. None when no watchdog is armed. With several armed (tests),
+    reports the one CLOSEST TO FIRING — staleness relative to its own
+    timeout, not raw staleness (a 200s-stale 10s-timeout watchdog fires
+    long before a 300s-stale 600s-timeout one)."""
+    if not _ACTIVE:
+        return None
+    now = time.monotonic()
+    w = max(_ACTIVE, key=lambda w: (now - w._last) - w.timeout_s)
+    return {
+        "staleness_s": round(now - w._last, 3),
+        "timeout_s": w.timeout_s,
+        "in_grace": now <= w._grace_until,
+    }
 
 
 def extend_grace(secs: float) -> None:

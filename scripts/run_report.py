@@ -29,21 +29,39 @@ import sys
 
 
 def read_jsonl(path: str) -> list[dict]:
-    """Rows of a JSONL file; a torn final line (process killed
-    mid-write, the exact scenario telemetry exists to explain) is
-    dropped rather than aborting the report."""
+    """Rows of a JSONL file. A torn FINAL line (process killed
+    mid-write — the exact scenario telemetry exists to explain) is
+    dropped silently; undecodable lines anywhere else mean real
+    corruption, so they are dropped with one stderr note naming the
+    file and count instead of aborting the report."""
     rows: list[dict] = []
     if not os.path.exists(path):
         return rows
+    # Streamed, not materialized: a sharded run's spans.jsonl can be
+    # hundreds of MB (one relayed span per worker per batch step), and
+    # holding raw lines AND parsed rows would double peak memory. A bad
+    # line is only counted once a LATER non-blank line proves it wasn't
+    # the file's final (torn) one.
+    bad_interior = 0
+    last_bad = False
     with open(path) as f:
         for line in f:
             line = line.strip()
             if not line:
                 continue
+            if last_bad:
+                bad_interior += 1
+                last_bad = False
             try:
                 rows.append(json.loads(line))
             except json.JSONDecodeError:
-                continue
+                last_bad = True
+    if bad_interior:
+        print(
+            f"warning: {path}: dropped {bad_interior} undecodable "
+            "non-final line(s)",
+            file=sys.stderr,
+        )
     return rows
 
 
@@ -105,6 +123,11 @@ def phase_breakdown(spans: list[dict]) -> list[str]:
     instants = [e for e in spans if e.get("ph") == "i"]
     if not complete and not instants:
         return ["*(no span events)*"]
+    # Relayed worker-lane spans run in W processes CONCURRENT with the
+    # parent's iteration wall: summing them into a table whose shares
+    # are of parent wall would print >100% rows. Summarize them apart.
+    workers = [e for e in complete if e.get("name") == "env_step_worker"]
+    complete = [e for e in complete if e.get("name") != "env_step_worker"]
     iters = [e for e in complete if e.get("name") == "iteration"]
     iter_total_us = sum(float(e.get("dur", 0.0)) for e in iters)
     phases: dict[str, dict] = {}
@@ -140,6 +163,22 @@ def phase_breakdown(spans: list[dict]) -> list[str]:
             f"| {name} | {p['count']} | {_fmt_s(p['total_us'] / 1e6)} "
             f"| {_fmt_s(p['total_us'] / 1e6 / p['count'])} "
             f"| {_fmt_s(p['max_us'] / 1e6)} | {pct:.1f}% |"
+        )
+    if workers:
+        by_pid: dict = {}
+        for e in workers:
+            p = by_pid.setdefault(e.get("pid"), [0, 0.0])
+            p[0] += 1
+            p[1] += float(e.get("dur", 0.0))
+        out.append("")
+        out.append(
+            f"Env-pool worker lanes (concurrent with the table above, "
+            f"so not in its shares): {len(by_pid)} worker process(es), "
+            + ", ".join(
+                f"pid {pid}: {n} steps / {_fmt_s(d / 1e6)} busy"
+                for pid, (n, d) in sorted(by_pid.items())
+            )
+            + " — per-step detail in the Perfetto trace."
         )
     if instants:
         by_name: dict[str, int] = {}
@@ -211,8 +250,156 @@ def resource_summary(rows: list[dict]) -> list[str]:
     return out
 
 
+def compile_attribution(rows: list[dict]) -> list[str]:
+    """Markdown lines for the recompile-attribution table: `compile`
+    events (telemetry/profiler.py's compile listener) grouped by jitted
+    function, with compile wall, cost_analysis() FLOPs, and — the
+    recompile-storm diagnosis — the DISTINCT abstract argument
+    signatures seen, so a function compiled 40 times shows exactly which
+    arg shape/dtype kept changing."""
+    comps = [r for r in rows if r.get("kind") == "compile"]
+    if not comps:
+        return [
+            "*(no `compile` events — run predates the compile listener, "
+            "or the JAX compile funnel was unavailable; the resource "
+            "sampler's recompile counter above still applies)*"
+        ]
+    by_name: dict[str, dict] = {}
+    for r in comps:
+        g = by_name.setdefault(
+            r.get("name", "?"),
+            {"count": 0, "total_s": 0.0, "flops": None, "sigs": []},
+        )
+        g["count"] += 1
+        g["total_s"] += float(r.get("compile_s", 0.0))
+        if r.get("flops") is not None:
+            g["flops"] = float(r["flops"])  # last compile's program
+        sig = r.get("signature")
+        if sig is not None and sig not in g["sigs"]:
+            g["sigs"].append(sig)
+    # The listener hooks the compile funnel, which persistent-cache
+    # HITS also pass through (near-zero wall) — call those out so a
+    # warm-cache run isn't misread as a recompile storm when the
+    # jax.monitoring counter (Resources section) stays low.
+    fast = sum(
+        1 for r in comps if float(r.get("compile_s", 0.0)) < 0.01
+    )
+    fast_note = (
+        f" ({fast} under 10 ms — likely compilation-cache hits, "
+        "not real recompiles)" if fast else ""
+    )
+    out = [
+        f"{len(comps)} XLA compilation(s), "
+        f"{_fmt_s(sum(g['total_s'] for g in by_name.values()))} total "
+        f"compile wall{fast_note}.",
+        "",
+        "| function | compiles | compile wall | FLOPs/call | distinct arg signatures |",
+        "|---|---:|---:|---:|---:|",
+    ]
+    for name, g in sorted(by_name.items(), key=lambda kv: -kv[1]["total_s"]):
+        flops = f"{g['flops']:.3g}" if g["flops"] is not None else "n/a"
+        out.append(
+            f"| `{name}` | {g['count']} | {_fmt_s(g['total_s'])} "
+            f"| {flops} | {len(g['sigs'])} |"
+        )
+    # Name the churn: a function with one signature compiled once is
+    # startup; several signatures is shape/dtype churn worth reading.
+    for name, g in sorted(by_name.items(), key=lambda kv: -kv[1]["total_s"]):
+        if len(g["sigs"]) > 1:
+            out.append("")
+            out.append(
+                f"`{name}` recompiled under {len(g['sigs'])} argument "
+                "signatures (shape/dtype churn):"
+            )
+            out.extend(f"- `{s}`" for s in g["sigs"][:8])
+            if len(g["sigs"]) > 8:
+                out.append(f"- … {len(g['sigs']) - 8} more")
+    return out
+
+
+def slowest_spans(spans: list[dict], k: int = 10) -> list[str]:
+    """Top-K complete spans by raw duration — the individual stalls a
+    phase MEAN hides (one 40 s checkpoint inside 500 × 80 ms ones).
+    Container spans are excluded: an `iteration` always outlasts every
+    phase inside it (and a `profile` window spans several iterations),
+    so ranking them would fill the table with enclosures instead of the
+    slow phases the section exists to surface."""
+    containers = {"iteration", "profile"}
+    complete = [
+        e for e in spans
+        if e.get("ph") == "X" and e.get("name") not in containers
+    ]
+    if not complete:
+        return ["*(no span events)*"]
+    top = sorted(
+        complete, key=lambda e: -float(e.get("dur", 0.0))
+    )[:max(k, 1)]
+    out = [
+        "| rank | phase | duration | start | pid | args |",
+        "|---:|---|---:|---:|---:|---|",
+    ]
+    for i, e in enumerate(top, 1):
+        args = json.dumps(e.get("args", {}), default=str)
+        if len(args) > 60:
+            args = args[:57] + "…"
+        out.append(
+            f"| {i} | {e.get('name', '?')} "
+            f"| {_fmt_s(float(e.get('dur', 0.0)) / 1e6)} "
+            f"| +{_fmt_s(float(e.get('ts', 0.0)) / 1e6)} "
+            f"| {e.get('pid', '?')} | `{args}` |"
+        )
+    return out
+
+
+def profile_captures(rows: list[dict], telemetry_dir: str) -> list[str]:
+    """Links to on-demand profile captures: `profile_done` events plus
+    any profile_* directories present on disk that lack an event (a
+    capture cut short by a kill still leaves its directory)."""
+    # Keyed by BASENAME, not raw path: the events record the path the
+    # training process used, which may be relative (or under a
+    # since-moved root) while the report runs against the absolute dir —
+    # a raw-string match would list one capture twice, once mislabeled
+    # as interrupted. profile_NNN names are unique per telemetry dir.
+    seen: dict[str, dict] = {}
+    for r in rows:
+        if r.get("kind") == "profile_done" and r.get("path"):
+            seen[os.path.basename(os.path.normpath(str(r["path"])))] = r
+    import glob as _glob
+
+    on_disk = {
+        os.path.basename(os.path.normpath(p)): p
+        for p in _glob.glob(os.path.join(telemetry_dir, "profile_*"))
+    }
+    if not seen and not on_disk:
+        return [
+            "*(no captures — arm one on a live run with "
+            "`curl localhost:PORT/profile?iters=5` or `kill -USR2 <pid>`)*"
+        ]
+    out = []
+    for base in sorted(set(seen) | set(on_disk)):
+        r = seen.get(base)
+        path = on_disk.get(base) or str(r["path"])
+        detail = (
+            f" — {_fmt_s(float(r['wall_s']))} captured"
+            if r is not None and "wall_s" in r
+            else " — no profile_done event (capture interrupted?)"
+        )
+        out.append(f"- `{path}`{detail}")
+    out.append("")
+    out.append(
+        "*Open a capture: `tensorboard --logdir <dir>` (Profile tab) or "
+        "load its `perfetto_trace.json.gz` at https://ui.perfetto.dev.*"
+    )
+    return out
+
+
 def event_summary(rows: list[dict]) -> list[str]:
-    lifecycle = {"session_start", "session_end"}
+    # Diagnostic streams get their own report sections; listing each
+    # compile/profile row here would drown the health table.
+    lifecycle = {
+        "session_start", "session_end", "exporter_start",
+        "compile", "profile_start", "profile_done", "profile_failed",
+    }
     health = [r for r in rows if r.get("kind") not in lifecycle]
     starts = [r for r in rows if r.get("kind") == "session_start"]
     out = []
@@ -305,7 +492,16 @@ def render(
     lines = [f"# Run report — `{telemetry_dir}`", ""]
     lines += ["## Events & health", ""] + event_summary(events) + [""]
     lines += ["## Phase breakdown", ""] + phase_breakdown(spans) + [""]
+    lines += ["## Slowest spans", ""] + slowest_spans(spans) + [""]
     lines += ["## Resources", ""] + resource_summary(resources) + [""]
+    lines += (
+        ["## Recompile attribution", ""] + compile_attribution(events) + [""]
+    )
+    lines += (
+        ["## Profile captures", ""]
+        + profile_captures(events, telemetry_dir)
+        + [""]
+    )
     if metrics_path is None:
         cand = os.path.join(telemetry_dir, "metrics.jsonl")
         metrics_path = cand if os.path.exists(cand) else None

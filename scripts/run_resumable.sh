@@ -33,8 +33,9 @@ args=()
 takes_value() {
   case "$1" in
     --preset|--algo|--env|--iterations|--seed|--set|--env-set|--metrics|\
-    --telemetry-dir|--log-every|--chunk|--eval-every|--eval-envs|\
-    --eval-steps|--workers|--ckpt-dir|--save-every|--stall-timeout)
+    --telemetry-dir|--telemetry-port|--telemetry-sample-s|--log-every|\
+    --chunk|--eval-every|--eval-envs|--eval-steps|--workers|--ckpt-dir|\
+    --save-every|--stall-timeout)
       return 0 ;;
   esac
   return 1

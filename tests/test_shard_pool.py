@@ -153,9 +153,13 @@ def test_ppo_host_resume_with_sharded_pool(tmp_path):
 
 
 def test_sharded_pool_telemetry(tmp_path):
-    """host_collect must emit one env_step_worker span per worker per
-    collection block, and the sampler row must carry the pool gauge while
-    the pool lives (and drop it after close)."""
+    """The worker→parent relay must land one env_step_worker span per
+    worker per BATCH STEP in spans.jsonl, carrying each worker's REAL
+    pid (its own Perfetto lane, ≠ the parent's) and a process_name
+    metadata label; the sampler row must carry the pool gauge while the
+    pool lives (and drop it after close)."""
+    import os
+
     from actor_critic_tpu import telemetry
     from actor_critic_tpu.algos import ppo
     from actor_critic_tpu.telemetry.sampler import sample_row
@@ -176,16 +180,23 @@ def test_sharded_pool_telemetry(tmp_path):
 
     with open(tmp_path / "spans.jsonl") as f:
         events = [json.loads(line) for line in f if line.strip()]
-    workers_seen = {
-        e["args"]["worker"]
-        for e in events
+    spans = [
+        e for e in events
         if e.get("name") == "env_step_worker" and e["ph"] == "X"
+    ]
+    assert {e["args"]["worker"] for e in spans} == {0, 1}
+    # Relayed records: one span per worker per batch step, REAL pids.
+    assert len(spans) == 2 * 2 * cfg.rollout_steps, len(spans)
+    pids = {e["pid"] for e in spans}
+    assert len(pids) == 2 and os.getpid() not in pids, pids
+    assert all(e["dur"] >= 0 and e["ts"] >= 0 for e in spans)
+    # Each worker lane is labeled for Perfetto.
+    labels = {
+        e["pid"]: e["args"]["name"]
+        for e in events
+        if e.get("ph") == "M" and e.get("name") == "process_name"
     }
-    assert workers_seen == {0, 1}, workers_seen
-    spans = [e for e in events if e.get("name") == "env_step_worker"]
-    # one span per worker per iteration block
-    assert len(spans) == 2 * 2, spans
-    assert all(e["dur"] >= 0 for e in spans)
+    assert {labels.get(p) for p in pids} == {"env-shard-0", "env-shard-1"}
 
 
 def test_block_buffers_double_buffer_and_reuse():

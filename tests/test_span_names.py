@@ -1,0 +1,80 @@
+"""Static check: every phase-span name in the codebase is canonical.
+
+`scripts/run_report.py`'s phase breakdown groups spans by NAME — a
+typo'd `telemetry.span("updaet")` raises nowhere and simply grows a
+one-off row that silently vanishes from every aggregate people actually
+read. This test greps the source for every literal name passed to
+`telemetry.span(...)` / `complete_span(...)` / `instant(...)` (and the
+tracer-level `complete_foreign(...)` the shard-pool relay uses) and
+asserts membership in `telemetry.CANONICAL_PHASES`. Add new phases to
+that set (telemetry/spans.py) BEFORE instrumenting with them.
+"""
+
+import re
+from pathlib import Path
+
+from actor_critic_tpu import telemetry
+
+REPO = Path(__file__).parent.parent
+
+# Source that emits phase spans; tests are excluded on purpose — they
+# exercise the tracer with synthetic names.
+SCAN = ["actor_critic_tpu", "scripts", "train.py", "bench.py", "bench"]
+
+_CALL = re.compile(
+    r"""(?:telemetry|_session)\s*\.\s*
+        (?:span|complete_span|instant)\s*\(\s*
+        (['"])(?P<name>[^'"]+)\1
+    """,
+    re.VERBOSE,
+)
+_FOREIGN = re.compile(
+    r"""\.\s*complete_foreign\s*\(\s*(['"])(?P<name>[^'"]+)\1""",
+    re.VERBOSE,
+)
+# Phase names bound to a constant before use (e.g. the shard-pool
+# relay's batched emission) declare themselves with a *_PHASE suffix.
+_CONST = re.compile(
+    r"""^\s*\w+_PHASE\s*=\s*(['"])(?P<name>[^'"]+)\1""",
+    re.MULTILINE,
+)
+
+
+def _span_names() -> dict[str, set[str]]:
+    """{span name: {files using it}} across the scanned source."""
+    uses: dict[str, set[str]] = {}
+    for root in SCAN:
+        path = REPO / root
+        files = [path] if path.is_file() else sorted(path.rglob("*.py"))
+        for f in files:
+            text = f.read_text()
+            for pat in (_CALL, _FOREIGN, _CONST):
+                for m in pat.finditer(text):
+                    uses.setdefault(m.group("name"), set()).add(
+                        str(f.relative_to(REPO))
+                    )
+    return uses
+
+
+def test_every_span_name_is_canonical():
+    uses = _span_names()
+    assert uses, "scanner found no span call sites — regex rotted?"
+    rogue = {
+        name: sorted(files)
+        for name, files in uses.items()
+        if name not in telemetry.CANONICAL_PHASES
+    }
+    assert not rogue, (
+        f"non-canonical span name(s) {rogue} — add to "
+        "telemetry/spans.py CANONICAL_PHASES or fix the typo"
+    )
+
+
+def test_core_phases_are_instrumented():
+    """The phases the run report's breakdown documents must actually be
+    emitted somewhere (guards against an instrumentation refactor
+    silently dropping one)."""
+    uses = _span_names()
+    for phase in ("iteration", "env_step", "update", "log", "checkpoint",
+                  "eval", "host_to_device", "env_step_worker"):
+        assert phase in uses, f"phase {phase!r} no longer instrumented"

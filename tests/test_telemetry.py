@@ -123,6 +123,18 @@ def test_sampler_rows_are_monotone(tmp_path):
     assert all(r["rss_bytes"] > 0 for r in rows if "rss_bytes" in r)
 
 
+def test_session_plumbs_sampler_cadence(tmp_path):
+    """`train.py --telemetry-sample-s` overrides the 5 s default via
+    TelemetrySession(resource_interval_s=...)."""
+    with telemetry.TelemetrySession(
+        tmp_path, resource_interval_s=0.02
+    ) as s:
+        assert s.sampler is not None and s.sampler._interval == 0.02
+        time.sleep(0.1)
+    rows = _read_jsonl(tmp_path / "resources.jsonl")
+    assert len(rows) >= 3  # the faster cadence actually ticked
+
+
 def test_sample_row_shape():
     row = sample_row()
     assert set(row) >= {"ts", "recompiles"}
@@ -180,6 +192,42 @@ def test_throughput_monitor_quiet_on_checkpoint_blips():
         t += 5.0 if it % 7 == 0 else 1.0  # save blip every 7th window
         m.observe(it, {}, t)
     assert fired == []
+
+
+def test_throughput_monitor_threshold_boundary():
+    """drop_threshold=0.5 means the floor is half the EMA: a sustained
+    rate just ABOVE the floor must stay quiet, just BELOW must fire —
+    the trigger/no-trigger edge the flag documents. ema_alpha=0 freezes
+    the EMA at the baseline rate so the floor is exactly 0.5 iter/s
+    (with the default alpha the EMA tracks a mild slowdown down and a
+    45% rate stops counting as regressed — adaptive by design)."""
+    for rate_frac, should_fire in ((0.55, False), (0.45, True)):
+        fired = []
+        m = ThroughputMonitor(
+            lambda kind, **f: fired.append(kind),
+            drop_threshold=0.5, warmup_observations=2, ema_alpha=0.0,
+        )
+        t = 0.0
+        for it in range(1, 10):  # steady 1 iter/s baseline
+            t += 1.0
+            m.observe(it, {}, t)
+        for it in range(10, 16):  # sustained slowdown at rate_frac
+            t += 1.0 / rate_frac
+            m.observe(it, {}, t)
+        assert bool(fired) == should_fire, (rate_frac, fired)
+
+
+def test_divergence_monitor_collapse_boundary():
+    """collapse_frac=0.1 of best=100: 11 (above the line) must stay
+    quiet, 9 (below) must fire."""
+    for value, should_fire in ((11.0, False), (9.0, True)):
+        fired = []
+        m = DivergenceMonitor(
+            lambda kind, **f: fired.append(kind), collapse_frac=0.1
+        )
+        m.observe(0, {"avg_return_ema": 100.0})
+        m.observe(1, {"avg_return_ema": value})
+        assert bool(fired) == should_fire, (value, fired)
 
 
 def test_divergence_monitor_nonfinite_loss():
@@ -243,6 +291,36 @@ def test_stall_report_names_open_span(tmp_path):
     assert len(stall) == 1
     assert stall[0]["phase"] == "update" and stall[0]["stalled_s"] == 12.3
     assert telemetry.stall_report() == ""  # no open span → empty clause
+
+
+def test_stall_report_names_deepest_open_span(tmp_path):
+    """Under nesting the diagnosis must name the INNERMOST open span —
+    the phase actually executing when progress stopped — not the
+    enclosing iteration."""
+    with telemetry.TelemetrySession(tmp_path, sample_resources=False):
+        with telemetry.span("iteration", it=3):
+            with telemetry.span("env_step", steps=64):
+                msg = telemetry.stall_report(7.0)
+    assert "'env_step'" in msg and "'iteration'" not in msg, msg
+    stall = [
+        r for r in _read_jsonl(tmp_path / "events.jsonl")
+        if r["kind"] == "stall"
+    ]
+    assert len(stall) == 1 and stall[0]["phase"] == "env_step"
+
+
+def test_health_events_are_fsynced(tmp_path, monkeypatch):
+    """A health event() must flush+fsync the sinks (SIGKILL durability):
+    count fsync calls on the events file descriptor."""
+    synced = []
+    real_fsync = os.fsync
+    monkeypatch.setattr(os, "fsync", lambda fd: (synced.append(fd),
+                                                 real_fsync(fd))[1])
+    with telemetry.TelemetrySession(tmp_path, sample_resources=False) as s:
+        s.event("session_note")  # lifecycle: no fsync required
+        assert synced == []
+        s.observe(1, {"loss": float("nan")})  # divergence → durable
+    assert len(synced) >= 3  # all three sinks synced at least once
 
 
 def test_watchdog_exit42_diagnosis_includes_open_span(tmp_path):
@@ -348,6 +426,98 @@ def test_run_report_stitches_resume_segments(tmp_path):
     ts = [e["ts"] for e in json.load(open(tmp_path / "trace.json"))["traceEvents"]
           if e["ph"] == "X"]
     assert ts == [0.0, 60.0 * 1e6]  # segment 2 shifted by the epoch gap
+
+
+def test_read_jsonl_tolerates_torn_final_line(tmp_path, capsys):
+    """A half-written final record (SIGKILL mid-write) must cost exactly
+    that record, silently; undecodable INTERIOR lines are dropped too
+    but announced on stderr (they mean real corruption, not a kill)."""
+    p = tmp_path / "events.jsonl"
+    p.write_text(
+        json.dumps({"kind": "a"}) + "\n"
+        + json.dumps({"kind": "b"}) + "\n"
+        + '{"kind": "stall", "stalled_s": 3'  # torn: no close, no newline
+    )
+    rows = run_report.read_jsonl(str(p))
+    assert [r["kind"] for r in rows] == ["a", "b"]
+    assert capsys.readouterr().err == ""  # torn tail is expected, quiet
+
+    p.write_text(
+        json.dumps({"kind": "a"}) + "\n"
+        + "{corrupt\n"
+        + json.dumps({"kind": "c"}) + "\n"
+    )
+    rows = run_report.read_jsonl(str(p))
+    assert [r["kind"] for r in rows] == ["a", "c"]
+    assert "1 undecodable" in capsys.readouterr().err
+
+
+def test_run_report_recompile_attribution_and_slowest_spans(tmp_path):
+    """The report's new sections: compile events group into the
+    attribution table naming distinct arg signatures, the slowest-spans
+    table ranks raw durations, and profile_done events become links."""
+    (tmp_path / "spans.jsonl").write_text(
+        "".join(
+            json.dumps({"name": n, "ph": "X", "ts": float(i), "dur": d,
+                        "pid": 1, "tid": 1}) + "\n"
+            for i, (n, d) in enumerate(
+                [("update", 10.0), ("checkpoint", 4e7), ("update", 30.0)]
+            )
+        )
+    )
+    sig_a = "(tensor<8x3xf32>) -> tensor<8x8xf32>"
+    sig_b = "(tensor<16x3xf32>) -> tensor<16x16xf32>"
+    (tmp_path / "events.jsonl").write_text(
+        "".join(
+            json.dumps(r) + "\n"
+            for r in [
+                {"ts": 1.0, "kind": "session_start"},
+                {"ts": 2.0, "kind": "compile", "name": "jit_update",
+                 "compile_s": 2.0, "flops": 1e9, "signature": sig_a},
+                {"ts": 3.0, "kind": "compile", "name": "jit_update",
+                 "compile_s": 3.0, "flops": 4e9, "signature": sig_b},
+                {"ts": 4.0, "kind": "profile_done",
+                 "path": str(tmp_path / "profile_001"), "wall_s": 1.5},
+            ]
+        )
+    )
+    report = run_report.render(str(tmp_path))
+    assert "## Recompile attribution" in report
+    assert "| `jit_update` | 2 | 5.00s" in report, report
+    assert "2 argument signatures" in report
+    assert sig_a in report and sig_b in report
+    assert "## Slowest spans" in report
+    slow_sec = report.split("## Slowest spans")[1].split("##")[0]
+    # checkpoint (40 s) outranks both updates
+    assert slow_sec.splitlines()[4].startswith("| 1 | checkpoint | 40.00s")
+    assert "## Profile captures" in report
+    assert "profile_001" in report
+    # compile/profile diagnostics must NOT flood the health table
+    assert "| **compile**" not in report and "| **profile_done**" not in report
+
+
+def test_phase_breakdown_separates_worker_lanes():
+    """Relayed env_step_worker spans run in W processes CONCURRENT with
+    the parent iteration wall: they must not enter the share table
+    (workers=4 at ~90% busy would print a 360% row) — they get their
+    own per-pid summary line instead."""
+    spans = [
+        {"name": "iteration", "ph": "X", "ts": 0.0, "dur": 100.0,
+         "pid": 1, "tid": 1},
+        {"name": "env_step", "ph": "X", "ts": 5.0, "dur": 90.0,
+         "pid": 1, "tid": 1},
+    ] + [
+        {"name": "env_step_worker", "ph": "X", "ts": float(10 * i),
+         "dur": 80.0, "pid": pid, "tid": 0, "args": {"worker": pid - 100}}
+        for pid in (100, 101, 102, 103)
+        for i in range(2)
+    ]
+    lines = "\n".join(run_report.phase_breakdown(spans))
+    assert "| env_step_worker" not in lines
+    assert "4 worker process(es)" in lines
+    assert "pid 100: 2 steps" in lines
+    # shares stay interpretable: the only table row is env_step at 90%
+    assert "90.0%" in lines and "360" not in lines
 
 
 def test_run_report_cli(tmp_path):

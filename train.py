@@ -443,6 +443,25 @@ def main(argv=None) -> int:
         "near-free; this flag only adds the file sinks + the resource "
         "sampler thread.",
     )
+    p.add_argument(
+        "--telemetry-port", type=int, default=None, metavar="PORT",
+        help="live run introspection: serve GET /metrics (Prometheus "
+        "text: RSS, device memory, XLA recompiles, sampler gauges, last "
+        "training row, steps/s), /healthz (watchdog staleness + open "
+        "span; 503 when stalled), and /profile?iters=N (arm an "
+        "on-demand jax.profiler capture) on 127.0.0.1:PORT from a "
+        "daemon thread (telemetry/exporter.py). 0 picks an ephemeral "
+        "port (printed at startup). Requires --telemetry-dir (profile "
+        "captures land there). SIGUSR2 also arms a capture.",
+    )
+    p.add_argument(
+        "--telemetry-sample-s", type=float, default=5.0, metavar="SECS",
+        help="cadence of the telemetry resource sampler thread "
+        "(resources.jsonl rows; default 5 s). Only meaningful with "
+        "--telemetry-dir. NB: the shard pool's utilization gauge "
+        "recomputes over windows of at least 1 s, so sub-second "
+        "cadences repeat its previous value between recomputes.",
+    )
     p.add_argument("--log-every", type=int, default=10)
     p.add_argument(
         "--chunk", type=int, default=1,
@@ -507,6 +526,14 @@ def main(argv=None) -> int:
     )
     p.add_argument("--list-presets", action="store_true")
     args = p.parse_args(argv)
+    if args.telemetry_port is not None and not args.telemetry_dir:
+        raise SystemExit(
+            "--telemetry-port requires --telemetry-dir (the exporter "
+            "serves the session's sinks and /profile captures land in "
+            "that directory)"
+        )
+    if args.telemetry_sample_s <= 0:
+        raise SystemExit("--telemetry-sample-s must be > 0")
 
     from actor_critic_tpu.config import (
         PRESETS, parse_env_set_args, parse_set_args, resolve,
@@ -560,8 +587,21 @@ def main(argv=None) -> int:
                 "seed": args.seed,
                 "config": dataclasses.asdict(preset.config),
             },
+            resource_interval_s=args.telemetry_sample_s,
+            serve_port=args.telemetry_port,
         )
         telemetry.set_current(telemetry_session)
+        if telemetry_session.exporter is not None:
+            print(
+                f"telemetry exporter: {telemetry_session.exporter.url}"
+                "/metrics /healthz /profile?iters=N",
+                flush=True,
+            )
+        # `kill -USR2 <pid>` arms an on-demand profile capture even when
+        # no --telemetry-port was given.
+        from actor_critic_tpu.telemetry.profiler import install_sigusr2
+
+        install_sigusr2()
 
     watchdog = None
     if args.stall_timeout > 0:
