@@ -284,3 +284,11 @@ def train(
         seed=seed, state=state, log_every=log_every, log_fn=log_fn,
         scan_when_silent=True,
     )
+
+
+# -- AOT warmup registry (utils/compile_cache.py, ISSUE 4) ------------------
+from actor_critic_tpu.utils import compile_cache as _compile_cache  # noqa: E402
+
+_compile_cache.register_fused_warmups(
+    "a2c", ("a2c",), init_state, make_train_step, make_eval_fn
+)

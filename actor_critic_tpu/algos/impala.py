@@ -476,3 +476,13 @@ def train(
         seed=seed, state=state, log_every=log_every, log_fn=log_fn,
         scan_when_silent=True,
     )
+
+
+# -- AOT warmup registry (utils/compile_cache.py, ISSUE 4) ------------------
+# The sp (mesh-sharded) programs are exempt from warmup: they are built
+# only by the explicit parallel drivers (see compile_cache.EXEMPT).
+from actor_critic_tpu.utils import compile_cache as _compile_cache  # noqa: E402
+
+_compile_cache.register_fused_warmups(
+    "impala", ("impala", "a3c"), init_state, make_train_step, make_eval_fn
+)

@@ -41,6 +41,7 @@ from actor_critic_tpu.models.networks import ActorCriticDiscrete, ActorCriticGau
 from actor_critic_tpu.ops.pallas_scan import gae_auto as gae
 from actor_critic_tpu.ops.returns import normalize_advantages
 from actor_critic_tpu.parallel import mesh as pmesh
+from actor_critic_tpu.utils import compile_cache as _compile_cache
 
 
 @dataclasses.dataclass(frozen=True)
@@ -549,6 +550,95 @@ def train_host(
     if ckpt is not None:
         ckpt.wait()  # the final async save must be durable before return
     return params, opt_state, history
+
+
+def _abstract_host_params(spec, cfg: PPOConfig):
+    """(params, opt_state) shape/dtype trees via eval_shape — the same
+    constructor the host loop uses, no device allocation."""
+    from functools import partial as _partial
+
+    return jax.eval_shape(
+        _partial(init_host_params, spec, cfg), jax.random.key(0)
+    )
+
+
+@_compile_cache.register_warmup("ppo.make_policy_step")
+def _warmup_policy_step(ctx):
+    if ctx.fused or ctx.algo != "ppo":
+        return None
+    params_abs, _ = _abstract_host_params(ctx.spec, ctx.cfg)
+    if _compile_cache.mirror_active(ctx, params_abs):
+        return None  # the numpy mirror acts; this program never runs
+    jitted = make_policy_step(ctx.spec, ctx.cfg)
+    obs = _compile_cache.host_obs_struct(ctx, (ctx.cfg.num_envs,))
+    key = _compile_cache.key_struct()
+    return lambda: _compile_cache.aot_compile(jitted, params_abs, obs, key)
+
+
+@_compile_cache.register_warmup("ppo.make_host_update_step")
+def _warmup_host_update(ctx):
+    if ctx.fused or ctx.algo != "ppo":
+        return None
+    import numpy as np
+
+    cfg, spec = ctx.cfg, ctx.spec
+    T, E = cfg.rollout_steps, cfg.num_envs
+    params_abs, opt_abs = _abstract_host_params(spec, cfg)
+    mirror = _compile_cache.mirror_active(ctx, params_abs)
+    s = _compile_cache.array_struct
+    if spec.discrete:
+        # The mirror samples with np.argmax (int64); the device policy
+        # with jax.random.categorical (int32) — the recorded block, and
+        # therefore the update's signature, follows the acting path.
+        action = s((T, E), np.int64 if mirror else np.int32)
+    else:
+        action = s((T, E, spec.action_dim), np.float32)
+    args = [
+        params_abs, opt_abs,
+        _compile_cache.host_obs_struct(ctx, (T, E)),        # obs
+        action,
+        s((T, E), np.float32), s((T, E), np.float32),       # log_prob, value
+        s((T, E), np.float32), s((T, E), np.float32),       # reward, done
+        s((T, E), np.float32),                              # terminated
+        _compile_cache.host_obs_struct(ctx, (T, E)),        # final_obs
+        _compile_cache.host_obs_struct(ctx, (E,)),          # last_obs
+        _compile_cache.key_struct(),
+    ]
+    kwargs = {}
+    if mirror:
+        kwargs["final_values"] = s((T, E), np.float32)
+        kwargs["bootstrap_value"] = s((E,), np.float32)
+    if cfg.anneal_iters > 0:
+        kwargs["progress"] = s((), np.float32)
+    jitted = make_host_update_step(spec, cfg, can_truncate=True)
+    return lambda: _compile_cache.aot_compile(jitted, *args, **kwargs)
+
+
+@_compile_cache.register_warmup("ppo.make_greedy_act")
+def _warmup_greedy_act(ctx):
+    if ctx.fused or ctx.algo != "ppo" or ctx.eval_every <= 0:
+        return None
+    params_abs, _ = _abstract_host_params(ctx.spec, ctx.cfg)
+    if _compile_cache.greedy_mirror_active(params_abs):
+        return None  # eval mirrors on the host; this program never runs
+    obs = _compile_cache.host_obs_struct(ctx, (ctx.eval_envs,))
+    return _compile_cache.jitted_thunk(
+        make_greedy_act(ctx.spec, ctx.cfg), params_abs, obs
+    )
+
+
+@_compile_cache.register_warmup("ppo.make_train_step")
+def _warmup_fused_step(ctx):
+    if not ctx.fused or ctx.algo != "ppo":
+        return None
+    return _compile_cache.fused_step_thunk(ctx, init_state, make_train_step)
+
+
+@_compile_cache.register_warmup("ppo.make_eval_fn")
+def _warmup_fused_eval(ctx):
+    if not ctx.fused or ctx.algo != "ppo":
+        return None
+    return _compile_cache.fused_eval_thunk(ctx, init_state, make_eval_fn)
 
 
 def make_train_step(

@@ -442,3 +442,18 @@ def train_host(
         make_host_greedy=make_sac_host_greedy,
         save_replay=save_replay,
     )
+
+
+# -- AOT warmup registry (utils/compile_cache.py, ISSUE 4) ------------------
+from actor_critic_tpu.utils import compile_cache as _compile_cache  # noqa: E402
+
+_compile_cache.register_offpolicy_warmups(
+    "sac", ("sac",),
+    init_learner=init_learner,
+    make_host_act_fn=make_host_act_fn,
+    make_host_ingest_update=make_host_ingest_update,
+    make_greedy_act=make_greedy_act,
+    init_state=init_state,
+    make_train_step=make_train_step,
+    make_eval_fn=make_eval_fn,
+)

@@ -94,6 +94,26 @@ def render_metrics(session: "TelemetrySession") -> str:
         "XLA backend compilations observed by jax.monitoring",
         [_line(_metric_name("xla_recompiles_total"), row.get("recompiles", 0))],
     )
+    # Persistent compilation cache (utils/compile_cache.py): hit/miss
+    # counters plus whether a cache dir is enabled — the live view of
+    # "is this leg compile-free" (ISSUE 4).
+    from actor_critic_tpu.utils import compile_cache
+
+    cstats = compile_cache.cache_stats()
+    for field in ("hits", "misses"):
+        name = _metric_name("compile_cache", f"{field}_total")
+        emit(
+            name, "counter",
+            f"persistent compilation cache {field} "
+            "(jax.monitoring cache events)",
+            [_line(name, cstats[field])],
+        )
+    name = _metric_name("compile_cache_enabled")
+    emit(
+        name, "gauge",
+        "1 when a persistent compilation cache dir is configured",
+        [_line(name, int(compile_cache.enabled_dir() is not None))],
+    )
     if "rss_bytes" in row:
         emit(
             _metric_name("rss_bytes"), "gauge", "process resident set size",

@@ -41,8 +41,22 @@ DEFAULT_PROFILE_ITERS = 5
 # any current session additionally gets each record as a `compile` event.
 _COMPILE_RING_MAX = 256
 _compile_records: list[dict] = []
+_compile_total = 0  # monotonic; the ring above is capped
 _compile_lock = threading.Lock()
 _introspection_installed = False
+
+
+def introspection_active() -> bool:
+    """Whether the compile-funnel listener is installed (consumers like
+    the chunk-wall ratchet fall back to heuristics when it isn't)."""
+    return _introspection_installed
+
+
+def compile_event_count() -> int:
+    """Total compile events observed since the listener was installed
+    (monotonic — unlike the capped record ring). Sampling this around a
+    dispatch tells whether the dispatch paid XLA compile."""
+    return _compile_total
 
 
 class WindowedProfiler:
@@ -291,6 +305,13 @@ def ensure_compile_introspection() -> bool:
             original = _jax_compiler.compile_or_get_cached
         except (ImportError, AttributeError):
             return False
+        # Cache-hit attribution needs the persistent-cache hit/miss
+        # counters live even when no --compile-cache-dir was set.
+        from actor_critic_tpu.utils.compile_cache import (
+            ensure_cache_stats_listener,
+        )
+
+        ensure_cache_stats_listener()
 
         def _wrapped(*args, **kwargs):
             # Fully generic pass-through: the funnel is internal JAX
@@ -307,6 +328,9 @@ def ensure_compile_introspection() -> bool:
                     sig = _signature_of(computation)
             except Exception:
                 pass
+            from actor_critic_tpu.utils.compile_cache import cache_stats
+
+            hits_before = cache_stats()["hits"]
             t0 = time.perf_counter()
             executable = original(*args, **kwargs)
             record = {
@@ -314,6 +338,13 @@ def ensure_compile_introspection() -> bool:
                 "compile_s": round(time.perf_counter() - t0, 4),
                 **_cost_fields(executable),
             }
+            # Persistent-cache attribution: a hit event during the call
+            # means this "compile" deserialized a cached executable, not
+            # recompiled (concurrent compiles — e.g. the AOT warmup
+            # thread — can misattribute a hit across threads; that skews
+            # report cosmetics only, never the run).
+            if cache_stats()["hits"] > hits_before:
+                record["cache_hit"] = True
             if sig is not None:
                 record["signature"] = sig[:2000]
             _record_compile(record)
@@ -325,7 +356,9 @@ def ensure_compile_introspection() -> bool:
 
 
 def _record_compile(record: dict) -> None:
+    global _compile_total
     with _compile_lock:
+        _compile_total += 1
         _compile_records.append(record)
         del _compile_records[:-_COMPILE_RING_MAX]
     from actor_critic_tpu.telemetry import session as _session

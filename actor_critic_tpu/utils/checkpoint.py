@@ -38,6 +38,60 @@ def pack_keys(state: Any) -> Any:
     )
 
 
+@jax.jit
+def _owned_copy(tree: Any) -> Any:
+    """On-device clone: outputs are fresh jax-owned buffers (and, with
+    uncommitted inputs, uncommitted)."""
+    return jax.tree.map(jnp.copy, tree)
+
+
+def uncommit(state: Any) -> Any:
+    """Normalize a just-restored state for the compile-once contract
+    (ISSUE 4): every leaf becomes an UNCOMMITTED, JAX-OWNED
+    default-device array. Two distinct failure modes force this:
+
+    - COMMITMENT: orbax restores committed arrays (explicit sharding),
+      and jit bakes committed-arg shardings into the lowered module —
+      a resumed process would lower byte-different HLO from a fresh one
+      and MISS every persistent-cache entry the fresh leg or the AOT
+      warmup wrote (verified: the restored-state module gains per-arg
+      `mhlo.sharding` attributes). The host round-trip below restores
+      the fresh leg's cache keys.
+    - OWNERSHIP: device_put of host memory can alias it zero-copy, and
+      DONATING such a buffer into a DESERIALIZED cached executable
+      corrupts the glibc heap in this container ("corrupted
+      double-linked list" → SIGSEGV one dispatch later; reproduced 6/6
+      with restored states under a warm cache, clean 6/6 with fresh
+      states or cold compiles). The `_owned_copy` clone reads the
+      maybe-aliased buffers WITHOUT donation and emits buffers XLA
+      allocated itself, which every downstream donating dispatch can
+      safely consume.
+
+    One host round-trip plus one on-device copy per restore buys the
+    resumed leg a near-compile-free, crash-free start.
+
+    Mesh-SHARDED states pass through untouched: the host round-trip
+    would collapse their shards onto one device, and the dp/seqpar
+    drivers that restore them manage placement explicitly (they sit
+    outside train.py's compile-cache scope)."""
+    for leaf in jax.tree.leaves(state):
+        try:
+            multi = len(leaf.sharding.device_set) > 1
+        except AttributeError:
+            multi = False
+        if multi:
+            return state
+    placed = jax.tree.map(
+        lambda x: (
+            jax.device_put(jax.device_get(x))
+            if isinstance(x, jax.Array)
+            else x
+        ),
+        state,
+    )
+    return _owned_copy(placed)
+
+
 def unpack_keys(restored: Any, template: Any) -> Any:
     """Re-wrap raw key data wherever `template` holds a typed key."""
     return jax.tree.map(
@@ -56,6 +110,10 @@ class Checkpointer:
 
     Saves are async (the train loop keeps running while the write
     completes); `wait()` blocks, and `close()` waits + releases.
+    Restored states are ownership/commitment-normalized (`uncommit`) so
+    resumed processes share the fresh process's compilation-cache keys
+    and never donate externally-aliased buffers into deserialized
+    executables.
     """
 
     def __init__(
@@ -102,7 +160,13 @@ class Checkpointer:
 
     def restore(self, template: Any, step: Optional[int] = None) -> Any:
         """Restore the checkpoint at `step` (default: latest) into the
-        structure/shardings of `template` (a concrete or abstract state)."""
+        structure/shardings of `template` (a concrete or abstract state).
+
+        The returned leaves are normalized by `uncommit` — uncommitted,
+        XLA-owned default-device arrays — so a resumed process lowers
+        the same HLO (and hits the same persistent-compilation-cache
+        entries) as a fresh one, and downstream donating dispatches
+        never free buffers orbax/numpy still own."""
         if step is None:
             step = self.latest_step()
             if step is None:
@@ -126,6 +190,19 @@ class Checkpointer:
             restored = self._mgr.restore(
                 step, args=ocp.args.StandardRestore(abstract)
             )
+        # Normalized BEFORE key re-wrap (plain uint32 leaves throughout),
+        # so typed keys come out of wrap_key_data uncommitted like a
+        # fresh process's. Only while the persistent compile cache is
+        # live: both failure modes uncommit guards against need a warm
+        # cache (key mismatch / deserialized-executable donation), and
+        # the normalization's transient 2x device materialization must
+        # not be charged to cache-less restores of replay-ring-sized
+        # states. (train.py enables the cache before any Checkpointer
+        # exists, so the ordering holds.)
+        from actor_critic_tpu.utils import compile_cache
+
+        if compile_cache.enabled_dir() is not None:
+            restored = uncommit(restored)
         return unpack_keys(restored, template)
 
     def restore_metrics(self, step: Optional[int] = None) -> dict:
@@ -212,6 +289,22 @@ def _persist_chunk_wall(path: str, wall_s: float) -> None:
         pass  # advisory sidecar; never take the run down
 
 
+def _compile_probe() -> Optional[Callable[[], int]]:
+    """A monotonically-increasing compile-event counter from the
+    telemetry compile listener, or None when the listener isn't
+    installed. The chunk-wall ratchet samples it around each dispatch to
+    MEASURE whether the dispatch paid XLA compile, instead of guessing
+    from 'first dispatch at this k' (tests monkeypatch this seam to pin
+    either path)."""
+    try:
+        from actor_critic_tpu.telemetry import profiler
+    except Exception:  # pragma: no cover - telemetry always importable
+        return None
+    if not profiler.introspection_active():
+        return None
+    return profiler.compile_event_count
+
+
 def resume_or_init(ckpt: Checkpointer, init_state: Any) -> tuple[Any, int]:
     """(state, completed_iterations): the latest checkpoint if one exists,
     else the freshly-initialized state at iteration 0."""
@@ -282,7 +375,7 @@ def checkpointed_train(
             watchdog.ensure_timeout_at_least(3.0 * learned)
 
     it = done
-    timed_k = None  # stride of the last compile-paid dispatch (see below)
+    timed_k = None  # heuristic fallback: stride of the last compile-paid dispatch
     while it < num_iterations:
         # First chunk after a misaligned resume realigns to stride
         # boundaries (resume at it=1000, stride=64 → k=24 then 64s), so
@@ -295,6 +388,8 @@ def checkpointed_train(
         # (telemetry/profiler.py; one "iter" here = one chunk at
         # stride > 1 — the capturable unit of fused work).
         telemetry.profiler_tick()
+        compile_count = _compile_probe() if stride > 1 else None
+        compiles_before = compile_count() if compile_count else 0
         t_dispatch = time.monotonic()
         # The span measures enqueue-to-return, not device wall: a jitted
         # call returns at dispatch, and fencing here would break the
@@ -313,22 +408,31 @@ def checkpointed_train(
             # only done while a watchdog is armed, so the unwatched path
             # keeps its async pipelining. A completed chunk is proof of
             # the real wall time — raise any armed watchdog to 3x that,
-            # with headroom for jit-cache misses on tail chunks.
+            # with headroom for cache misses on tail chunks.
             jax.block_until_ready(metrics)
             chunk_wall = time.monotonic() - t_dispatch
-            if k != timed_k:
-                # A dispatch with a k this process hasn't timed yet paid
-                # XLA compile (each static k is its own program: the
-                # process's first chunk, the resume-realignment chunk,
-                # the short tail chunk — ~60s observed here). Ratcheting
-                # or persisting ITS wall would bake compile time into 3x
-                # the stall timeout permanently, weakening wedge
-                # detection for the rest of the run and (via the
-                # sidecar) every future leg. Shield the NEXT chunk with
-                # a temporary grace extension instead; the first same-k
-                # dispatch supplies the clean wall.
-                watchdog.extend_grace(3.0 * chunk_wall)
+            if compile_count is not None:
+                # MEASURED compile attribution (ISSUE 4): the telemetry
+                # compile listener saw XLA compile during this dispatch.
+                # (A persistent-cache hit also funnels through — its
+                # near-zero wall makes the conservative grace extension
+                # harmless.)
+                paid_compile = compile_count() > compiles_before
+            else:
+                # Fallback heuristic (telemetry off): a dispatch with a
+                # k this process hasn't timed yet paid compile — the
+                # process's first chunk, the realignment chunk, the
+                # short tail (~60s observed here).
+                paid_compile = k != timed_k
                 timed_k = k
+            if paid_compile:
+                # Ratcheting or persisting a compile-carrying wall would
+                # bake compile time into 3x the stall timeout
+                # permanently, weakening wedge detection for the rest of
+                # the run and (via the sidecar) every future leg. Shield
+                # the NEXT chunk with a temporary grace extension
+                # instead; the first clean dispatch supplies the wall.
+                watchdog.extend_grace(3.0 * chunk_wall)
             else:
                 watchdog.ensure_timeout_at_least(3.0 * chunk_wall)
                 if chunk_wall_path is not None:

@@ -268,39 +268,52 @@ def compile_attribution(rows: list[dict]) -> list[str]:
     for r in comps:
         g = by_name.setdefault(
             r.get("name", "?"),
-            {"count": 0, "total_s": 0.0, "flops": None, "sigs": []},
+            {"count": 0, "hits": 0, "total_s": 0.0, "flops": None,
+             "sigs": []},
         )
         g["count"] += 1
+        if r.get("cache_hit"):
+            g["hits"] += 1
         g["total_s"] += float(r.get("compile_s", 0.0))
         if r.get("flops") is not None:
             g["flops"] = float(r["flops"])  # last compile's program
         sig = r.get("signature")
         if sig is not None and sig not in g["sigs"]:
             g["sigs"].append(sig)
-    # The listener hooks the compile funnel, which persistent-cache
-    # HITS also pass through (near-zero wall) — call those out so a
-    # warm-cache run isn't misread as a recompile storm when the
-    # jax.monitoring counter (Resources section) stays low.
-    fast = sum(
-        1 for r in comps if float(r.get("compile_s", 0.0)) < 0.01
-    )
-    fast_note = (
-        f" ({fast} under 10 ms — likely compilation-cache hits, "
-        "not real recompiles)" if fast else ""
-    )
+    # The listener hooks the compile funnel, which persistent-cache HITS
+    # also pass through: attributed events carry an explicit `cache_hit`
+    # flag (ISSUE 4); for older runs without the flag, fall back to the
+    # near-zero-wall signal — either way a warm-cache run must not be
+    # misread as a recompile storm when the jax.monitoring counter
+    # (Resources section) stays low.
+    attributed_hits = sum(1 for r in comps if r.get("cache_hit"))
+    if attributed_hits:
+        fast_note = (
+            f" ({attributed_hits} persistent-cache hit(s) — "
+            "deserialized, not recompiled)"
+        )
+    else:
+        fast = sum(
+            1 for r in comps if float(r.get("compile_s", 0.0)) < 0.01
+        )
+        fast_note = (
+            f" ({fast} under 10 ms — likely compilation-cache hits, "
+            "not real recompiles)" if fast else ""
+        )
     out = [
         f"{len(comps)} XLA compilation(s), "
         f"{_fmt_s(sum(g['total_s'] for g in by_name.values()))} total "
         f"compile wall{fast_note}.",
         "",
-        "| function | compiles | compile wall | FLOPs/call | distinct arg signatures |",
-        "|---|---:|---:|---:|---:|",
+        "| function | compiles | cache hits | compile wall | FLOPs/call "
+        "| distinct arg signatures |",
+        "|---|---:|---:|---:|---:|---:|",
     ]
     for name, g in sorted(by_name.items(), key=lambda kv: -kv[1]["total_s"]):
         flops = f"{g['flops']:.3g}" if g["flops"] is not None else "n/a"
         out.append(
-            f"| `{name}` | {g['count']} | {_fmt_s(g['total_s'])} "
-            f"| {flops} | {len(g['sigs'])} |"
+            f"| `{name}` | {g['count']} | {g['hits']} "
+            f"| {_fmt_s(g['total_s'])} | {flops} | {len(g['sigs'])} |"
         )
     # Name the churn: a function with one signature compiled once is
     # startup; several signatures is shape/dtype churn worth reading.

@@ -22,20 +22,21 @@ set -u
 MAX_RETRIES=${MAX_RETRIES:-10}
 
 ckpt_dir=""
+cache_dir="auto"  # sentinel: flag not passed (train.py's default)
 fresh=0
 prev=""
 args=()
 # train.py options that take a VALUE: a literal "--fresh" right after one
 # of these is that option's argument, not our flag (e.g. a metrics file
 # named --fresh), and must pass through untouched. Mirrors train.py's
-# argparse spec; boolean flags (--quiet, --resume, ...) are absent on
-# purpose.
+# argparse spec; boolean flags (--quiet, --resume, --warmup ...) are
+# absent on purpose.
 takes_value() {
   case "$1" in
     --preset|--algo|--env|--iterations|--seed|--set|--env-set|--metrics|\
     --telemetry-dir|--telemetry-port|--telemetry-sample-s|--log-every|\
     --chunk|--eval-every|--eval-envs|--eval-steps|--workers|--ckpt-dir|\
-    --save-every|--stall-timeout)
+    --compile-cache-dir|--save-every|--stall-timeout)
       return 0 ;;
   esac
   return 1
@@ -45,9 +46,24 @@ for a in "$@"; do
     fresh=1; prev="$a"; continue
   fi
   if [ "$prev" = "--ckpt-dir" ]; then ckpt_dir="$a"; fi
+  if [ "$prev" = "--compile-cache-dir" ]; then cache_dir="$a"; fi
   args+=("$a")
   prev="$a"
 done
+# Every leg shares the persistent compilation cache: train.py's 'auto'
+# default already resolves to the <ckpt-dir>/xla_cache sidecar, so leg
+# N>0 demonstrably skips XLA compile. Mirror resolve_cache_dir exactly
+# so --fresh knows which directory to wipe: an unpassed flag means
+# 'auto'; 'none'/'off' (ANY case — python lowercases) and an explicit
+# empty value mean DISABLED, never a literal path (wiping a "None"
+# directory would delete unrelated cwd state).
+cache_lc=$(printf '%s' "$cache_dir" | tr '[:upper:]' '[:lower:]')
+case "$cache_lc" in
+  auto)
+    if [ -n "$ckpt_dir" ]; then cache_dir="$ckpt_dir/xla_cache"
+    else cache_dir=""; fi ;;
+  ""|none|off) cache_dir="" ;;
+esac
 # ${args[@]+...}: bash < 4.4 treats expanding an EMPTY array as an unset-
 # variable error under `set -u`; the parameter-expansion guard is the
 # portable spelling (a bare "${args[@]}" aborts the wrapper when train.py
@@ -59,6 +75,13 @@ if [ "$fresh" -eq 1 ] && [ -n "$ckpt_dir" ] && [ -d "$ckpt_dir" ] \
   echo "[run_resumable] --fresh: $ckpt_dir already contains a checkpoint;" \
        "refusing to start an evidence run over foreign state" >&2
   exit 3
+fi
+if [ "$fresh" -eq 1 ] && [ -n "$cache_dir" ] && [ -d "$cache_dir" ]; then
+  # A fresh evidence run must also start compile-fresh: stale cache
+  # entries (old jax/XLA flags, a since-edited model) would make leg 0's
+  # "cold" startup measurement quietly warm.
+  echo "[run_resumable] --fresh: wiping compile cache $cache_dir" >&2
+  rm -rf "$cache_dir"
 fi
 
 latest_step() {

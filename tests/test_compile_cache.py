@@ -1,0 +1,328 @@
+"""Compile-once subsystem (utils/compile_cache.py, ISSUE 4): persistent
+cache warm/cold behavior, AOT warmup signature-exactness (the loop's own
+first dispatch must HIT what warmup compiled), shape-stabilized chunking
+(two programs total, bit-compatible semantics), and the steady-state
+compile-count regression contract: after warmup + first dispatch, zero
+recompiles."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from actor_critic_tpu.telemetry import profiler
+from actor_critic_tpu.utils import compile_cache
+
+
+def _new_records(n0: int) -> list:
+    return profiler.compile_records()[n0:]
+
+
+def _require_introspection():
+    if not profiler.ensure_compile_introspection():
+        pytest.skip("jax compile funnel unavailable in this jax version")
+
+
+# ---------------------------------------------------------------- utilities
+
+def test_bucket_size_and_pad_to_bucket():
+    assert compile_cache.bucket_size(5, (4, 8, 16)) == 8
+    assert compile_cache.bucket_size(8, (4, 8, 16)) == 8
+    assert compile_cache.bucket_size(0, (4,)) == 4
+    with pytest.raises(ValueError):
+        compile_cache.bucket_size(17, (4, 8, 16))
+    with pytest.raises(ValueError):
+        compile_cache.bucket_size(-1, (4,))
+
+    x = np.arange(12, dtype=np.float32).reshape(6, 2)
+    padded, mask = compile_cache.pad_to_bucket(x, (4, 8))
+    assert padded.shape == (8, 2) and mask.shape == (8,)
+    np.testing.assert_array_equal(padded[:6], x)
+    np.testing.assert_array_equal(padded[6:], 0.0)
+    np.testing.assert_array_equal(mask, [1, 1, 1, 1, 1, 1, 0, 0])
+    # Exact fit: no copy semantics promised, but shape/mask must be right.
+    same, mask = compile_cache.pad_to_bucket(x, (6,))
+    assert same.shape == (6, 2) and mask.sum() == 6
+
+
+def test_resolve_cache_dir_policy(tmp_path):
+    resolve = compile_cache.resolve_cache_dir
+    ck = str(tmp_path / "ck")
+    assert resolve("auto", ck).endswith("xla_cache")
+    assert resolve("auto", None) is None
+    assert resolve(None, ck).endswith("xla_cache")
+    assert resolve("none", ck) is None
+    assert resolve("off", ck) is None
+    assert resolve("", ck) is None
+    assert resolve("/x/y", ck) == "/x/y"
+
+
+# ------------------------------------------------------- persistent cache
+
+def test_persistent_cache_cold_then_warm(tmp_path):
+    """Cold compile writes the cache (miss counted); after clearing the
+    in-memory jit caches, the same program deserializes (hit counted) —
+    the cross-leg mechanism `run_resumable.sh` relies on."""
+    import os
+
+    with compile_cache.temporary_cache(tmp_path / "cc") as cc_dir:
+        stats0 = compile_cache.cache_stats()
+
+        def fn(x):
+            return jnp.tanh(x @ x.T).sum() + x.sum()
+
+        x = jnp.ones((97, 53))  # unlikely-collision shape for this process
+        jax.block_until_ready(jax.jit(fn)(x))
+        stats1 = compile_cache.cache_stats()
+        assert stats1["misses"] > stats0["misses"]
+        assert any(f.endswith("-cache") for f in os.listdir(cc_dir))
+
+        jax.clear_caches()  # "new process": in-memory jit caches gone
+        jax.block_until_ready(jax.jit(fn)(x))
+        stats2 = compile_cache.cache_stats()
+        assert stats2["hits"] > stats1["hits"]
+
+
+# --------------------------------------------------- shape-stable chunking
+
+def _tiny_a2c():
+    from actor_critic_tpu.algos import a2c
+    from actor_critic_tpu.envs import make_two_state_mdp
+
+    env = make_two_state_mdp()
+    cfg = a2c.A2CConfig(num_envs=8, rollout_steps=4, hidden=(16,))
+    return a2c, env, cfg
+
+
+def test_chunked_step_masked_tail_matches_per_iteration():
+    """The n_valid-masked bucket must advance exactly k iterations —
+    same trajectory as k per-iteration dispatches from the same state —
+    and report the LAST VALID iteration's metrics."""
+    a2c, env, cfg = _tiny_a2c()
+    raw = a2c.make_train_step(env, cfg)
+    step = compile_cache.make_chunked_step(raw, 4)
+
+    sA, _ = step(a2c.init_state(env, cfg, jax.random.key(0)), 4)
+    sA, mA = step(sA, 3)  # masked: 3 valid of 4 slots
+
+    sB, _ = step(a2c.init_state(env, cfg, jax.random.key(0)), 4)
+    per_iter = jax.jit(raw)
+    for _ in range(3):
+        sB, mB = per_iter(sB)
+
+    for a, b in zip(jax.tree.leaves(sA), jax.tree.leaves(sB)):
+        if jnp.issubdtype(a.dtype, jax.dtypes.prng_key):
+            a, b = jax.random.key_data(a), jax.random.key_data(b)
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
+        )
+    for k in mB:
+        np.testing.assert_allclose(
+            np.asarray(mA[k]), np.asarray(mB[k]), rtol=1e-4, atol=1e-6
+        )
+
+
+def test_chunked_step_compiles_exactly_two_programs():
+    """Every partial k shares ONE masked program (the PR 3 attribution
+    table's top recompile source was a fresh program per distinct static
+    tail k)."""
+    _require_introspection()
+    a2c, env, cfg = _tiny_a2c()
+    step = compile_cache.make_chunked_step(a2c.make_train_step(env, cfg), 4)
+    state = a2c.init_state(env, cfg, jax.random.key(1))
+
+    n0 = len(profiler.compile_records())
+    state, _ = step(state, 4)   # full program
+    state, _ = step(state, 3)   # masked program
+    mid = profiler.compile_event_count()
+    state, _ = step(state, 1)   # masked REUSED
+    state, _ = step(state, 2)   # masked REUSED
+    state, _ = step(state, 4)   # full REUSED
+    assert profiler.compile_event_count() == mid, [
+        r["name"] for r in _new_records(n0)
+    ]
+    names = [r["name"] for r in _new_records(n0)]
+    assert names.count("jit_full") == 1 and names.count("jit_masked") == 1
+
+
+# ------------------------------------------------------------- AOT warmup
+
+def test_warmup_runner_contains_thunk_errors():
+    ok = []
+    runner = compile_cache.WarmupRunner(
+        [("boom", lambda: 1 / 0), ("fine", lambda: ok.append(1))]
+    ).start()
+    assert runner.wait(30)
+    assert "error" in runner.results[0]
+    assert ok and "compile_s" in runner.results[1]
+
+
+def test_fused_warmup_makes_first_dispatch_a_cache_hit(tmp_path):
+    """The warmup thread AOT-compiles the chunked programs from ABSTRACT
+    state; the loop's own jit objects must then funnel through as
+    persistent-cache HITS — i.e. each entry point really compiles once
+    (0 recompiles after warmup)."""
+    _require_introspection()
+    a2c, env, cfg = _tiny_a2c()
+    with compile_cache.temporary_cache(tmp_path / "cc"):
+        ctx = compile_cache.WarmupContext(
+            algo="a2c", fused=True, spec=env.spec, cfg=cfg, env=env,
+            chunk=3, iterations=7, eval_every=0,
+        )
+        plan = compile_cache.plan_warmup(ctx)
+        assert [n for n, _ in plan] == ["a2c.make_train_step"]
+        n0 = len(profiler.compile_records())
+        runner = compile_cache.WarmupRunner(plan).start()
+        assert runner.wait(300) and "error" not in runner.results[0], (
+            runner.results
+        )
+
+        # The "live" loop builds its OWN step (fresh jit objects, same
+        # HLO) — exactly what train.py's run_fused does.
+        step = compile_cache.make_chunked_step(
+            a2c.make_train_step(env, cfg), 3
+        )
+        state = a2c.init_state(env, cfg, jax.random.key(0))
+        from actor_critic_tpu.utils.checkpoint import checkpointed_train
+
+        state, _ = checkpointed_train(step, state, 7, stride=3)
+
+    records = _new_records(n0)
+    for name in ("jit_full", "jit_masked"):
+        evs = [r for r in records if r["name"] == name]
+        real = [r for r in evs if not r.get("cache_hit")]
+        hits = [r for r in evs if r.get("cache_hit")]
+        assert len(real) == 1, (name, evs)   # warmup's one true compile
+        assert hits, (name, evs)             # the loop hit the cache
+
+
+def test_host_ppo_steady_state_zero_recompiles(tmp_path):
+    """ISSUE 4 acceptance: a short host loop under the compile listener —
+    every registered entry point compiles exactly once (warmup), the
+    loop's first dispatch is a cache hit, and steady state (iterations
+    past the second) triggers ZERO further compile events."""
+    pytest.importorskip("gymnasium")
+    _require_introspection()
+    from actor_critic_tpu.algos import ppo
+    from actor_critic_tpu.envs.host_pool import HostEnvPool
+
+    cfg = ppo.PPOConfig(
+        num_envs=4, rollout_steps=8, epochs=1, num_minibatches=2,
+        hidden=(16,),
+    )
+    pool = HostEnvPool("CartPole-v1", num_envs=4, seed=0)
+    try:
+        with compile_cache.temporary_cache(tmp_path / "cc"):
+            ctx = compile_cache.WarmupContext(
+                algo="ppo", fused=False, spec=pool.spec, cfg=cfg,
+                eval_every=0, overlap=True,
+            )
+            plan = compile_cache.plan_warmup(ctx)
+            # CartPole's MLP mirrors acting/eval on the host, so the only
+            # device entry point this run dispatches is the update.
+            assert [n for n, _ in plan] == ["ppo.make_host_update_step"]
+            n0 = len(profiler.compile_records())
+            runner = compile_cache.WarmupRunner(plan).start()
+            assert runner.wait(300) and "error" not in runner.results[0], (
+                runner.results
+            )
+
+            counts = {}
+
+            def log_fn(it, m):
+                counts[it] = profiler.compile_event_count()
+
+            ppo.train_host(
+                pool, cfg, num_iterations=4, log_every=1, log_fn=log_fn,
+            )
+    finally:
+        pool.close()
+
+    records = _new_records(n0)
+    update_evs = [r for r in records if r["name"] == "jit_update"]
+    real = [r for r in update_evs if not r.get("cache_hit")]
+    assert len(real) == 1, update_evs   # warmup compiled it exactly once
+    assert any(r.get("cache_hit") for r in update_evs), update_evs
+    # Steady state: whatever one-time micro-jits iteration 1/2 paid
+    # (PRNG split etc.), iterations 3..4 must compile NOTHING.
+    assert counts[4] == counts[2], records
+
+
+def test_restore_normalizes_for_compile_cache(tmp_path):
+    """A restored state must (a) carry UNCOMMITTED, XLA-owned leaves —
+    orbax's committed arrays lower byte-different HLO (per-arg
+    mhlo.sharding attrs) that misses every cache entry a fresh process
+    wrote, and donating restore-aliased buffers into deserialized
+    executables corrupts the heap — and (b) therefore lower EXACTLY the
+    fresh process's module, so resumed legs hit the fresh leg's cache."""
+    from actor_critic_tpu.utils.checkpoint import Checkpointer
+
+    a2c, env, cfg = _tiny_a2c()
+    state = a2c.init_state(env, cfg, jax.random.key(0))
+    with Checkpointer(tmp_path / "ck") as ck:
+        ck.save(1, state, force=True)
+        ck.wait()
+        # Normalization is gated on a live cache (its 2x transient
+        # device materialization must not tax cache-less restores of
+        # replay-ring-sized states).
+        with compile_cache.temporary_cache(tmp_path / "cc"):
+            restored = ck.restore(state, 1)
+    for leaf in jax.tree.leaves(restored):
+        assert not leaf.committed
+    step = compile_cache.make_chunked_step(a2c.make_train_step(env, cfg), 2)
+    fresh_hlo = step.full.lower(state).as_text()
+    restored_hlo = step.full.lower(restored).as_text()
+    assert fresh_hlo == restored_hlo
+    # And the restored values round-tripped exactly despite the clone.
+    for a, b in zip(jax.tree.leaves(state), jax.tree.leaves(restored)):
+        if jnp.issubdtype(a.dtype, jax.dtypes.prng_key):
+            a, b = jax.random.key_data(a), jax.random.key_data(b)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ------------------------------------------------------------- telemetry
+
+def test_exporter_reports_compile_cache_counters(tmp_path):
+    from actor_critic_tpu.telemetry.exporter import render_metrics
+    from actor_critic_tpu.telemetry.session import TelemetrySession
+
+    s = TelemetrySession(
+        tmp_path / "t", sample_resources=False, profile=False
+    )
+    try:
+        text = render_metrics(s)
+    finally:
+        s.close()
+    assert "actor_critic_compile_cache_hits_total" in text
+    assert "actor_critic_compile_cache_misses_total" in text
+    assert "actor_critic_compile_cache_enabled" in text
+
+
+def test_run_report_cache_hit_attribution(tmp_path):
+    import importlib.util
+    import json
+    from pathlib import Path
+
+    spec = importlib.util.spec_from_file_location(
+        "run_report",
+        Path(__file__).parent.parent / "scripts" / "run_report.py",
+    )
+    run_report = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(run_report)
+
+    (tmp_path / "events.jsonl").write_text(
+        "".join(
+            json.dumps(r) + "\n"
+            for r in [
+                {"ts": 1.0, "kind": "session_start"},
+                {"ts": 2.0, "kind": "compile", "name": "jit_update",
+                 "compile_s": 2.0},
+                {"ts": 3.0, "kind": "compile", "name": "jit_update",
+                 "compile_s": 0.02, "cache_hit": True},
+            ]
+        )
+    )
+    report = run_report.render(str(tmp_path))
+    assert "| `jit_update` | 2 | 1 | 2.02s" in report, report
+    assert "persistent-cache hit(s)" in report

@@ -483,7 +483,7 @@ def test_run_report_recompile_attribution_and_slowest_spans(tmp_path):
     )
     report = run_report.render(str(tmp_path))
     assert "## Recompile attribution" in report
-    assert "| `jit_update` | 2 | 5.00s" in report, report
+    assert "| `jit_update` | 2 | 0 | 5.00s" in report, report
     assert "2 argument signatures" in report
     assert sig_a in report and sig_b in report
     assert "## Slowest spans" in report

@@ -1,0 +1,43 @@
+"""Tier-1 wiring for scripts/check_warmup_registry.py (ISSUE 4): a
+`jax.jit` entry point added to algos/ or models/ without an AOT warmup
+registration (or an explicit exemption with a reason) must fail fast in
+CI, not resurface as first-dispatch compile latency weeks later."""
+
+import importlib.util
+from pathlib import Path
+
+
+def _load_lint():
+    spec = importlib.util.spec_from_file_location(
+        "check_warmup_registry",
+        Path(__file__).parent.parent / "scripts" / "check_warmup_registry.py",
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_registry_covers_every_jit_entry_point(capsys):
+    lint = _load_lint()
+    assert lint.main([]) == 0, capsys.readouterr().err
+
+
+def test_lint_detects_unregistered_sites(tmp_path):
+    """The AST scanner must see direct calls, decorators, and
+    partial(jax.jit, ...) forms, keyed by enclosing top-level def."""
+    lint = _load_lint()
+    src = (
+        "import jax\n"
+        "from functools import partial\n"
+        "def make_thing(cfg):\n"
+        "    @partial(jax.jit, donate_argnums=0)\n"
+        "    def f(x):\n"
+        "        return x\n"
+        "    return f\n"
+        "def make_other(cfg):\n"
+        "    return jax.jit(lambda x: x)\n"
+    )
+    p = tmp_path / "newalgo.py"
+    p.write_text(src)
+    sites = lint.jit_sites(str(p))
+    assert sorted(fn for fn, _ in sites) == ["make_other", "make_thing"]

@@ -85,14 +85,20 @@ def test_armed_and_ensure_timeout_at_least():
     watchdog.ensure_timeout_at_least(99.0)      # disarmed: nothing to touch
 
 
-def test_chunked_train_widens_watchdog_from_real_chunk_wall():
+def test_chunked_train_widens_watchdog_from_real_chunk_wall(monkeypatch):
     """End-to-end: checkpointed_train(stride>1) must measure the chunk
     BEHIND a block (a jitted call returns at enqueue time) and raise an
     armed watchdog to 3x the measured wall — from the SECOND dispatch on
-    (the first is compile-inflated and skipped by design)."""
+    (the first is compile-inflated and skipped by design). Pinned to the
+    HEURISTIC compile-detection path (telemetry listener off): these
+    step fns fake compile latency with sleep, which the measured
+    compile-event path correctly calls clean."""
     import jax.numpy as jnp
 
+    from actor_critic_tpu.utils import checkpoint
     from actor_critic_tpu.utils.checkpoint import checkpointed_train
+
+    monkeypatch.setattr(checkpoint, "_compile_probe", lambda: None)
 
     def slow_chunk(state, k):
         time.sleep(0.25)  # stand-in for real device wall time
@@ -116,18 +122,24 @@ def test_chunked_train_widens_watchdog_from_real_chunk_wall():
         w.stop()
 
 
-def test_chunked_train_first_dispatch_never_ratchets_and_wall_persists(tmp_path):
+def test_chunked_train_first_dispatch_never_ratchets_and_wall_persists(
+    tmp_path, monkeypatch
+):
     """ISSUE 2 satellite: (a) the FIRST dispatch of a process — which in
     production carries full XLA compile — must not drive the auto-raise
     (it would bake compile time into 3x the stall timeout for the whole
     run); (b) the steady-state chunk wall persists to a ckpt-dir sidecar;
     (c) a resumed process widens its armed watchdog from the sidecar
-    BEFORE its own (skipped) chunk 1."""
+    BEFORE its own (skipped) chunk 1. Heuristic detection path pinned
+    (see test_chunked_train_widens_watchdog_from_real_chunk_wall)."""
     import json
 
     import jax.numpy as jnp
 
+    from actor_critic_tpu.utils import checkpoint
     from actor_critic_tpu.utils.checkpoint import Checkpointer, checkpointed_train
+
+    monkeypatch.setattr(checkpoint, "_compile_probe", lambda: None)
 
     calls = []
 
@@ -165,3 +177,45 @@ def test_chunked_train_first_dispatch_never_ratchets_and_wall_persists(tmp_path)
         assert w2.timeout_s >= 3.0 * wall - 1e-6, w2.timeout_s
     finally:
         w2.stop()
+
+
+def test_chunked_train_ratchet_consumes_compile_events(monkeypatch):
+    """ISSUE 4 satellite: with the telemetry compile listener installed,
+    the ratchet decides "compile-inflated dispatch" from MEASURED compile
+    events, not from per-k novelty — a recompile on a later same-k
+    dispatch (the storm case the heuristic misreads as a clean wall)
+    must extend grace instead of ratcheting its inflated wall into the
+    permanent timeout."""
+    import jax.numpy as jnp
+
+    from actor_critic_tpu.utils import checkpoint
+    from actor_critic_tpu.utils.checkpoint import checkpointed_train
+
+    compile_count = [0]
+    monkeypatch.setattr(
+        checkpoint, "_compile_probe", lambda: (lambda: compile_count[0])
+    )
+    calls = []
+
+    def chunk(state, k):
+        calls.append(k)
+        if len(calls) <= 2:
+            compile_count[0] += 1  # dispatches 1 AND 2 "pay compile"
+            time.sleep(0.3)       # compile-inflated wall
+        else:
+            time.sleep(0.05)      # steady-state wall
+        return state + k, {"loss": jnp.asarray(0.0)}
+
+    w = watchdog.StallWatchdog(0.4).start()
+    try:
+        state, _ = checkpointed_train(
+            chunk, jnp.asarray(0), num_iterations=6, stride=2,
+        )
+        assert int(state) == 6 and len(calls) == 3
+        # The k-novelty heuristic would have ratcheted dispatch 2
+        # (same k as dispatch 1) to 3 x 0.3 = 0.9s; the event-driven
+        # path shields it, and the clean 0.05s dispatch ratchets a
+        # no-op 0.15 < 0.4.
+        assert w.timeout_s == 0.4, w.timeout_s
+    finally:
+        w.stop()

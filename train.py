@@ -266,16 +266,13 @@ def run_fused(env, preset, args, logger) -> dict:
         # scanned on the same pong program) is paid once per chunk.
         # Metrics are the final iteration's slice — the same
         # point-in-time semantics a per-iteration loop logs at chunk
-        # boundaries. k is static: full chunks share one compile, the
-        # resume/end tails cost one more each.
-        from functools import partial
+        # boundaries. Shape-stabilized (utils/compile_cache.py): full
+        # chunks share one program and EVERY partial chunk (resume
+        # realignment, end tail) shares a second, n_valid-masked one —
+        # arbitrary k never compiles a fresh program.
+        from actor_critic_tpu.utils.compile_cache import make_chunked_step
 
-        @partial(jax.jit, static_argnums=1, donate_argnums=0)
-        def step(s, k):
-            s, ms = jax.lax.scan(
-                lambda c, _: raw_step(c), s, None, length=k
-            )
-            return s, jax.tree.map(lambda x: x[-1], ms)
+        step = make_chunked_step(raw_step, chunk)
 
         # Cadences fire only at chunk boundaries; snap them UP to chunk
         # multiples so "every N" keeps meaning what it says.
@@ -507,6 +504,22 @@ def main(argv=None) -> int:
         "pools clip; jax:pendulum scales). Never flip this on a resumed "
         "run: the restored networks trained under the other convention.",
     )
+    p.add_argument(
+        "--compile-cache-dir", default="auto", metavar="DIR",
+        help="persistent XLA compilation cache (utils/compile_cache.py): "
+        "compiled programs are written here and later processes (e.g. "
+        "run_resumable.sh retry legs) deserialize instead of recompiling. "
+        "'auto' (default) uses a <ckpt-dir>/xla_cache sidecar when "
+        "--ckpt-dir is set, else disables; 'none' disables explicitly.",
+    )
+    p.add_argument(
+        "--warmup", action=argparse.BooleanOptionalAction, default=True,
+        help="AOT-compile every registered jitted entry point (abstract "
+        "shapes from the env spec + config) on a background thread while "
+        "the env pool spawns/resets and the checkpoint restores, so "
+        "time-to-first-step hides compile instead of serializing on it "
+        "(utils/compile_cache.py warmup registry).",
+    )
     p.add_argument("--ckpt-dir", help="orbax checkpoint dir")
     p.add_argument("--save-every", type=int, default=100)
     p.add_argument(
@@ -559,6 +572,17 @@ def main(argv=None) -> int:
         f"env_kwargs={preset.env_kwargs}",
         flush=True,
     )
+    from actor_critic_tpu.utils import compile_cache
+
+    cache_dir = compile_cache.resolve_cache_dir(
+        args.compile_cache_dir, args.ckpt_dir
+    )
+    if cache_dir is not None:
+        # Before the first trace/compile of the process: every program —
+        # including the warmup thread's — must land in (or hit) the
+        # on-disk cache so resumed legs start near-instantly.
+        compile_cache.enable_persistent_cache(cache_dir)
+        print(f"compile cache: {cache_dir}", flush=True)
     env, fused = build_env(
         preset.env, preset.algo, preset.config, args.seed,
         scale_actions=args.scale_actions, env_kwargs=preset.env_kwargs,
@@ -602,6 +626,40 @@ def main(argv=None) -> int:
         from actor_critic_tpu.telemetry.profiler import install_sigusr2
 
         install_sigusr2()
+
+    if args.warmup and cache_dir is None:
+        # AOT-compiled executables are never installed into the jit
+        # dispatch cache (JAX AOT contract) — without the persistent
+        # cache to carry them to the loop's own jit objects, warmup
+        # would just compile everything twice on a contended host.
+        print(
+            "AOT warmup skipped: requires the persistent compile cache "
+            "(--compile-cache-dir, or --ckpt-dir for the auto sidecar)",
+            flush=True,
+        )
+    elif args.warmup:
+        # Background AOT warmup: compile every registered entry point
+        # (abstract arg shapes from spec + config) while the host side
+        # resets pools / restores checkpoints. XLA compilation releases
+        # the GIL, so this genuinely overlaps; each compile lands in the
+        # persistent cache, so the loop's own first dispatch re-traces
+        # and hits instead of compiling.
+        ctx = compile_cache.WarmupContext(
+            algo=preset.algo, fused=fused, spec=env.spec,
+            cfg=preset.config, env=env if fused else None,
+            chunk=max(1, args.chunk) if fused else 1,
+            iterations=args.iterations, eval_every=args.eval_every,
+            eval_envs=args.eval_envs, overlap=not args.no_overlap,
+            resume=args.resume,
+        )
+        plan = compile_cache.plan_warmup(ctx)
+        if plan:
+            print(
+                f"AOT warmup: {len(plan)} entry point(s) compiling in "
+                "the background: " + ", ".join(n for n, _ in plan),
+                flush=True,
+            )
+            compile_cache.WarmupRunner(plan).start()
 
     watchdog = None
     if args.stall_timeout > 0:
