@@ -29,6 +29,8 @@ class EpisodeTracker:
     def update(self, raw_reward: np.ndarray, done: np.ndarray) -> None:
         self._ep_ret += raw_reward
         for i in np.nonzero(done)[0]:
+            # jaxlint: disable=host-sync (numpy episode accounting — no
+            # device value; the coercion below is host-only)
             self.finished.append(float(self._ep_ret[i]))
             self._ep_ret[i] = 0.0
 
@@ -484,6 +486,9 @@ def off_policy_train_host(
                 def explore_act(o):
                     nonlocal key, env_steps
                     key, akey = jax.random.split(key)
+                    # jaxlint: disable=host-sync (deliberate: without a
+                    # numpy mirror the pool needs concrete host actions
+                    # every step — the documented non-overlap fallback)
                     action = np.asarray(
                         act(learner.actor_params, jnp.asarray(o), akey,
                             jnp.asarray(env_steps, jnp.int32))
@@ -549,6 +554,8 @@ def off_policy_train_host(
                 ckpt, it + 1, save_every, num_iterations, pool, metrics,
                 save_replay=save_replay,
                 learner=learner, key=key,
+                # jaxlint: disable=host-sync (python int → np scalar for
+                # the checkpoint tree; no device value is touched)
                 env_steps=np.asarray(env_steps, np.int64),
             )
     if ckpt is not None:
@@ -615,6 +622,9 @@ def fused_train_loop(
     for it in range(num_iterations):
         state, metrics = jit_step(state)
         if log_fn is not None and should_log(it + 1, log_every, num_iterations):
+            # jaxlint: disable=host-sync (deliberate: the log-cadence
+            # float() coercions are the loop's designed first sync point
+            # — README "Observability")
             log_fn(it + 1, {k: float(v) for k, v in metrics.items()})
     return state, metrics
 

@@ -475,6 +475,9 @@ def train_host(
                     nonlocal key
                     key, akey = jax.random.split(key)
                     action, logp, value = policy_step(params, jnp.asarray(o), akey)
+                    # jaxlint: disable=host-sync (deliberate: without a
+                    # numpy mirror, acting round-trips the device and the
+                    # pool needs concrete arrays — the non-overlap path)
                     return np.asarray(action), {
                         "log_prob": np.asarray(logp),
                         "value": np.asarray(value),

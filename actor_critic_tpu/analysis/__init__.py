@@ -1,0 +1,59 @@
+"""jaxlint: repo-wide JAX correctness analyzer (ISSUE 5).
+
+AST-based static analysis over this repo's JAX code — pure stdlib
+`ast`, no new dependencies, and (except the `warmup-registry` pass,
+which validates against the live registry) no imports of the code it
+scans. Six registered passes, each grounded in a failure this codebase
+actually hit or observes at runtime:
+
+    donation-aliasing   donated jit args fed restore-aliased/still-live
+                        buffers (the PR 4 glibc heap corruption)
+    tracer-leak         Python if/while/assert/bool() on traced values
+    prng-reuse          one PRNG key consumed twice without split
+    recompile-hazard    jit built in loops; shape-/len()-derived scalars
+                        at jitted call sites (the PR 3 recompile storms)
+    host-sync           device syncs inside hot collection loops
+    warmup-registry     jax.jit entry points without AOT warmup planners
+                        (ISSUE 4's lint, folded in)
+
+CLI: `python scripts/jaxlint.py` (tier-1-gated via
+tests/test_jaxlint.py and scripts/tier1.sh). Per-line suppression:
+`# jaxlint: disable=<check>` with the reason in the same comment.
+Accepted findings live in `jaxlint_baseline.json` with reason strings.
+"""
+
+from actor_critic_tpu.analysis.baseline import (
+    apply_baseline,
+    default_baseline_path,
+    load_baseline,
+    regenerate,
+    save_baseline,
+)
+from actor_critic_tpu.analysis.core import (
+    AnalysisError,
+    Check,
+    Finding,
+    ModuleInfo,
+    analyze_paths,
+    load_modules,
+    register_check,
+    registered_checks,
+    run_checks,
+)
+
+__all__ = [
+    "AnalysisError",
+    "Check",
+    "Finding",
+    "ModuleInfo",
+    "analyze_paths",
+    "apply_baseline",
+    "default_baseline_path",
+    "load_baseline",
+    "load_modules",
+    "regenerate",
+    "register_check",
+    "registered_checks",
+    "run_checks",
+    "save_baseline",
+]

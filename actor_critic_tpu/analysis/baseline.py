@@ -1,0 +1,120 @@
+"""jaxlint baseline: accepted findings with reasons, matched by
+line-number-free fingerprint.
+
+The baseline records findings the team has LOOKED AT and decided to
+keep — every entry carries a `reason` string a reviewer can audit, the
+same contract as `compile_cache.EXEMPT`. Tier-1 fails on findings that
+are not in the baseline (`--error-on-new`, the default gate), so new
+hazards surface immediately while accepted ones stay visible in
+`--show-baselined` output instead of rotting as ignored noise.
+
+Matching is by `Finding.fingerprint()` — check + path + enclosing
+top-level function + stripped line text — so entries survive edits
+elsewhere in the file. When the flagged LINE itself changes, the entry
+goes stale (reported, never silently dropped) and the finding resurfaces
+as new: a changed line deserves a fresh look, not a stale pardon.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterable, Optional
+
+from actor_critic_tpu.analysis.core import AnalysisError, Finding
+
+DEFAULT_BASENAME = "jaxlint_baseline.json"
+_PLACEHOLDER_REASON = (
+    "NEEDS-REASON: accepted by --write-baseline; replace with why this "
+    "finding is deliberate"
+)
+
+
+def entry_fingerprint(entry: dict) -> str:
+    return (
+        f"{entry.get('check', '')}:{entry.get('path', '')}:"
+        f"{entry.get('context', '')}:{entry.get('line_text', '')}"
+    )
+
+
+def load_baseline(path: str) -> list[dict]:
+    """Baseline entries; [] when the file does not exist. A present but
+    unreadable/malformed file is an AnalysisError (exit 2) — a corrupt
+    baseline silently reading as empty would fail tier-1 with dozens of
+    'new' findings and no hint why."""
+    if not os.path.exists(path):
+        return []
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError) as e:
+        raise AnalysisError(f"baseline {path}: {e}") from e
+    if not isinstance(data, dict) or not isinstance(
+        data.get("entries"), list
+    ):
+        raise AnalysisError(
+            f"baseline {path}: expected {{'version': 1, 'entries': [...]}}"
+        )
+    return list(data["entries"])
+
+
+def save_baseline(path: str, entries: Iterable[dict]) -> None:
+    entries = sorted(entries, key=entry_fingerprint)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": 1, "entries": entries}, f, indent=2)
+        f.write("\n")
+
+
+def apply_baseline(
+    findings: list[Finding], entries: list[dict]
+) -> tuple[list[Finding], list[tuple[Finding, dict]], list[dict]]:
+    """(new_findings, baselined (finding, entry) pairs, stale entries).
+    One entry covers every finding sharing its fingerprint."""
+    by_fp = {entry_fingerprint(e): e for e in entries}
+    new: list[Finding] = []
+    matched: list[tuple[Finding, dict]] = []
+    used: set[str] = set()
+    for f in findings:
+        entry = by_fp.get(f.fingerprint())
+        if entry is None:
+            new.append(f)
+        else:
+            matched.append((f, entry))
+            used.add(f.fingerprint())
+    stale = [e for fp, e in by_fp.items() if fp not in used]
+    return new, matched, stale
+
+
+def regenerate(
+    findings: list[Finding], old_entries: list[dict]
+) -> list[dict]:
+    """Baseline entries for the current findings, PRESERVING the reason
+    of any entry whose fingerprint still matches; genuinely new entries
+    get a loud placeholder reason that a reviewer must replace."""
+    old_by_fp = {entry_fingerprint(e): e for e in old_entries}
+    out: dict[str, dict] = {}
+    for f in findings:
+        fp = f.fingerprint()
+        if fp in out:
+            continue
+        old = old_by_fp.get(fp)
+        out[fp] = {
+            "check": f.check,
+            "path": f.path,
+            "context": f.context,
+            "line_text": f.line_text,
+            "reason": old["reason"] if old else _PLACEHOLDER_REASON,
+        }
+    return list(out.values())
+
+
+def default_baseline_path(repo_root: str) -> str:
+    return os.path.join(repo_root, DEFAULT_BASENAME)
+
+
+def find_reason(entries: list[dict], finding: Finding) -> Optional[str]:
+    fp = finding.fingerprint()
+    for e in entries:
+        if entry_fingerprint(e) == fp:
+            return e.get("reason")
+    return None

@@ -1,0 +1,451 @@
+"""jaxlint core: findings, check registry, suppressions, and the runner.
+
+The analyzer is pure `ast` over source text — it NEVER imports the
+modules it scans (the one registered exception, the `warmup-registry`
+pass, imports the *registry* it validates against, not the scanned
+files; see analysis/warmup.py). That keeps every check runnable in
+tier-1 under `JAX_PLATFORMS=cpu` in milliseconds, with no device, no
+env pools, and no import side effects.
+
+Vocabulary:
+
+- A **check** is a registered pass. Module-scope checks run once per
+  scanned file and receive a `ModuleInfo`; repo-scope checks run once
+  per analysis and receive the full `list[ModuleInfo]` (they correlate
+  across files, e.g. the warmup registry against every jit site).
+- A **Finding** names one defect at one source location. Its
+  `fingerprint()` deliberately excludes the line NUMBER (check + path +
+  enclosing function + stripped line text) so baselines survive
+  unrelated edits above the finding.
+- A `# jaxlint: disable=<check>[,<check>...]` comment on the flagged
+  line suppresses those checks there (`disable=all` suppresses every
+  check on the line). Suppressions are for findings that are correct
+  about the pattern but wrong about the hazard — put the why in the
+  same comment.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+import tokenize
+from typing import Callable, Iterable, Optional
+
+# ---------------------------------------------------------------------------
+# Findings
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One defect at one location. `context` is the enclosing top-level
+    function ("<module>" at module scope); `line_text` is the stripped
+    source line — together with check+path it forms the line-number-free
+    baseline fingerprint."""
+
+    check: str
+    path: str  # repo-relative, POSIX separators
+    line: int
+    col: int
+    message: str
+    context: str = "<module>"
+    line_text: str = ""
+
+    def fingerprint(self) -> str:
+        return f"{self.check}:{self.path}:{self.context}:{self.line_text}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        return (
+            f"{self.path}:{self.line}:{self.col}: [{self.check}] "
+            f"{self.message} (in {self.context})"
+        )
+
+
+class AnalysisError(Exception):
+    """A scanned file could not be read/parsed — the CLI maps this to
+    exit 2 (crash), distinct from exit 1 (findings)."""
+
+
+# ---------------------------------------------------------------------------
+# Parsed-module facts shared by every check
+# ---------------------------------------------------------------------------
+
+# Check names are comma-separated tokens; free-form reason text after
+# them (e.g. "disable=host-sync (numpy scalar)") is not captured.
+# Anchored to the comment start (like _HOT_RE below): a comment QUOTING
+# a pragma ("# TODO: drop the `# jaxlint: disable=...` below") must not
+# register a real suppression.
+_DISABLE_RE = re.compile(
+    r"^#\s*jaxlint:\s*disable=([A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*)"
+)
+# Anchored: the pragma must START the comment, so a comment QUOTING the
+# pragma (docs, review notes — "the `# jaxlint: hot-module` pragma")
+# cannot opt a file in.
+_HOT_RE = re.compile(r"^#\s*jaxlint:\s*hot-module\b")
+
+
+class ModuleInfo:
+    """One parsed source file plus the derived facts checks keep
+    re-needing: parent links, enclosing-function names, per-line
+    suppressions, and import aliases."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        try:
+            self.tree = ast.parse(source, filename=path)
+        except (SyntaxError, ValueError) as e:
+            raise AnalysisError(f"{relpath}: parse error: {e}") from e
+        self._parents: dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self._parents[child] = node
+        self.hot_module = False  # set by the comment scan below
+        # lineno -> end of the SIMPLE statement starting there (so a
+        # standalone pragma can cover a wrapped multiline expression).
+        # Compound statements (if/for/while/def/...) are deliberately
+        # absent: a pragma before a block header must cover the header
+        # line only, never silently disable the whole block.
+        _compound = (
+            ast.If, ast.For, ast.AsyncFor, ast.While, ast.With,
+            ast.AsyncWith, ast.Try, ast.FunctionDef,
+            ast.AsyncFunctionDef, ast.ClassDef,
+        )
+        self._stmt_end: dict[int, int] = {}
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.stmt) or isinstance(node, _compound):
+                continue
+            self._stmt_end[node.lineno] = max(
+                self._stmt_end.get(node.lineno, node.lineno),
+                node.end_lineno or node.lineno,
+            )
+        self._suppressions = self._scan_suppressions()
+        self.aliases = self._scan_aliases()
+
+    # -- structure ---------------------------------------------------------
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(node)
+
+    def ancestors(self, node: ast.AST) -> Iterable[ast.AST]:
+        cur = self._parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self._parents.get(cur)
+
+    def enclosing_function(self, node: ast.AST) -> str:
+        """The TOP-LEVEL def the node sits in ("<module>" otherwise) —
+        the same keying scripts/check_warmup_registry.py always used, so
+        fingerprints and registry keys agree."""
+        name = "<module>"
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if isinstance(self._parents.get(anc), ast.Module):
+                    name = anc.name
+        return name
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    # -- suppressions ------------------------------------------------------
+
+    def _scan_suppressions(self) -> dict[int, set[str]]:
+        """line -> set of disabled check names. A trailing comment
+        suppresses its own line; a comment-ONLY line suppresses the next
+        SIMPLE statement in full (every physical line of a wrapped
+        call/assignment — findings anchor where the inner expression
+        starts). Before a compound header (`if`/`for`/...) it covers the
+        header line only, never the block. Read via tokenize so a
+        `# jaxlint:` inside a string literal is not a pragma."""
+        out: dict[int, set[str]] = {}
+
+        def record(lineno: int, names: set[str]) -> None:
+            names = {n for n in names if n}
+            stripped = self.lines[lineno - 1].strip()
+            if stripped.startswith("#"):
+                # standalone pragma: cover the next code line AND, when
+                # that line opens a multiline statement, every line of
+                # it — findings anchor where the inner call starts,
+                # which may be a continuation line.
+                for j in range(lineno + 1, len(self.lines) + 1):
+                    nxt = self.lines[j - 1].strip()
+                    if nxt and not nxt.startswith("#"):
+                        end = self._stmt_end.get(j, j)
+                        for k in range(j, end + 1):
+                            out.setdefault(k, set()).update(names)
+                        return
+                return
+            out.setdefault(lineno, set()).update(names)
+
+        try:
+            tokens = tokenize.generate_tokens(iter(self.lines2()).__next__)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                if _HOT_RE.match(tok.string):
+                    # hot-module pragma: COMMENT tokens only, so a
+                    # docstring merely *mentioning* the pragma (this
+                    # package's own docs do) cannot opt a file in.
+                    self.hot_module = True
+                m = _DISABLE_RE.match(tok.string)
+                if m:
+                    record(
+                        tok.start[0],
+                        {n.strip() for n in m.group(1).split(",")},
+                    )
+        except (tokenize.TokenizeError, IndentationError, SyntaxError):
+            # Fall back to comment-looking raw lines; string-literal
+            # false positives only ever OVER-suppress one line.
+            for i, ln in enumerate(self.lines, 1):
+                if not ln.lstrip().startswith("#"):
+                    continue
+                if _HOT_RE.match(ln.lstrip()):
+                    self.hot_module = True
+                m = _DISABLE_RE.match(ln.lstrip())
+                if m:
+                    record(
+                        i, {n.strip() for n in m.group(1).split(",")}
+                    )
+        return out
+
+    def lines2(self):
+        for ln in self.lines:
+            yield ln + "\n"
+
+    def suppressed(self, lineno: int, check: str) -> bool:
+        names = self._suppressions.get(lineno, ())
+        return check in names or "all" in names
+
+    # -- imports -----------------------------------------------------------
+
+    def _scan_aliases(self) -> dict[str, str]:
+        """local name -> canonical dotted module ("np" -> "numpy",
+        "jr" -> "jax.random", "random" -> "jax.random" for
+        `from jax import random`)."""
+        out: dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    out[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    out[a.asname or a.name] = f"{node.module}.{a.name}"
+        return out
+
+    def scope_of(self, node: ast.AST) -> ast.AST:
+        """The top-level def containing `node`, or the module — the
+        statement-ordered analysis unit the dataflow passes share."""
+        scope: ast.AST = self.tree
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if isinstance(self.parent(anc), ast.Module):
+                    scope = anc
+        return scope
+
+    def exclusive_branches(self, a: ast.AST, b: ast.AST) -> bool:
+        """Whether `a` and `b` sit in different arms of a common `if` —
+        at most one of them executes, so path-sensitive checks (reuse,
+        double consumption) must not pair them."""
+        pa = self._branch_map(a)
+        pb = self._branch_map(b)
+        return any(
+            pa[key] != pb[key] for key in pa.keys() & pb.keys()
+        )
+
+    def _branch_map(self, node: ast.AST) -> dict[int, str]:
+        """id(if-node) -> arm ('body'/'orelse') for each `if` ancestor."""
+        out: dict[int, str] = {}
+        child = node
+        for anc in self.ancestors(node):
+            if isinstance(anc, ast.If):
+                if any(child is n for n in anc.body):
+                    out[id(anc)] = "body"
+                elif any(child is n for n in anc.orelse):
+                    out[id(anc)] = "orelse"
+            child = anc
+        return out
+
+    def dotted(self, node: ast.AST) -> Optional[str]:
+        """Canonical dotted name of an expression, alias-resolved at the
+        root: `jr.split` -> "jax.random.split", `np.asarray` ->
+        "numpy.asarray". None for non-name expressions."""
+        parts: list[str] = []
+        cur = node
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if not isinstance(cur, ast.Name):
+            return None
+        root = self.aliases.get(cur.id, cur.id)
+        parts.append(root)
+        return ".".join(reversed(parts))
+
+
+def target_names(tgt: ast.AST, roots: bool = False) -> list[str]:
+    """Bare names an assignment target binds (tuple/list unpacking
+    included). With `roots`, subscript/attribute targets contribute
+    their base name too (`state["k"] = ...` mutates `state` — the
+    aliasing-sensitive passes want that; the binding-sensitive ones do
+    not)."""
+    if isinstance(tgt, ast.Name):
+        return [tgt.id]
+    if isinstance(tgt, (ast.Tuple, ast.List)):
+        return [n for e in tgt.elts for n in target_names(e, roots)]
+    if roots:
+        while isinstance(tgt, (ast.Subscript, ast.Attribute)):
+            tgt = tgt.value
+        if isinstance(tgt, ast.Name):
+            return [tgt.id]
+    return []
+
+
+# ---------------------------------------------------------------------------
+# Check registry
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Check:
+    name: str
+    doc: str  # one line, printed by --list-checks
+    fn: Callable
+    scope: str = "module"  # "module" | "repo"
+
+
+_CHECKS: dict[str, Check] = {}
+
+
+def register_check(name: str, doc: str, scope: str = "module"):
+    """Decorator registering `fn(module_info) -> list[Finding]` (module
+    scope) or `fn(list[ModuleInfo]) -> list[Finding]` (repo scope)."""
+
+    def deco(fn):
+        _CHECKS[name] = Check(name=name, doc=doc, fn=fn, scope=scope)
+        return fn
+
+    return deco
+
+
+def registered_checks() -> tuple[Check, ...]:
+    _ensure_builtin_checks()
+    return tuple(_CHECKS[k] for k in sorted(_CHECKS))
+
+
+def _ensure_builtin_checks() -> None:
+    # Import-for-side-effect: each pass module registers itself. Kept
+    # lazy so `import actor_critic_tpu.analysis.core` alone stays cheap.
+    from actor_critic_tpu.analysis import (  # noqa: F401
+        donation,
+        host_sync,
+        prng,
+        recompile,
+        tracer_leak,
+        warmup,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Runner
+# ---------------------------------------------------------------------------
+
+
+def iter_python_files(paths: Iterable[str], repo_root: str) -> list[str]:
+    """Expand files/dirs to sorted .py paths (skips __pycache__ and
+    hidden directories). Missing paths raise AnalysisError (exit 2: a
+    typo'd path must not read as a clean run)."""
+    out: list[str] = []
+    for p in paths:
+        ap = p if os.path.isabs(p) else os.path.join(repo_root, p)
+        if os.path.isfile(ap):
+            out.append(ap)
+        elif os.path.isdir(ap):
+            for dirpath, dirnames, filenames in os.walk(ap):
+                dirnames[:] = [
+                    d for d in sorted(dirnames)
+                    if d != "__pycache__" and not d.startswith(".")
+                ]
+                out.extend(
+                    os.path.join(dirpath, f)
+                    for f in sorted(filenames)
+                    if f.endswith(".py")
+                )
+        else:
+            raise AnalysisError(f"no such file or directory: {p}")
+    return out
+
+
+def load_modules(paths: Iterable[str], repo_root: str) -> list[ModuleInfo]:
+    modules: list[ModuleInfo] = []
+    for path in iter_python_files(paths, repo_root):
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+        except OSError as e:
+            raise AnalysisError(f"{path}: {e}") from e
+        modules.append(
+            ModuleInfo(path, os.path.relpath(path, repo_root), source)
+        )
+    return modules
+
+
+def run_checks(
+    modules: list[ModuleInfo],
+    checks: Optional[Iterable[str]] = None,
+    skip: Iterable[str] = (),
+) -> list[Finding]:
+    """All findings over the parsed modules, suppression-filtered and
+    sorted by location. `checks` selects a subset by name; `skip` drops
+    names from whatever was selected. Unknown names raise (a typo'd
+    check filter must not read as a clean run)."""
+    _ensure_builtin_checks()
+    selected = list(checks) if checks is not None else sorted(_CHECKS)
+    unknown = [c for c in [*selected, *skip] if c not in _CHECKS]
+    if unknown:
+        raise AnalysisError(
+            f"unknown check(s): {', '.join(sorted(set(unknown)))} "
+            f"(have: {', '.join(sorted(_CHECKS))})"
+        )
+    selected = [c for c in selected if c not in set(skip)]
+
+    by_rel = {m.relpath: m for m in modules}
+    findings: list[Finding] = []
+    for name in selected:
+        check = _CHECKS[name]
+        if check.scope == "repo":
+            raw = check.fn(modules)
+        else:
+            raw = [f for m in modules for f in check.fn(m)]
+        for f in raw:
+            mod = by_rel.get(f.path)
+            if mod is not None:
+                if not f.line_text:
+                    f = dataclasses.replace(
+                        f, line_text=mod.line_text(f.line)
+                    )
+                if mod.suppressed(f.line, f.check):
+                    continue
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.check))
+    return findings
+
+
+def analyze_paths(
+    paths: Iterable[str],
+    repo_root: str,
+    checks: Optional[Iterable[str]] = None,
+    skip: Iterable[str] = (),
+) -> list[Finding]:
+    """Parse + run in one call — the API scripts/jaxlint.py and the
+    tests drive."""
+    return run_checks(load_modules(paths, repo_root), checks=checks, skip=skip)

@@ -1,0 +1,277 @@
+"""donation-aliasing: donated jit arguments fed buffers that are not
+jit's to free — the PR 4 heap-corruption class.
+
+Two hazards, both found at donating call sites (`donate_argnums` /
+`donate_argnames` wrap or decoration, resolved by analysis/jitinfo.py):
+
+1. **Restored buffers**: the argument flows from a checkpoint restore
+   (`*.restore(...)`, `resume_or_init`, `host_resume`) without being
+   re-placed (`uncommit` / `jnp.copy` / `device_put`). Donating a
+   restore-aliased buffer into a deserialized executable corrupted the
+   glibc heap in PR 4 (`checkpoint.uncommit` is the fix; this check
+   keeps the class from coming back at a NEW call site).
+2. **Use after donation**: the donated name is read again after the
+   donating call without being rebound by it — including the
+   loop-carried form (`for ...: metrics = step(state)` with `state`
+   never rebound, so iteration 2 donates a freed buffer). The donated
+   buffer is freed (or worse, aliased by the output) — classic
+   use-after-free that only crashes under real memory pressure.
+
+Dataflow is per top-level function, statement-ordered by line number:
+restore-taint enters at restore-like assignments, propagates through
+name/subscript/attribute aliasing, and is cleared by any other
+rebinding (so `state = uncommit(state)` cleans the name).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from actor_critic_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    register_check,
+    target_names,
+)
+from actor_critic_tpu.analysis.jitinfo import named_jit_sites
+
+CHECK = "donation-aliasing"
+
+# Call names that yield restore-aliased buffers. Taint is cleared by
+# rebinding from ANY other call's result (a call output is a fresh
+# value — `state = uncommit(state)` cleans the name, and so does any
+# transform of it); only name/subscript/attribute aliasing propagates.
+_RESTORE_FUNCS = {"resume_or_init", "host_resume", "restore"}
+
+
+def _root_name(node: ast.AST) -> Optional[str]:
+    """The base Name of a Name/Subscript/Attribute chain."""
+    while isinstance(node, (ast.Subscript, ast.Attribute)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _last_attr(mod: ModuleInfo, func: ast.AST) -> Optional[str]:
+    dotted = mod.dotted(func)
+    return dotted.rsplit(".", 1)[-1] if dotted else None
+
+
+class _TaintScope:
+    """Restore-taint of names within one top-level function, queried by
+    line number (assignments before the line decide)."""
+
+    def __init__(self, mod: ModuleInfo, scope: ast.AST):
+        self.mod = mod
+        # name -> [(lineno, restored_bool)] in line order
+        self.history: dict[str, list[tuple[int, bool]]] = {}
+        assigns: list[tuple[int, ast.AST, ast.AST]] = []
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    assigns.append((node.lineno, tgt, node.value))
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                if node.value is not None:
+                    assigns.append((node.lineno, node.target, node.value))
+        for lineno, tgt, value in sorted(assigns, key=lambda a: a[0]):
+            restored = self._value_restored(value, lineno)
+            for name in target_names(tgt, roots=True):
+                self.history.setdefault(name, []).append((lineno, restored))
+
+    def _value_restored(self, value: ast.AST, lineno: int) -> bool:
+        if isinstance(value, ast.Call):
+            attr = _last_attr(self.mod, value.func)
+            if attr in _RESTORE_FUNCS:
+                return True
+            return False  # any other call output is a fresh value
+        root = _root_name(value)
+        if root is not None:
+            return self.restored(root, lineno + 1)
+        return False
+
+    def restored(self, name: str, before_line: int) -> bool:
+        state = False
+        for lineno, restored in self.history.get(name, ()):
+            if lineno < before_line:
+                state = restored
+            else:
+                break
+        return state
+
+
+def _assign_targets_of_call(mod: ModuleInfo, call: ast.Call) -> set[str]:
+    """Names the enclosing statement rebinds to this call's result."""
+    parent = mod.parent(call)
+    # tolerate  `a = b = f(x)`  and  `a, b = f(x)`  one level up
+    if isinstance(parent, ast.Assign):
+        return {
+            n for tgt in parent.targets for n in target_names(tgt)
+        }
+    if isinstance(parent, (ast.AnnAssign, ast.AugAssign)) and isinstance(
+        parent.target, ast.Name
+    ):
+        return {parent.target.id}
+    return set()
+
+
+def _reused_after(
+    mod: ModuleInfo, scope: ast.AST, name: str, call: ast.Call
+) -> Optional[int]:
+    """First line after the donating call where `name` is read on a
+    path that can follow it. Excluded: reads INSIDE the call itself (a
+    multiline call's own argument sits on a later physical line) and
+    reads in an exclusive sibling `if` arm (alternatives, not
+    use-after-free)."""
+    own = {id(n) for n in ast.walk(call)}
+    best: Optional[int] = None
+    for node in ast.walk(scope):
+        if (
+            isinstance(node, ast.Name)
+            and node.id == name
+            and isinstance(node.ctx, ast.Load)
+            and id(node) not in own
+            and node.lineno > call.lineno
+            and not mod.exclusive_branches(call, node)
+        ):
+            best = node.lineno if best is None else min(best, node.lineno)
+    return best
+
+
+def _loop_without_rebind(
+    mod: ModuleInfo, call: ast.Call, name: str, scope: ast.AST
+) -> Optional[ast.AST]:
+    """The innermost for/while around the donating call in which `name`
+    is never (re)bound — iteration 2 would donate a freed buffer. None
+    when no such loop exists."""
+    loop = None
+    for anc in mod.ancestors(call):
+        if anc is scope:
+            break
+        if isinstance(anc, (ast.For, ast.AsyncFor, ast.While)):
+            loop = anc
+            break
+    if loop is None:
+        return None
+    for node in ast.walk(loop):
+        if isinstance(node, ast.Assign):
+            if any(name in target_names(t) for t in node.targets):
+                return None
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            if name in target_names(node.target):
+                return None
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            if name in target_names(node.target):
+                return None
+    return loop
+
+
+@register_check(
+    CHECK,
+    "donated jit args fed checkpoint-restored or still-live buffers "
+    "(PR 4 heap-corruption class)",
+)
+def check_donation_aliasing(mod: ModuleInfo) -> list[Finding]:
+    sites = {n: s for n, s in named_jit_sites(mod).items() if s.donates}
+    if not sites:
+        return []
+    findings: list[Finding] = []
+    taints: dict[ast.AST, _TaintScope] = {}
+
+    for call in ast.walk(mod.tree):
+        if not isinstance(call, ast.Call) or not isinstance(
+            call.func, ast.Name
+        ):
+            continue
+        site = sites.get(call.func.id)
+        if site is None:
+            continue
+        positions = site.donated_positions()
+        if not positions and site.donates:
+            positions = (0,)  # jax's overwhelmingly common convention
+        donated_args: list[ast.AST] = [
+            call.args[p]
+            for p in positions
+            if p < len(call.args)
+            and not isinstance(call.args[p], ast.Starred)
+        ]
+        donated_args += [
+            kw.value for kw in call.keywords if kw.arg in site.donate_argnames
+        ]
+        if not donated_args:
+            continue
+
+        scope = mod.scope_of(call)
+        if scope not in taints:
+            taints[scope] = _TaintScope(mod, scope)
+        taint = taints[scope]
+        rebound = _assign_targets_of_call(mod, call)
+        context = mod.enclosing_function(call)
+
+        for arg in donated_args:
+            # direct `f(ckpt.restore(t))`
+            if (
+                isinstance(arg, ast.Call)
+                and _last_attr(mod, arg.func) in _RESTORE_FUNCS
+            ):
+                findings.append(
+                    Finding(
+                        CHECK, mod.relpath, arg.lineno, arg.col_offset,
+                        f"donating the result of a checkpoint restore into "
+                        f"jitted `{call.func.id}` — restore-aliased buffers "
+                        "must be re-placed first (checkpoint.uncommit / "
+                        "jnp.copy)",
+                        context,
+                    )
+                )
+                continue
+            name = _root_name(arg)
+            if name is None:
+                continue
+            if taint.restored(name, call.lineno):
+                findings.append(
+                    Finding(
+                        CHECK, mod.relpath, arg.lineno, arg.col_offset,
+                        f"`{name}` flows from a checkpoint restore and is "
+                        f"donated into jitted `{call.func.id}` — donating a "
+                        "restore-aliased buffer into a deserialized "
+                        "executable corrupts the heap (PR 4); re-place it "
+                        "(checkpoint.uncommit / jnp.copy) first",
+                        context,
+                    )
+                )
+            if isinstance(mod.parent(call), ast.Return):
+                # a donating call in a `return` ends its path; a read on
+                # a LATER line is a sibling branch, not a use-after-free
+                continue
+            loop = _loop_without_rebind(mod, call, name, scope)
+            if loop is not None:
+                # the canonical PR 4 shape: iteration 2 donates the
+                # buffer iteration 1 already freed
+                findings.append(
+                    Finding(
+                        CHECK, mod.relpath, call.lineno, call.col_offset,
+                        f"`{name}` is donated into jitted "
+                        f"`{call.func.id}` inside the loop at line "
+                        f"{loop.lineno} but never rebound in it — the "
+                        "next iteration donates an already-freed buffer; "
+                        "rebind the result (`out = "
+                        f"{call.func.id}(...)`) or drop the donation",
+                        context,
+                    )
+                )
+                continue
+            if name not in rebound:
+                reuse_line = _reused_after(mod, scope, name, call)
+                if reuse_line is not None:
+                    findings.append(
+                        Finding(
+                            CHECK, mod.relpath, call.lineno, call.col_offset,
+                            f"`{name}` is donated into jitted "
+                            f"`{call.func.id}` but read again at line "
+                            f"{reuse_line} — a donated buffer is freed by "
+                            "the call; rebind the result or drop the "
+                            "donation",
+                            context,
+                        )
+                    )
+    return findings

@@ -1,0 +1,219 @@
+"""Shared jit-site resolution for the AST passes.
+
+Three passes need the same fact: "which local names are jit-compiled
+callables in this module, and with what donate/static argument
+configuration?" — donation-aliasing (donated positions), tracer-leak
+(which defs trace their params), recompile-hazard (static positions at
+call sites). This module extracts it once, recognizing the three forms
+the codebase actually writes (the same set
+scripts/check_warmup_registry.py always matched):
+
+    @jax.jit / @partial(jax.jit, ...)        decorated defs
+    name = jax.jit(fn, ...)                  wrap assignments
+    jax.jit(fn, ...)                         anonymous wraps (call sites
+                                             only, no name to track)
+
+Keyword literals (donate_argnums/donate_argnames/static_argnums/
+static_argnames) are parsed when they are int/str constants or tuples/
+lists thereof; non-literal values are treated as unknown (empty), never
+guessed.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Optional
+
+from actor_critic_tpu.analysis.core import ModuleInfo
+
+_PARTIAL = {"functools.partial", "partial"}
+
+
+@dataclasses.dataclass
+class JitSite:
+    """One jit-compiled callable and its argument configuration."""
+
+    name: str  # local name it is callable under ("" when anonymous)
+    lineno: int
+    donate_argnums: tuple[int, ...] = ()
+    donate_argnames: tuple[str, ...] = ()
+    static_argnums: tuple[int, ...] = ()
+    static_argnames: tuple[str, ...] = ()
+    func_def: Optional[ast.AST] = None  # wrapped/decorated def if resolvable
+    donates_unknown: bool = False  # donate_* present but not a literal
+
+    @property
+    def donates(self) -> bool:
+        return bool(
+            self.donate_argnums or self.donate_argnames or self.donates_unknown
+        )
+
+    def params(self) -> tuple[str, ...]:
+        if self.func_def is None or not isinstance(
+            self.func_def, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            return ()
+        a = self.func_def.args
+        return tuple(
+            p.arg for p in [*a.posonlyargs, *a.args, *a.kwonlyargs]
+        )
+
+    def donated_positions(self) -> tuple[int, ...]:
+        """Donated positional indices, argnames resolved through the
+        wrapped def's signature when known."""
+        pos = set(self.donate_argnums)
+        params = self.params()
+        for n in self.donate_argnames:
+            if n in params:
+                pos.add(params.index(n))
+        return tuple(sorted(pos))
+
+    def static_positions(self) -> tuple[int, ...]:
+        pos = set(self.static_argnums)
+        params = self.params()
+        for n in self.static_argnames:
+            if n in params:
+                pos.add(params.index(n))
+        return tuple(sorted(pos))
+
+
+def _literal_ints(node: ast.AST) -> tuple[tuple[int, ...], bool]:
+    """(values, is_literal) for an int-or-int-tuple keyword value."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,), True
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                vals.append(elt.value)
+            else:
+                return (), False
+        return tuple(vals), True
+    return (), False
+
+
+def _literal_strs(node: ast.AST) -> tuple[tuple[str, ...], bool]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,), True
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                vals.append(elt.value)
+            else:
+                return (), False
+        return tuple(vals), True
+    return (), False
+
+
+def _apply_keywords(site: JitSite, keywords: list[ast.keyword]) -> None:
+    for kw in keywords:
+        if kw.arg == "donate_argnums":
+            vals, lit = _literal_ints(kw.value)
+            site.donate_argnums = vals
+            site.donates_unknown |= not lit
+        elif kw.arg == "donate_argnames":
+            vals, lit = _literal_strs(kw.value)
+            site.donate_argnames = vals
+            site.donates_unknown |= not lit
+        elif kw.arg == "static_argnums":
+            site.static_argnums, _ = _literal_ints(kw.value)
+        elif kw.arg == "static_argnames":
+            site.static_argnames, _ = _literal_strs(kw.value)
+
+
+def is_jax_jit_expr(mod: ModuleInfo, node: ast.AST) -> bool:
+    """Whether `node` denotes the `jax.jit` transform itself: the bare
+    attribute, or `partial(jax.jit, ...)`."""
+    if mod.dotted(node) == "jax.jit":
+        return True
+    return (
+        isinstance(node, ast.Call)
+        and mod.dotted(node.func) in _PARTIAL
+        and bool(node.args)
+        and mod.dotted(node.args[0]) == "jax.jit"
+    )
+
+
+def _jit_call_keywords(mod: ModuleInfo, call: ast.Call) -> Optional[list]:
+    """keywords when `call` invokes jax.jit (directly or through a
+    partial(jax.jit, ...) callee); None when it does not."""
+    if mod.dotted(call.func) == "jax.jit":
+        return list(call.keywords)
+    if is_jax_jit_expr(mod, call.func) and isinstance(call.func, ast.Call):
+        return list(call.func.keywords) + list(call.keywords)
+    return None
+
+
+def _local_defs(mod: ModuleInfo) -> dict[str, ast.AST]:
+    out: dict[str, ast.AST] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out[node.name] = node
+    return out
+
+
+def collect_jit_sites(mod: ModuleInfo) -> list[JitSite]:
+    """Every jit-compiled callable in the module. Named entries (bound
+    via assignment or decoration) are callable-by-name at call sites;
+    anonymous wraps still appear (name="") for passes that only care
+    about where jit is invoked."""
+    defs = _local_defs(mod)
+    sites: list[JitSite] = []
+
+    for node in ast.walk(mod.tree):
+        # -- decorated defs ------------------------------------------------
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                site = None
+                if mod.dotted(dec) == "jax.jit":
+                    site = JitSite(node.name, node.lineno, func_def=node)
+                elif isinstance(dec, ast.Call):
+                    kws = None
+                    if mod.dotted(dec.func) == "jax.jit":
+                        kws = list(dec.keywords)
+                    elif (
+                        mod.dotted(dec.func) in _PARTIAL
+                        and dec.args
+                        and mod.dotted(dec.args[0]) == "jax.jit"
+                    ):
+                        kws = list(dec.keywords)
+                    if kws is not None:
+                        site = JitSite(node.name, node.lineno, func_def=node)
+                        _apply_keywords(site, kws)
+                if site is not None:
+                    sites.append(site)
+                    break
+        # -- wrap calls ----------------------------------------------------
+        elif isinstance(node, ast.Call):
+            kws = _jit_call_keywords(mod, node)
+            if kws is None:
+                continue
+            target = node.args[0] if node.args else None
+            func_def = None
+            if isinstance(target, ast.Name):
+                func_def = defs.get(target.id)
+            elif isinstance(target, ast.Lambda):
+                func_def = target
+            name = ""
+            parent = mod.parent(node)
+            if isinstance(parent, ast.Assign):
+                tgt = parent.targets[0]
+                if isinstance(tgt, ast.Name):
+                    name = tgt.id
+            site = JitSite(name, node.lineno, func_def=func_def)
+            _apply_keywords(site, kws)
+            sites.append(site)
+
+    return sites
+
+
+def named_jit_sites(mod: ModuleInfo) -> dict[str, JitSite]:
+    """name -> JitSite for the callable-by-name entries (last binding
+    wins, matching runtime shadowing)."""
+    out: dict[str, JitSite] = {}
+    for site in sorted(collect_jit_sites(mod), key=lambda s: s.lineno):
+        if site.name:
+            out[site.name] = site
+    return out

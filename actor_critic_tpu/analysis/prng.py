@@ -1,0 +1,231 @@
+"""prng-reuse: one PRNG key consumed by two `jax.random.*` calls.
+
+Reusing a key gives correlated (identical) randomness — in this
+codebase that means identical exploration noise across calls, identical
+minibatch permutations across epochs, and silently broken statistics
+rather than a crash. The contract is one consumption per key binding:
+`split`/`fold_in` and rebind before the next use.
+
+Mechanics (per top-level function, statement-ordered by line number):
+
+- A name becomes a *tracked key* when it is ever bound from a producer
+  (`jax.random.key/PRNGKey/split/fold_in/clone/wrap_key_data`),
+  including tuple unpacking (`key, sub = jax.random.split(key)`).
+- Every `jax.random.*` call consumes the tracked keys it takes as bare
+  `Name` arguments (subscripted uses like `keys[i]` are per-element and
+  not tracked). `split` consumes too — that is the idiom's point.
+  `fold_in` consumes NOTHING: deriving per-step keys from one parent
+  (`fold_in(key, i)`) deliberately keeps the parent live.
+- Consumptions in mutually exclusive `if` arms are alternatives (at
+  most one executes) and never pair into a reuse finding.
+- Any assignment to the name resets its consumption count (same-line
+  `key, sub = split(key)` consumes the old binding first, then
+  rebinds).
+- A second consumption of one binding flags. A consumption inside a
+  `for`/`while` whose binding was made OUTSIDE the loop (and never
+  rebound inside it) flags once per loop — every iteration reuses the
+  same key.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from actor_critic_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    register_check,
+    target_names as _target_names,
+)
+
+CHECK = "prng-reuse"
+
+_PRODUCERS = {
+    "key", "PRNGKey", "split", "fold_in", "clone", "wrap_key_data",
+}
+
+
+def _is_jax_random_call(mod: ModuleInfo, call: ast.Call) -> Optional[str]:
+    """The jax.random function name ("split", "normal", ...) or None."""
+    dotted = mod.dotted(call.func)
+    if dotted and dotted.startswith("jax.random."):
+        return dotted.rsplit(".", 1)[-1]
+    return None
+
+
+def _scopes(mod: ModuleInfo):
+    """Every function def (nested included) plus the module top level.
+    Each def is its own scope: two sibling closures both naming their
+    key `key` (the repo's idiom) are unrelated bindings, and analyzing
+    them flat would count one's consumption against the other's."""
+    yield mod.tree
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _walk_scope(scope: ast.AST, mod: ModuleInfo):
+    """Walk `scope` WITHOUT descending into nested defs (their own
+    scopes). Lambdas stay in the enclosing scope — they cannot rebind
+    names, so their consumptions belong to the scope they close over."""
+    if isinstance(
+        scope, (ast.Module, ast.FunctionDef, ast.AsyncFunctionDef)
+    ):
+        # the scope's own statements, minus child defs (their own scopes)
+        stack = [
+            n
+            for n in scope.body
+            if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+    else:
+        stack = [scope]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            stack.append(child)
+
+
+@register_check(
+    CHECK,
+    "a PRNG key consumed by two jax.random.* calls without an "
+    "intervening split/fold_in (correlated randomness)",
+)
+def check_prng_reuse(mod: ModuleInfo) -> list[Finding]:
+    findings: list[Finding] = []
+    for scope in _scopes(mod):
+        # ---- gather events -------------------------------------------
+        binds: list[tuple[int, str, bool]] = []  # (line, name, from_producer)
+        consumes: list[tuple[int, str, ast.Call]] = []
+        loops: list[ast.AST] = []
+        if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # parameters bind at the def line (so a key param consumed
+            # inside a loop without rebinding reads as loop-carried)
+            a = scope.args
+            binds.extend(
+                (scope.lineno, p.arg, False)
+                for p in [*a.posonlyargs, *a.args, *a.kwonlyargs]
+            )
+        for node in _walk_scope(scope, mod):
+            if isinstance(node, (ast.For, ast.While)):
+                loops.append(node)
+                if isinstance(node, ast.For):
+                    for n in _target_names(node.target):
+                        binds.append((node.lineno, n, False))
+            if isinstance(node, ast.Assign):
+                from_prod = (
+                    isinstance(node.value, ast.Call)
+                    and _is_jax_random_call(mod, node.value) in _PRODUCERS
+                )
+                for tgt in node.targets:
+                    for n in _target_names(tgt):
+                        binds.append((node.lineno, n, from_prod))
+            elif (
+                isinstance(node, (ast.AnnAssign, ast.AugAssign))
+                and node.value is not None
+            ):
+                from_prod = (
+                    isinstance(node.value, ast.Call)
+                    and _is_jax_random_call(mod, node.value) in _PRODUCERS
+                )
+                for n in _target_names(node.target):
+                    binds.append((node.lineno, n, from_prod))
+            if isinstance(node, ast.Call):
+                fn = _is_jax_random_call(mod, node)
+                # fold_in never counts as consumption: deriving
+                # per-step keys from one parent (`fold_in(key, i)`) is
+                # the sanctioned loop idiom, and the parent deliberately
+                # stays live across derivations.
+                if fn is not None and fn != "fold_in":
+                    for arg in [
+                        *node.args,
+                        *[kw.value for kw in node.keywords],
+                    ]:
+                        if isinstance(arg, ast.Name):
+                            consumes.append((node.lineno, arg.id, node))
+
+        tracked = {n for _, n, p in binds if p}
+        # A def's key-like parameters are keys by convention even though
+        # no producer call binds them in this scope (`def reset(key):`).
+        if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            a = scope.args
+            for p in [*a.posonlyargs, *a.args, *a.kwonlyargs]:
+                if "key" in p.arg.lower() or "rng" in p.arg.lower():
+                    tracked.add(p.arg)
+        if not tracked:
+            continue
+
+        # ---- linear replay -------------------------------------------
+        # Same-line order: consumptions read the OLD binding, then the
+        # assignment rebinds (the `key, sub = split(key)` idiom).
+        events = sorted(
+            [(ln, 0, n, node) for ln, n, node in consumes if n in tracked]
+            + [(ln, 1, n, None) for ln, n, _p in binds if n in tracked],
+            key=lambda e: (e[0], e[1]),
+        )
+        since_bind: dict[str, list[ast.Call]] = {}
+        for ln, kind, name, node in events:
+            if kind == 1:
+                since_bind[name] = []
+                continue
+            prev = since_bind.setdefault(name, [])
+            # Consumptions in mutually exclusive `if` arms are
+            # alternatives, not reuse — only pair path-compatible uses.
+            clash = [
+                p for p in prev if not mod.exclusive_branches(p, node)
+            ]
+            if clash:
+                findings.append(
+                    Finding(
+                        CHECK, mod.relpath, ln, node.col_offset,
+                        f"PRNG key `{name}` is consumed again (previous "
+                        f"consumption at line {clash[-1].lineno}) without "
+                        "an intervening split — reused keys repeat their "
+                        "randomness; split and rebind first",
+                        mod.enclosing_function(node),
+                    )
+                )
+            prev.append(node)
+
+        # ---- loop-carried reuse --------------------------------------
+        flagged: set[tuple[str, int]] = set()
+        for ln, name, node in consumes:
+            if name not in tracked:
+                continue
+            loop = _innermost_loop(loops, ln)
+            if loop is None or (name, loop.lineno) in flagged:
+                continue
+            bound_before = max(
+                (bl for bl, n, _p in binds if n == name and bl < loop.lineno),
+                default=None,
+            )
+            bound_inside = any(
+                n == name and loop.lineno <= bl <= (loop.end_lineno or bl)
+                for bl, n, _p in binds
+            )
+            if bound_before is not None and not bound_inside:
+                flagged.add((name, loop.lineno))
+                findings.append(
+                    Finding(
+                        CHECK, mod.relpath, ln, node.col_offset,
+                        f"PRNG key `{name}` is consumed inside a loop but "
+                        "bound outside it — every iteration reuses the "
+                        "same key; split per iteration (`key, sub = "
+                        "jax.random.split(key)`)",
+                        mod.enclosing_function(node),
+                    )
+                )
+    return findings
+
+
+def _innermost_loop(loops: list[ast.AST], lineno: int) -> Optional[ast.AST]:
+    best = None
+    for lp in loops:
+        end = lp.end_lineno or lp.lineno
+        if lp.lineno < lineno <= end:
+            if best is None or lp.lineno > best.lineno:
+                best = lp
+    return best

@@ -1,0 +1,194 @@
+"""recompile-hazard: call patterns that defeat the compile-once
+contract (the storms PR 3 could only observe and PR 4 prevents).
+
+Two sub-patterns, both visible from source:
+
+1. **jit construction inside a loop** — `jax.jit(...)` (or
+   `partial(jax.jit, ...)` application, or a jit-decorated def)
+   evaluated in a `for`/`while`/comprehension body builds a FRESH
+   callable per iteration. Each fresh callable has an empty dispatch
+   cache, so every call re-traces (and, for closures over loop
+   variables — the f-string/`.shape`-captured closure case — compiles a
+   distinct program per iteration). Hoist the jit out of the loop.
+
+2. **shape-churning scalar arguments** — a known-jitted callable fed a
+   `len(...)`- or `.shape`-derived Python value (directly or through a
+   local name) that is not covered by `static_argnums`/
+   `static_argnames`. Used as a shape inside the program it either
+   fails to trace or gets marked static — and then every distinct value
+   is its own XLA program (the chunked-tail storm
+   `compile_cache.make_chunked_step` exists to fix). Pass a padded
+   bucket (`pad_to_bucket`) or pin it dynamic with
+   `jnp.asarray(x, dtype)`.
+
+Near-misses that stay clean: args already wrapped in
+`jnp.asarray`/`np.asarray`/`jnp.array` (dynamic, dtype-pinned), and
+positions the wrap explicitly lists in `static_argnums` (the author
+opted into per-value compilation deliberately).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from actor_critic_tpu.analysis.core import Finding, ModuleInfo, register_check
+from actor_critic_tpu.analysis.jitinfo import (
+    is_jax_jit_expr,
+    named_jit_sites,
+)
+
+CHECK = "recompile-hazard"
+
+_LOOPS = (
+    ast.For, ast.AsyncFor, ast.While,
+    ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp,
+)
+_ASARRAY = {
+    "jax.numpy.asarray", "jax.numpy.array", "numpy.asarray", "numpy.array",
+    "jnp.asarray", "jnp.array",
+}
+
+
+def _in_loop(mod: ModuleInfo, node: ast.AST) -> bool:
+    return any(isinstance(a, _LOOPS) for a in mod.ancestors(node))
+
+
+def _is_shape_derived(mod: ModuleInfo, expr: ast.AST) -> Optional[str]:
+    """A human-readable description when `expr` is len()- or
+    .shape-derived, else None."""
+    if isinstance(expr, ast.Call) and mod.dotted(expr.func) == "len":
+        return "a len(...) value"
+    if isinstance(expr, ast.Attribute) and expr.attr == "shape":
+        return "a .shape tuple"
+    if (
+        isinstance(expr, ast.Subscript)
+        and isinstance(expr.value, ast.Attribute)
+        and expr.value.attr == "shape"
+    ):
+        return "a .shape[i] value"
+    if isinstance(expr, ast.BinOp):
+        return _is_shape_derived(mod, expr.left) or _is_shape_derived(
+            mod, expr.right
+        )
+    return None
+
+
+def _latest_assignment(
+    mod: ModuleInfo, scope: ast.AST, name: str, before: int
+) -> Optional[ast.AST]:
+    best_line = -1
+    best_value: Optional[ast.AST] = None
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Assign) and node.lineno < before:
+            for tgt in node.targets:
+                targets = (
+                    [tgt] if isinstance(tgt, ast.Name) else (
+                        tgt.elts if isinstance(tgt, (ast.Tuple, ast.List))
+                        else []
+                    )
+                )
+                for i, t in enumerate(targets):
+                    if isinstance(t, ast.Name) and t.id == name:
+                        if node.lineno > best_line:
+                            best_line = node.lineno
+                            # tuple-unpack of `x.shape` marks every
+                            # target shape-derived
+                            best_value = node.value
+    return best_value
+
+
+@register_check(
+    CHECK,
+    "jit built inside a loop, or shape-/len()-derived scalars fed to "
+    "jitted calls (re-trace per iteration / per value)",
+)
+def check_recompile_hazard(mod: ModuleInfo) -> list[Finding]:
+    findings: list[Finding] = []
+
+    # -- 1. jit construction inside a loop --------------------------------
+    for node in ast.walk(mod.tree):
+        is_wrap = isinstance(node, ast.Call) and (
+            mod.dotted(node.func) == "jax.jit"
+            or is_jax_jit_expr(mod, node.func)
+        )
+        is_dec = isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ) and any(is_jax_jit_expr(mod, d) for d in node.decorator_list)
+        if (is_wrap or is_dec) and _in_loop(mod, node):
+            findings.append(
+                Finding(
+                    CHECK, mod.relpath, node.lineno, node.col_offset,
+                    "jax.jit evaluated inside a loop — every iteration "
+                    "builds a fresh callable with an empty dispatch cache "
+                    "(re-trace per iteration); hoist the jit out of the "
+                    "loop",
+                    mod.enclosing_function(node),
+                )
+            )
+
+    # -- 2. shape-churning scalar args at jitted call sites ----------------
+    sites = named_jit_sites(mod)
+    if not sites:
+        return findings
+    for call in ast.walk(mod.tree):
+        if not isinstance(call, ast.Call) or not isinstance(
+            call.func, ast.Name
+        ):
+            continue
+        site = sites.get(call.func.id)
+        if site is None:
+            continue
+        static_pos = set(site.static_positions())
+        static_names = set(site.static_argnames)
+        scope = None
+        for i, arg in enumerate(call.args):
+            if i in static_pos or isinstance(arg, ast.Starred):
+                continue
+            self_desc = _describe_hazard(mod, call, arg)
+            if self_desc is None and isinstance(arg, ast.Name):
+                if scope is None:
+                    scope = mod.scope_of(call)
+                value = _latest_assignment(mod, scope, arg.id, call.lineno)
+                if value is not None:
+                    derived = _is_shape_derived(mod, value)
+                    if derived is not None:
+                        self_desc = f"`{arg.id}` ({derived})"
+            if self_desc is not None:
+                findings.append(
+                    Finding(
+                        CHECK, mod.relpath, arg.lineno, arg.col_offset,
+                        f"jitted `{call.func.id}` is fed {self_desc} — a "
+                        "data-dependent Python scalar either fails to "
+                        "trace or (marked static) compiles one program "
+                        "per distinct value; pad to a bucket "
+                        "(compile_cache.pad_to_bucket) or pin it dynamic "
+                        "with jnp.asarray(x, dtype)",
+                        mod.enclosing_function(call),
+                    )
+                )
+        for kw in call.keywords:
+            if kw.arg in static_names or kw.arg is None:
+                continue
+            desc = _describe_hazard(mod, call, kw.value)
+            if desc is not None:
+                findings.append(
+                    Finding(
+                        CHECK, mod.relpath, kw.value.lineno,
+                        kw.value.col_offset,
+                        f"jitted `{call.func.id}` is fed {desc} via "
+                        f"`{kw.arg}=` — each distinct value re-traces; "
+                        "mark it static deliberately or pin it dynamic "
+                        "with jnp.asarray(x, dtype)",
+                        mod.enclosing_function(call),
+                    )
+                )
+    return findings
+
+
+def _describe_hazard(
+    mod: ModuleInfo, call: ast.Call, arg: ast.AST
+) -> Optional[str]:
+    if isinstance(arg, ast.Call) and mod.dotted(arg.func) in _ASARRAY:
+        return None  # dtype-pinned dynamic array: the sanctioned form
+    return _is_shape_derived(mod, arg)

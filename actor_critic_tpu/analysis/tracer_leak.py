@@ -1,0 +1,200 @@
+"""tracer-leak: Python control flow on traced values inside jit.
+
+Inside a jit-compiled function the arguments are tracers; `if x > 0:`,
+`while x < n:`, `assert x.all()` or `bool(x)` on a value that flows
+from a parameter forces concretization and raises
+`TracerBoolConversionError` at trace time — or worse, silently bakes
+one branch in when the value happens to be concrete during tracing
+(weak constants, closed-over arrays). The fix is `lax.cond`/`jnp.where`
+or hoisting the value to a `static_argnums` argument.
+
+What does NOT flag (the near-misses that make this check usable):
+
+- `.shape` / `.ndim` / `.dtype` / `.size` derivations — static under
+  tracing; branching on them is the standard shape-specialization
+  idiom (`if B % cfg.num_minibatches != 0: raise ...`).
+- `len(x)`, `isinstance`, `hasattr`, `type` — concrete under tracing.
+- `x is None` / `x is not None` — Python-level presence checks on
+  optional arguments, resolved at trace time.
+- Parameters named in the site's `static_argnums`/`static_argnames`.
+
+Scope: defs detected as jit targets by analysis/jitinfo.py (decorated,
+wrap-assigned, or anonymous `jax.jit(f)`), parameters tainted, taint
+propagated through assignments in the def (nested defs included — a
+scan body defined inside a jitted def traces its closure too).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from actor_critic_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    register_check,
+    target_names,
+)
+from actor_critic_tpu.analysis.jitinfo import collect_jit_sites
+
+CHECK = "tracer-leak"
+
+# Attribute accesses that yield static (non-traced) values.
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding"}
+# Builtin calls whose result is concrete even on tracer arguments.
+_STATIC_CALLS = {"len", "isinstance", "hasattr", "type", "getattr", "callable"}
+
+
+def _is_none(node: ast.AST) -> bool:
+    return isinstance(node, ast.Constant) and node.value is None
+
+
+class _Tainter:
+    """Taint = "flows from a traced parameter". Assignment-ordered by
+    line number within one jitted def (nested defs share the space —
+    their bodies trace with the enclosing jit)."""
+
+    def __init__(self, mod: ModuleInfo, fn: ast.AST, tainted: set[str]):
+        self.mod = mod
+        self.tainted = set(tainted)
+        assigns = []
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    assigns.append((node.lineno, tgt, node.value))
+            elif (
+                isinstance(node, (ast.AnnAssign, ast.AugAssign))
+                and node.value is not None
+            ):
+                assigns.append((node.lineno, node.target, node.value))
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                # loop target over a tainted iterable is tainted
+                assigns.append((node.lineno, node.target, node.iter))
+        for _, tgt, value in sorted(assigns, key=lambda a: a[0]):
+            names = target_names(tgt)
+            if self.expr_tainted(value):
+                self.tainted.update(names)
+            else:
+                self.tainted.difference_update(names)
+
+    def expr_tainted(self, expr: ast.AST) -> bool:
+        """Whether the expression carries taint after sanitization."""
+        if expr is None:
+            return False
+        if isinstance(expr, ast.Name):
+            return expr.id in self.tainted
+        if isinstance(expr, ast.Attribute):
+            if expr.attr in _STATIC_ATTRS:
+                return False  # static metadata of a traced value
+            return self.expr_tainted(expr.value)
+        if isinstance(expr, ast.Call):
+            name = self.mod.dotted(expr.func)
+            if name in _STATIC_CALLS:
+                return False
+            # a call's output is tainted if any input is (conservative
+            # for jnp math, which is exactly the point)
+            return any(
+                self.expr_tainted(a)
+                for a in [
+                    *expr.args,
+                    *[kw.value for kw in expr.keywords],
+                ]
+            ) or self.expr_tainted(expr.func)
+        if isinstance(expr, ast.Compare):
+            ops_are_is = all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in expr.ops
+            )
+            comparators_none = all(
+                _is_none(c) for c in expr.comparators
+            ) or _is_none(expr.left)
+            if ops_are_is and comparators_none:
+                return False  # `x is None` — trace-time presence check
+            return self.expr_tainted(expr.left) or any(
+                self.expr_tainted(c) for c in expr.comparators
+            )
+        if isinstance(expr, ast.BoolOp):
+            return any(self.expr_tainted(v) for v in expr.values)
+        if isinstance(expr, ast.UnaryOp):
+            return self.expr_tainted(expr.operand)
+        if isinstance(expr, ast.BinOp):
+            return self.expr_tainted(expr.left) or self.expr_tainted(
+                expr.right
+            )
+        if isinstance(expr, ast.Subscript):
+            return self.expr_tainted(expr.value)
+        if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.expr_tainted(e) for e in expr.elts)
+        if isinstance(expr, ast.IfExp):
+            return any(
+                self.expr_tainted(e)
+                for e in (expr.test, expr.body, expr.orelse)
+            )
+        if isinstance(expr, ast.Starred):
+            return self.expr_tainted(expr.value)
+        return False
+
+
+def _jitted_defs(mod: ModuleInfo):
+    """(def_node, tainted_param_names) for each jit-compiled def whose
+    body we can see."""
+    out = []
+    seen: set[ast.AST] = set()
+    for site in collect_jit_sites(mod):
+        fn = site.func_def
+        if fn is None or not isinstance(
+            fn, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ):
+            continue
+        if fn in seen:
+            continue
+        seen.add(fn)
+        params = list(site.params())
+        static = set(site.static_positions())
+        static_names = set(site.static_argnames)
+        tainted = {
+            p
+            for i, p in enumerate(params)
+            if i not in static and p not in static_names
+        }
+        out.append((fn, tainted))
+    return out
+
+
+@register_check(
+    CHECK,
+    "Python if/while/assert/bool() on values traced by jax.jit "
+    "(concretization error or silently baked branch)",
+)
+def check_tracer_leak(mod: ModuleInfo) -> list[Finding]:
+    findings: list[Finding] = []
+    for fn, tainted in _jitted_defs(mod):
+        t = _Tainter(mod, fn, tainted)
+        context = mod.enclosing_function(fn)
+
+        def flag(node: ast.AST, what: str) -> None:
+            findings.append(
+                Finding(
+                    CHECK, mod.relpath, node.lineno, node.col_offset,
+                    f"{what} on a value traced by jit-compiled "
+                    f"`{fn.name}` — use jax.lax.cond/jnp.where, or mark "
+                    "the driving argument static_argnums",
+                    context,
+                )
+            )
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.If) and t.expr_tainted(node.test):
+                flag(node, "Python `if`")
+            elif isinstance(node, ast.While) and t.expr_tainted(node.test):
+                flag(node, "Python `while`")
+            elif isinstance(node, ast.Assert) and t.expr_tainted(node.test):
+                flag(node, "`assert`")
+            elif (
+                isinstance(node, ast.Call)
+                and mod.dotted(node.func) == "bool"
+                and node.args
+                and t.expr_tainted(node.args[0])
+            ):
+                flag(node, "`bool()`")
+            elif isinstance(node, ast.IfExp) and t.expr_tainted(node.test):
+                flag(node, "conditional expression")
+    return findings

@@ -1,0 +1,267 @@
+"""warmup-registry: every `jax.jit` entry point in `algos/`/`models/`
+must have an AOT warmup planner (compile_cache.register_warmup) or an
+exemption with a reason (compile_cache.EXEMPT) — ISSUE 4's lint, folded
+into the jaxlint framework as a registered pass (ISSUE 5).
+`scripts/check_warmup_registry.py` is now a thin shim over this module.
+
+This is the ONE pass that imports project code: it validates the scan
+against the live registry, which only exists after the algo modules'
+import-time `register_warmup` calls run. The import is lazy (inside the
+check), so every other pass — and any `--skip warmup-registry` run —
+stays import-free. The AST side (`jit_sites`) keys each site by
+"<module>.<enclosing top-level function>", exactly as the original
+script did, so registry keys and EXEMPT entries carry over unchanged.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import Iterable, Optional
+
+from actor_critic_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    register_check,
+)
+
+CHECK = "warmup-registry"
+
+SCAN_DIRS = ("actor_critic_tpu/algos", "actor_critic_tpu/models")
+_EXEMPT_HOME = "actor_critic_tpu/utils/compile_cache.py"
+
+
+def _sites_in_tree(tree: ast.AST) -> list[tuple[str, int]]:
+    """(enclosing top-level function name, lineno) for each `jax.jit`
+    reference ("<module>" at module scope) — the original
+    check_warmup_registry.py traversal, kept byte-compatible in
+    semantics: direct calls, decorators, and partial(jax.jit, ...) all
+    contain the same `jax.jit` Attribute node."""
+    sites: list[tuple[str, int]] = []
+
+    def is_jax_jit(node: ast.AST) -> bool:
+        return (
+            isinstance(node, ast.Attribute)
+            and node.attr == "jit"
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "jax"
+        )
+
+    def scan(node: ast.AST, enclosing: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            name = enclosing
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ) and enclosing == "<module>":
+                name = child.name
+            if is_jax_jit(child):
+                sites.append((enclosing, child.lineno))
+            scan(child, name)
+
+    scan(tree, "<module>")
+    return sites
+
+
+def jit_sites(path: str) -> list[tuple[str, int]]:
+    """(enclosing top-level function name, lineno) per `jax.jit`
+    reference in the file — the API the shim re-exports and
+    tests/test_warmup_registry.py exercises."""
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    return _sites_in_tree(tree)
+
+
+def load_registry() -> tuple[set[str], dict[str, str]]:
+    """(registered keys, EXEMPT) from the live package — importing
+    actor_critic_tpu.config pulls in every algo module, whose
+    register_warmup calls run as import side effects."""
+    import actor_critic_tpu.config  # noqa: F401 — registration side effect
+    from actor_critic_tpu.utils import compile_cache
+
+    return set(compile_cache.registered_warmups()), dict(compile_cache.EXEMPT)
+
+
+def site_findings(
+    sites: dict[str, list[tuple[str, int]]],
+    registered: Iterable[str],
+    exempt: dict[str, str],
+    check_stale: bool = True,
+) -> list[Finding]:
+    """Pure comparison: sites keyed "<module>.<function>" mapped to
+    [(relpath, lineno), ...] against the registry. Testable without any
+    project import (the fixture tests inject their own registry).
+    `check_stale=False` skips the stale-exemption direction — only
+    sound when `sites` covers the FULL scan dirs (a partial scan
+    legitimately misses the sites its exemptions name)."""
+    registered = set(registered)
+    findings: list[Finding] = []
+    for key, locations in sorted(sites.items()):
+        if key in registered or key in exempt:
+            continue
+        relpath, lineno = locations[0]
+        findings.append(
+            Finding(
+                CHECK, relpath, lineno, 0,
+                f"unregistered jax.jit entry point {key!r} — register an "
+                "AOT warmup planner in its module "
+                "(compile_cache.register_warmup) or add it to "
+                "compile_cache.EXEMPT with a reason",
+                key.split(".", 1)[-1],
+            )
+        )
+    if not check_stale:
+        return findings
+    # Stale exemptions rot fastest (a refactor renames the function and
+    # the exemption silently stops covering anything).
+    for key in sorted(exempt):
+        if key not in sites:
+            findings.append(
+                Finding(
+                    CHECK, _EXEMPT_HOME, 1, 0,
+                    f"stale exemption {key!r} in compile_cache.EXEMPT — "
+                    "no such jax.jit site exists anymore",
+                    "<module>",
+                    line_text=f"EXEMPT[{key!r}]",
+                )
+            )
+    return findings
+
+
+def sites_from_modules(
+    modules: Iterable[ModuleInfo],
+    scan_dirs: tuple[str, ...] = SCAN_DIRS,
+) -> dict[str, list[tuple[str, int]]]:
+    out: dict[str, list[tuple[str, int]]] = {}
+    prefixes = tuple(d.rstrip("/") + "/" for d in scan_dirs)
+    for mod in modules:
+        if not mod.relpath.startswith(prefixes):
+            continue
+        base = mod.relpath.rsplit("/", 1)[-1]
+        if base == "__init__.py":
+            continue
+        modname = base[:-3]
+        for func, lineno in _sites_in_tree(mod.tree):
+            out.setdefault(f"{modname}.{func}", []).append(
+                (mod.relpath, lineno)
+            )
+    return out
+
+
+@register_check(
+    CHECK,
+    "jax.jit entry points in algos//models/ lacking an AOT warmup "
+    "registration or EXEMPT reason (first-dispatch compile returns)",
+    scope="repo",
+)
+def check_warmup_registry(modules: list[ModuleInfo]) -> list[Finding]:
+    sites = sites_from_modules(modules)
+    if not sites:
+        # The scan didn't cover algos//models/ (fixture runs, partial
+        # paths): nothing to validate, and importing the registry would
+        # be pure overhead.
+        return []
+    registered, exempt = load_registry()
+    # An unregistered site is unregistered regardless of scan scope;
+    # stale-exemption validation is only sound when the scan covered
+    # EVERY file of the scan dirs (a single-file scan would otherwise
+    # report every other module's exemptions as stale).
+    return site_findings(
+        sites, registered, exempt, check_stale=_full_scan(modules)
+    )
+
+
+def _full_scan(modules: list[ModuleInfo]) -> bool:
+    """Whether `modules` covers every .py file of SCAN_DIRS on disk."""
+    scanned = {m.relpath for m in modules}
+    root = None
+    for m in modules:
+        if m.path.replace(os.sep, "/").endswith(m.relpath):
+            root = m.path[: len(m.path) - len(m.relpath)] or "."
+            break
+    if root is None:
+        return False
+    for rel in SCAN_DIRS:
+        d = os.path.join(root, rel)
+        if not os.path.isdir(d):
+            continue
+        for fname in os.listdir(d):
+            if not fname.endswith(".py") or fname == "__init__.py":
+                continue
+            if f"{rel}/{fname}" not in scanned:
+                return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# Original-CLI behavior, re-exported by the scripts/ shim
+# ---------------------------------------------------------------------------
+
+def collect_sites(
+    repo_root: Optional[str] = None,
+) -> dict[str, list[str]]:
+    """registry key -> ['path:line', ...] over the scanned packages
+    (the original script's API, path-string locations included)."""
+    root = repo_root or _repo_root()
+    out: dict[str, list[str]] = {}
+    for rel in SCAN_DIRS:
+        d = os.path.join(root, rel)
+        for fname in sorted(os.listdir(d)):
+            if not fname.endswith(".py") or fname == "__init__.py":
+                continue
+            path = os.path.join(d, fname)
+            for func, lineno in jit_sites(path):
+                out.setdefault(f"{fname[:-3]}.{func}", []).append(
+                    f"{os.path.relpath(path, root)}:{lineno}"
+                )
+    return out
+
+
+def _repo_root() -> str:
+    # analysis/ -> actor_critic_tpu/ -> repo
+    return os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
+def main(argv=None) -> int:
+    """The standalone lint: exit 0 when clean, 1 with a per-site report
+    on stderr otherwise (scripts/check_warmup_registry.py's contract,
+    unchanged — including the multi-location "at path:line, path:line"
+    report lines, which is why this mirrors site_findings() rather than
+    formatting its Findings; change the coverage rule in BOTH)."""
+    registered, exempt = load_registry()
+    sites = collect_sites()
+
+    problems: list[str] = []
+    for key, locations in sorted(sites.items()):
+        if key in registered or key in exempt:
+            continue
+        problems.append(
+            f"UNREGISTERED jax.jit entry point {key!r} at "
+            f"{', '.join(locations)} — register an AOT warmup planner "
+            "in its module (compile_cache.register_warmup) or add it to "
+            "compile_cache.EXEMPT with a reason"
+        )
+    for key in sorted(exempt):
+        if key not in sites:
+            problems.append(
+                f"STALE exemption {key!r} in compile_cache.EXEMPT — "
+                "no such jax.jit site exists anymore"
+            )
+
+    if problems:
+        print("\n".join(problems), file=sys.stderr)
+        print(
+            f"\ncheck_warmup_registry: {len(problems)} problem(s); "
+            f"{len(sites)} jit site(s), {len(registered)} registered, "
+            f"{len(exempt)} exempt.",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"check_warmup_registry: OK — {len(sites)} jax.jit site(s) in "
+        f"algos//models/ all covered ({len(registered)} registered "
+        f"warmups, {len(exempt)} exemptions)."
+    )
+    return 0

@@ -1,135 +1,22 @@
 #!/usr/bin/env python
-"""Lint: every `jax.jit` entry point in `actor_critic_tpu/algos/` and
-`actor_critic_tpu/models/` must be registered for AOT warmup
-(utils/compile_cache.py) or exempted there with a reason (ISSUE 4).
+"""Thin shim (ISSUE 5): the warmup-registry lint now lives in
+`actor_critic_tpu/analysis/warmup.py` as jaxlint's `warmup-registry`
+pass (run `python scripts/jaxlint.py` for the full analyzer). This
+entry point keeps the original CLI and API — `main` and `jit_sites` —
+so existing callers and tests/test_warmup_registry.py work unchanged."""
 
-The compile-once contract only holds if the warmup registry keeps up
-with the code: a new jitted entry point that nobody registers silently
-reintroduces first-dispatch compile into time-to-first-step. This lint
-makes that a tier-1 failure (tests/test_warmup_registry.py) instead of
-a perf regression someone notices weeks later.
-
-Mechanics: AST-scan the two packages for `jax.jit` references (direct
-calls, decorators, and `partial(jax.jit, ...)` all contain the same
-`jax.jit` attribute node), key each site by
-"<module>.<enclosing top-level function>", and require every key to be
-in `compile_cache.registered_warmups()` or `compile_cache.EXEMPT`.
-Stale EXEMPT keys (naming no existing jit site) are errors too, so
-refactors can't leave dead exemptions shadowing future sites. The
-registry is deliberately allowed to hold MORE keys than there are jit
-sites: several factories (make_train_step / make_eval_fn /
-make_greedy_act) contain no `jax.jit` themselves — their CALLERS jit
-them (train.py's run_fused, the host loops) — yet still need warmup
-planners; a registration whose factory was deleted outright fails
-loudly at plan time instead (`plan_warmup` prints the planner error
-and emits a `warmup_plan_error` telemetry event).
-
-Exit 0 when clean; 1 with a per-site report otherwise.
-"""
-
-from __future__ import annotations
-
-import ast
 import os
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-SCAN_DIRS = ("actor_critic_tpu/algos", "actor_critic_tpu/models")
-
-
-def jit_sites(path: str) -> list[tuple[str, int]]:
-    """(enclosing top-level function name, lineno) for each `jax.jit`
-    reference in the file ("<module>" when at module scope)."""
-    with open(path) as f:
-        tree = ast.parse(f.read(), filename=path)
-    sites: list[tuple[str, int]] = []
-
-    def is_jax_jit(node: ast.AST) -> bool:
-        return (
-            isinstance(node, ast.Attribute)
-            and node.attr == "jit"
-            and isinstance(node.value, ast.Name)
-            and node.value.id == "jax"
-        )
-
-    def scan(node: ast.AST, enclosing: str) -> None:
-        for child in ast.iter_child_nodes(node):
-            name = enclosing
-            if isinstance(
-                child, (ast.FunctionDef, ast.AsyncFunctionDef)
-            ) and enclosing == "<module>":
-                name = child.name
-            if is_jax_jit(child):
-                sites.append((enclosing, child.lineno))
-            scan(child, name)
-
-    scan(tree, "<module>")
-    return sites
-
-
-def collect_sites() -> dict[str, list[str]]:
-    """registry key -> ['path:line', ...] over the scanned packages."""
-    out: dict[str, list[str]] = {}
-    for rel in SCAN_DIRS:
-        root = os.path.join(REPO, rel)
-        for fname in sorted(os.listdir(root)):
-            if not fname.endswith(".py") or fname == "__init__.py":
-                continue
-            mod = fname[:-3]
-            path = os.path.join(root, fname)
-            for func, lineno in jit_sites(path):
-                key = f"{mod}.{func}"
-                out.setdefault(key, []).append(
-                    f"{os.path.relpath(path, REPO)}:{lineno}"
-                )
-    return out
-
-
-def main(argv=None) -> int:
+if REPO not in sys.path:
     sys.path.insert(0, REPO)
-    import actor_critic_tpu.config  # noqa: F401 — imports every algo module,
-    # which registers its warmup planners as an import side effect
-    from actor_critic_tpu.utils import compile_cache
 
-    registered = set(compile_cache.registered_warmups())
-    exempt = dict(compile_cache.EXEMPT)
-    sites = collect_sites()
-
-    problems: list[str] = []
-    for key, locations in sorted(sites.items()):
-        if key in registered or key in exempt:
-            continue
-        problems.append(
-            f"UNREGISTERED jax.jit entry point {key!r} at "
-            f"{', '.join(locations)} — register an AOT warmup planner "
-            "in its module (compile_cache.register_warmup) or add it to "
-            "compile_cache.EXEMPT with a reason"
-        )
-    # Stale exemptions rot fastest (a refactor renames the function and
-    # the exemption silently stops covering anything).
-    for key in sorted(exempt):
-        if key not in sites:
-            problems.append(
-                f"STALE exemption {key!r} in compile_cache.EXEMPT — "
-                "no such jax.jit site exists anymore"
-            )
-
-    if problems:
-        print("\n".join(problems), file=sys.stderr)
-        print(
-            f"\ncheck_warmup_registry: {len(problems)} problem(s); "
-            f"{len(sites)} jit site(s), {len(registered)} registered, "
-            f"{len(exempt)} exempt.",
-            file=sys.stderr,
-        )
-        return 1
-    print(
-        f"check_warmup_registry: OK — {len(sites)} jax.jit site(s) in "
-        f"algos//models/ all covered ({len(registered)} registered "
-        f"warmups, {len(exempt)} exemptions)."
-    )
-    return 0
-
+from actor_critic_tpu.analysis.warmup import (  # noqa: E402,F401
+    collect_sites,
+    jit_sites,
+    main,
+)
 
 if __name__ == "__main__":
     sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,215 @@
+#!/usr/bin/env python
+"""jaxlint: repo-wide JAX correctness analyzer (ISSUE 5).
+
+    python scripts/jaxlint.py                         # default scan set
+    python scripts/jaxlint.py actor_critic_tpu train.py bench
+    python scripts/jaxlint.py --list-checks
+    python scripts/jaxlint.py --json                  # machine output
+    python scripts/jaxlint.py --write-baseline        # regenerate
+    python scripts/jaxlint.py --show-baselined        # audit accepted
+
+Exit codes (tier-1 tells them apart — scripts/tier1.sh):
+    0  clean: zero un-baselined findings
+    1  findings: at least one finding not covered by the baseline
+    2  crash: parse error, unreadable path, malformed baseline, bad
+       check name
+
+`--error-on-new` names the default gate explicitly for CI readability;
+it is always on. Suppress a single line in source with
+`# jaxlint: disable=<check>[,<check>]` (put the why in the same
+comment); accept a finding repo-wide by adding it to
+`jaxlint_baseline.json` with a reason (`--write-baseline` drafts
+entries, reasons must be filled in by hand).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+DEFAULT_PATHS = ("actor_critic_tpu", "train.py", "bench")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[1].strip())
+    p.add_argument(
+        "paths", nargs="*", default=list(DEFAULT_PATHS),
+        help=f"files/dirs to scan (default: {' '.join(DEFAULT_PATHS)})",
+    )
+    p.add_argument(
+        "--list-checks", action="store_true",
+        help="print the registered checks with one-line docs and exit 0",
+    )
+    p.add_argument(
+        "--json", action="store_true",
+        help="machine-readable output (consumed by scripts/run_report.py)",
+    )
+    p.add_argument(
+        "--baseline", default=None,
+        help="baseline file (default: <repo>/jaxlint_baseline.json)",
+    )
+    p.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore the baseline: report every finding as new",
+    )
+    p.add_argument(
+        "--write-baseline", action="store_true",
+        help="rewrite the baseline from the current findings (existing "
+        "reasons are preserved; new entries get a NEEDS-REASON "
+        "placeholder) and exit 0",
+    )
+    p.add_argument(
+        "--show-baselined", action="store_true",
+        help="also print baselined findings with their reasons",
+    )
+    p.add_argument(
+        "--checks", default=None,
+        help="comma-separated subset of checks to run",
+    )
+    p.add_argument(
+        "--skip", default=None,
+        help="comma-separated checks to skip (e.g. warmup-registry to "
+        "stay fully import-free)",
+    )
+    p.add_argument(
+        "--error-on-new", action="store_true",
+        help="fail (exit 1) when un-baselined findings exist — the "
+        "default, named explicitly for CI invocations",
+    )
+    args = p.parse_args(argv)
+
+    from actor_critic_tpu import analysis
+
+    if args.list_checks:
+        checks = analysis.registered_checks()
+        width = max(len(c.name) for c in checks)
+        for c in checks:
+            print(f"{c.name:<{width}}  {c.doc}")
+        return 0
+
+    if args.write_baseline and args.no_baseline:
+        # --no-baseline empties the loaded entries, so combining it with
+        # --write-baseline would rewrite the file from nothing — every
+        # audited reason silently destroyed. Refuse loudly instead.
+        print(
+            "jaxlint: error: --write-baseline cannot be combined with "
+            "--no-baseline (it would discard every existing audited "
+            "entry)",
+            file=sys.stderr,
+        )
+        return 2
+
+    checks = args.checks.split(",") if args.checks else None
+    skip = args.skip.split(",") if args.skip else ()
+    baseline_path = args.baseline or analysis.default_baseline_path(REPO)
+
+    try:
+        modules = analysis.load_modules(args.paths, REPO)
+        findings = analysis.run_checks(modules, checks=checks, skip=skip)
+        entries = (
+            [] if args.no_baseline else analysis.load_baseline(baseline_path)
+        )
+    except analysis.AnalysisError as e:
+        print(f"jaxlint: error: {e}", file=sys.stderr)
+        return 2
+
+    scanned = {m.relpath for m in modules}
+    selected = set(checks) if checks else {
+        c.name for c in analysis.registered_checks()
+    }
+    selected -= set(skip)
+
+    if args.write_baseline:
+        # A scoped run (path subset, --checks/--skip) regenerates only
+        # what it could SEE; entries outside the scanned files or the
+        # selected checks are retained verbatim, so a partial rewrite
+        # can never silently delete another file's audited reasons.
+        retained = [
+            e
+            for e in entries
+            if e.get("path") not in scanned or e.get("check") not in selected
+        ]
+        entries_out = analysis.regenerate(findings, entries)
+        have = {
+            analysis.baseline.entry_fingerprint(e) for e in entries_out
+        }
+        entries_out += [
+            e
+            for e in retained
+            if analysis.baseline.entry_fingerprint(e) not in have
+        ]
+        analysis.save_baseline(baseline_path, entries_out)
+        placeholders = sum(
+            1 for e in entries_out if str(e["reason"]).startswith("NEEDS-")
+        )
+        print(
+            f"jaxlint: wrote {len(entries_out)} baseline entr"
+            f"{'y' if len(entries_out) == 1 else 'ies'} to {baseline_path}"
+            + (
+                f" — fill in {placeholders} NEEDS-REASON placeholder(s)"
+                if placeholders
+                else ""
+            )
+        )
+        return 0
+
+    new, matched, stale = analysis.apply_baseline(findings, entries)
+    # Stale = "matches no finding" is only meaningful for files this
+    # run actually scanned AND checks it actually ran; a path- or
+    # check-subset run must not call the rest of the baseline stale.
+    stale = [
+        e
+        for e in stale
+        if e.get("path") in scanned and e.get("check") in selected
+    ]
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "new": [f.to_dict() for f in new],
+                    "baselined": [
+                        {**f.to_dict(), "reason": e.get("reason")}
+                        for f, e in matched
+                    ],
+                    "stale_baseline_entries": stale,
+                    "counts": {
+                        "new": len(new),
+                        "baselined": len(matched),
+                        "stale": len(stale),
+                    },
+                },
+                indent=2,
+            )
+        )
+        return 1 if new else 0
+
+    for f in new:
+        print(f.render())
+    if args.show_baselined:
+        for f, e in matched:
+            print(f"{f.render()}  [baselined: {e.get('reason')}]")
+    for e in stale:
+        print(
+            "jaxlint: warning: stale baseline entry "
+            f"{analysis.baseline.entry_fingerprint(e)!r} matches no "
+            "finding — remove it (or rerun --write-baseline)",
+            file=sys.stderr,
+        )
+    summary = (
+        f"jaxlint: {len(new)} new finding(s), {len(matched)} baselined, "
+        f"{len(stale)} stale baseline entr"
+        f"{'y' if len(stale) == 1 else 'ies'}"
+    )
+    print(summary, file=sys.stderr if new else sys.stdout)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

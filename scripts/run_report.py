@@ -445,6 +445,55 @@ def event_summary(rows: list[dict]) -> list[str]:
     return out
 
 
+def static_findings() -> list[str]:
+    """Markdown lines for the "Static findings" section: the jaxlint
+    analyzer's `--json` output over the working tree (ISSUE 5). A run
+    report is usually read while diagnosing a misbehaving run — if the
+    tree ALSO carries un-baselined static hazards (a donated restored
+    buffer, a recompile-hazard call site), that belongs next to the
+    telemetry. Empty when the tree is clean (the section is omitted) or
+    when the analyzer cannot run (reports must render anywhere).
+
+    `warmup-registry` is skipped here: it imports the live registry
+    (seconds of jax import) and has its own tier-1 gate; the AST passes
+    are import-free and fast."""
+    import subprocess
+
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "jaxlint.py")
+    try:
+        proc = subprocess.run(
+            [sys.executable, script, "--json", "--skip", "warmup-registry"],
+            capture_output=True, text=True, timeout=120,
+        )
+        payload = json.loads(proc.stdout)
+    except Exception:
+        return []  # analyzer unavailable/broken: telemetry still renders
+    new = payload.get("new") or []
+    stale = payload.get("stale_baseline_entries") or []
+    if not new and not stale:
+        return []
+    out = [
+        f"{len(new)} un-baselined jaxlint finding(s) in the working tree "
+        "(`python scripts/jaxlint.py` for the full report):",
+        "",
+    ]
+    out += [
+        f"- `{f.get('path')}:{f.get('line')}` **[{f.get('check')}]** "
+        f"{f.get('message')}"
+        for f in new[:20]
+    ]
+    if len(new) > 20:
+        out.append(f"- … {len(new) - 20} more")
+    if stale:
+        out.append(
+            f"- plus {len(stale)} stale baseline entr"
+            f"{'y' if len(stale) == 1 else 'ies'} (flagged lines changed "
+            "— rerun `scripts/jaxlint.py --write-baseline` after review)"
+        )
+    return out
+
+
 def metrics_summary(rows: list[dict]) -> list[str]:
     if not rows:
         return ["*(no metrics rows)*"]
@@ -515,6 +564,11 @@ def render(
         + profile_captures(events, telemetry_dir)
         + [""]
     )
+    statics = static_findings()
+    if statics:
+        # Only when the tree actually carries findings: a clean tree
+        # must not grow a no-op section in every report.
+        lines += ["## Static findings", ""] + statics + [""]
     if metrics_path is None:
         cand = os.path.join(telemetry_dir, "metrics.jsonl")
         metrics_path = cand if os.path.exists(cand) else None

@@ -4,4 +4,8 @@
 # this one script so the command can never drift between callers —
 # update ROADMAP.md and this file together).
 cd "$(dirname "$0")/.." || exit 1
+# Static analysis first (ISSUE 5): an un-baselined jaxlint finding fails
+# tier-1 before any test runs (exit 1 = findings, 2 = analyzer crash —
+# distinct so CI logs tell them apart).
+env JAX_PLATFORMS=cpu python scripts/jaxlint.py actor_critic_tpu train.py bench --error-on-new || exit $?
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

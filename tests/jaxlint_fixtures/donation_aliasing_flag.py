@@ -1,0 +1,18 @@
+"""jaxlint fixture (MUST FLAG donation-aliasing): donating a
+checkpoint-restored buffer, and reading a donated name after the call.
+Parsed only — never imported."""
+
+import jax
+
+
+def resume_and_step(ckpt, template):
+    step = jax.jit(lambda s: s, donate_argnums=0)
+    state = ckpt.restore(template)
+    metrics = step(state)  # restore-aliased buffer donated
+    return metrics
+
+
+def double_use(step_fn, state):
+    step = jax.jit(step_fn, donate_argnums=0)
+    metrics = step(state)  # donates `state` ...
+    return metrics, state  # ... then reads it again

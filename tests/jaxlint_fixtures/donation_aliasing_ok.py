@@ -1,0 +1,19 @@
+"""jaxlint fixture (near miss, must NOT flag): the same donation shape,
+but the restored state is re-placed before donation and the donated
+name is rebound by the call. Parsed only — never imported."""
+
+import jax
+
+
+def resume_and_step(ckpt, template, uncommit):
+    step = jax.jit(lambda s: s, donate_argnums=0)
+    state = uncommit(ckpt.restore(template))  # re-placed: jax-owned
+    state = step(state)  # rebound by the donating call
+    return state
+
+
+def loop_step(step_fn, state, n):
+    step = jax.jit(step_fn, donate_argnums=0)
+    for _ in range(n):
+        state, metrics = step(state)  # rebound every iteration
+    return state, metrics

@@ -1,0 +1,18 @@
+# jaxlint: hot-module
+"""jaxlint fixture (MUST FLAG host-sync): device syncs inside a step
+loop of a hot module (opted in via the pragma above). Parsed only —
+never imported."""
+
+import numpy as np
+
+import jax
+
+
+def collect(pool, act, obs, steps, jit_update, state):
+    for _ in range(steps):
+        action = np.asarray(act(obs))  # device→host copy per step
+        out = pool.step(action)
+        state, metrics = jit_update(state, out)
+        loss = float(metrics["loss"])  # sync per step
+        jax.block_until_ready(state)  # hard fence per step
+    return state, loss

@@ -1,0 +1,18 @@
+# jaxlint: hot-module
+"""jaxlint fixture (near miss, must NOT flag): same hot module shape,
+but values stay on device inside the loop and the coercions happen once
+after it. Parsed only — never imported."""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+
+def collect(pool, act, obs, steps, jit_update, state):
+    for _ in range(steps):
+        action = act(obs)  # mirror/device path: no materialization
+        out = pool.step(action)
+        state, metrics = jit_update(state, out)
+    history = {k: float(v) for k, v in metrics.items()}  # once, post-loop
+    block = jnp.asarray(np.zeros((steps, 4)))  # host→device, not a sync
+    return state, history, block

@@ -1,0 +1,19 @@
+"""jaxlint fixture (MUST FLAG prng-reuse): one key binding consumed by
+two jax.random calls, and a key consumed inside a loop that never
+splits. Parsed only — never imported."""
+
+import jax
+
+
+def sample_pair(seed):
+    key = jax.random.key(seed)
+    a = jax.random.normal(key, (4,))
+    b = jax.random.uniform(key, (4,))  # same binding consumed again
+    return a + b
+
+
+def noisy_rollout(key, steps):
+    out = []
+    for _ in range(steps):
+        out.append(jax.random.normal(key, ()))  # same key every iteration
+    return out

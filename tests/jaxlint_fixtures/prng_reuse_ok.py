@@ -1,0 +1,21 @@
+"""jaxlint fixture (near miss, must NOT flag): the split-and-rebind
+idiom — every binding is consumed exactly once. Parsed only — never
+imported."""
+
+import jax
+
+
+def sample_pair(seed):
+    key = jax.random.key(seed)
+    k1, k2 = jax.random.split(key)
+    a = jax.random.normal(k1, (4,))
+    b = jax.random.uniform(k2, (4,))
+    return a + b
+
+
+def noisy_rollout(key, steps):
+    out = []
+    for _ in range(steps):
+        key, sub = jax.random.split(key)  # fresh subkey per iteration
+        out.append(jax.random.normal(sub, ()))
+    return out

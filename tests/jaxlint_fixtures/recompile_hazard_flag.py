@@ -1,0 +1,22 @@
+"""jaxlint fixture (MUST FLAG recompile-hazard): jit constructed inside
+a loop, and a len()-derived Python scalar fed to a jitted call. Parsed
+only — never imported."""
+
+import jax
+import jax.numpy as jnp
+
+
+def per_item(xs):
+    out = []
+    for x in xs:
+        f = jax.jit(lambda a: a + 1)  # fresh callable every iteration
+        out.append(f(x))
+    return out
+
+
+tail_update = jax.jit(lambda a, n: a * 1.0)
+
+
+def dispatch_tail(batch):
+    n = len(batch)
+    return tail_update(jnp.asarray(batch), n)  # len-derived scalar arg

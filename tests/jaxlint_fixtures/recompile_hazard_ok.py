@@ -1,0 +1,20 @@
+"""jaxlint fixture (near miss, must NOT flag): the jit is hoisted out
+of the loop and the data-dependent scalar is pinned dynamic with
+jnp.asarray. Parsed only — never imported."""
+
+import jax
+import jax.numpy as jnp
+
+step = jax.jit(lambda a: a + 1)
+
+
+def per_item(xs):
+    return [step(x) for x in xs]  # one callable, dispatch cache reused
+
+
+tail_update = jax.jit(lambda a, n: a * 1.0)
+
+
+def dispatch_tail(batch):
+    n = len(batch)
+    return tail_update(jnp.asarray(batch), jnp.asarray(n, jnp.int32))

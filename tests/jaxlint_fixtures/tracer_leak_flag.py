@@ -1,0 +1,21 @@
+"""jaxlint fixture (MUST FLAG tracer-leak): Python control flow on a
+traced value inside jit. Parsed only — never imported."""
+
+import jax
+
+
+@jax.jit
+def relu_branch(x):
+    if x > 0:  # traced value in a Python `if`
+        return x
+    return -x
+
+
+def make_step(cfg):
+    def step(state):
+        total = state.sum()
+        while total > 0:  # traced value drives a Python `while`
+            total = total - 1.0
+        return total
+
+    return jax.jit(step)

@@ -1,0 +1,23 @@
+"""jaxlint fixture (near miss, must NOT flag): branches on static
+metadata (.shape), trace-time presence checks (`is None`), and static
+arguments — the sanctioned idioms. Parsed only — never imported."""
+
+import jax
+
+
+@jax.jit
+def head(x, n=None):
+    if n is None:  # trace-time presence check on an optional arg
+        n = x.shape[0]
+    if x.shape[0] > 1:  # static shape metadata
+        return x[:1]
+    return x
+
+
+def make_step(cfg):
+    def step(state, flat):
+        if flat.shape[0] % 4 != 0:  # shape-specialization guard
+            raise ValueError("bad batch")
+        return state
+
+    return jax.jit(step, static_argnums=())

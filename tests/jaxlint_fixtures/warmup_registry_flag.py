@@ -1,0 +1,13 @@
+"""jaxlint fixture (MUST FLAG warmup-registry when its key is not
+registered): a jax.jit entry point with no AOT warmup registration.
+The test injects the registry; parsed only — never imported."""
+
+import jax
+
+
+def make_step(cfg):
+    @jax.jit
+    def step(state):
+        return state
+
+    return step

@@ -1,0 +1,13 @@
+"""jaxlint fixture (near miss, must NOT flag): the same jit entry-point
+shape, but its key IS in the registry the test injects. Parsed only —
+never imported."""
+
+import jax
+
+
+def make_step(cfg):
+    @jax.jit
+    def step(state):
+        return state
+
+    return step

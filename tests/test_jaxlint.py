@@ -1,0 +1,481 @@
+"""Tier-1 wiring for the jaxlint analyzer (ISSUE 5).
+
+Three layers of guarantees:
+
+1. **Fixture pairs** — per registered check, a `*_flag.py` fixture that
+   MUST produce findings of exactly that check and a `*_ok.py` near
+   miss that MUST stay completely clean, so a pass going blind (or
+   over-flagging the sanctioned idiom) fails CI.
+2. **Mechanics** — inline suppression comments (same line and
+   standalone line), baseline round-trip (save → load → zero new,
+   stale detection when the flagged line changes).
+3. **The gate** — the real tree (`actor_critic_tpu train.py bench`)
+   analyzes clean against the repo baseline, and the CLI's exit codes
+   stay distinct: 0 clean / 1 findings / 2 crash-or-parse-error.
+
+Everything runs AST-only (the analyzer never imports the files it
+scans), so this module is JAX_PLATFORMS=cpu-safe and fast; only the
+final gate test touches the live warmup registry (already imported by
+the rest of tier-1).
+"""
+
+import importlib.util
+import json
+from pathlib import Path
+
+import pytest
+
+from actor_critic_tpu import analysis
+from actor_critic_tpu.analysis import warmup
+
+REPO = Path(__file__).parent.parent
+FIXTURES = Path(__file__).parent / "jaxlint_fixtures"
+
+# Every AST check rides the same fixture contract; warmup-registry is
+# repo-scoped and has its own pair test below.
+PAIRS = [
+    ("donation-aliasing", "donation_aliasing"),
+    ("tracer-leak", "tracer_leak"),
+    ("prng-reuse", "prng_reuse"),
+    ("recompile-hazard", "recompile_hazard"),
+    ("host-sync", "host_sync"),
+]
+
+
+def _analyze(*names: str, checks=None):
+    return analysis.analyze_paths(
+        [str(FIXTURES / n) for n in names],
+        str(REPO),
+        checks=checks,
+        skip=("warmup-registry",),
+    )
+
+
+def _load_cli():
+    spec = importlib.util.spec_from_file_location(
+        "jaxlint_cli", REPO / "scripts" / "jaxlint.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# fixture pairs
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("check,stem", PAIRS)
+def test_flag_fixture_flags(check, stem):
+    findings = _analyze(f"{stem}_flag.py")
+    assert findings, f"{stem}_flag.py produced no findings"
+    assert all(f.check == check for f in findings), (
+        f"{stem}_flag.py leaked findings of other checks: "
+        f"{[f.render() for f in findings if f.check != check]}"
+    )
+
+
+@pytest.mark.parametrize("check,stem", PAIRS)
+def test_ok_fixture_stays_clean(check, stem):
+    findings = _analyze(f"{stem}_ok.py")
+    assert findings == [], (
+        f"{stem}_ok.py must be clean, got: "
+        f"{[f.render() for f in findings]}"
+    )
+
+
+def test_warmup_registry_fixture_pair():
+    mods = analysis.load_modules(
+        [
+            str(FIXTURES / "warmup_registry_flag.py"),
+            str(FIXTURES / "warmup_registry_ok.py"),
+        ],
+        str(REPO),
+    )
+    sites = warmup.sites_from_modules(
+        mods, scan_dirs=("tests/jaxlint_fixtures",)
+    )
+    assert set(sites) == {
+        "warmup_registry_flag.make_step",
+        "warmup_registry_ok.make_step",
+    }
+    findings = warmup.site_findings(
+        sites, registered={"warmup_registry_ok.make_step"}, exempt={}
+    )
+    assert [f.check for f in findings] == ["warmup-registry"]
+    assert "warmup_registry_flag.make_step" in findings[0].message
+    # near miss: fully covered registry -> clean
+    assert (
+        warmup.site_findings(
+            sites,
+            registered={
+                "warmup_registry_flag.make_step",
+                "warmup_registry_ok.make_step",
+            },
+            exempt={},
+        )
+        == []
+    )
+    # stale exemptions are findings too (refactors can't leave dead keys)
+    stale = warmup.site_findings(
+        sites,
+        registered={
+            "warmup_registry_flag.make_step",
+            "warmup_registry_ok.make_step",
+        },
+        exempt={"gone.make_step": "reason"},
+    )
+    assert len(stale) == 1 and "stale exemption" in stale[0].message
+
+
+# ---------------------------------------------------------------------------
+# suppression comments
+# ---------------------------------------------------------------------------
+
+_SNIPPET = (
+    "import jax\n"
+    "def f(seed):\n"
+    "    key = jax.random.key(seed)\n"
+    "    a = jax.random.normal(key, (2,))\n"
+    "    b = jax.random.uniform(key, (2,)){pragma}\n"
+    "    return a + b\n"
+)
+
+
+def _run_snippet(tmp_path, src):
+    p = tmp_path / "snippet.py"
+    p.write_text(src)
+    return analysis.analyze_paths(
+        [str(p)], str(REPO), skip=("warmup-registry",)
+    )
+
+
+def test_suppression_same_line(tmp_path):
+    assert _run_snippet(tmp_path, _SNIPPET.format(pragma=""))
+    suppressed = _run_snippet(
+        tmp_path,
+        _SNIPPET.format(
+            pragma="  # jaxlint: disable=prng-reuse (fixture reason)"
+        ),
+    )
+    assert suppressed == []
+
+
+def test_suppression_standalone_line_covers_next_code_line(tmp_path):
+    src = _SNIPPET.format(pragma="").replace(
+        "    b = jax.random.uniform",
+        "    # jaxlint: disable=prng-reuse (fixture reason)\n"
+        "    b = jax.random.uniform",
+    )
+    assert _run_snippet(tmp_path, src) == []
+
+
+def test_suppression_is_per_check(tmp_path):
+    # Disabling a DIFFERENT check must not hide the finding.
+    still = _run_snippet(
+        tmp_path, _SNIPPET.format(pragma="  # jaxlint: disable=host-sync")
+    )
+    assert len(still) == 1 and still[0].check == "prng-reuse"
+    assert (
+        _run_snippet(
+            tmp_path, _SNIPPET.format(pragma="  # jaxlint: disable=all")
+        )
+        == []
+    )
+
+
+# ---------------------------------------------------------------------------
+# false-positive guards (reviewed hazards that must stay clean)
+# ---------------------------------------------------------------------------
+
+
+def test_fold_in_loop_idiom_is_clean(tmp_path):
+    src = (
+        "import jax\n"
+        "def rollout(key, steps):\n"
+        "    out = []\n"
+        "    for i in range(steps):\n"
+        "        sub = jax.random.fold_in(key, i)\n"
+        "        out.append(jax.random.normal(sub, ()))\n"
+        "    return out\n"
+    )
+    assert _run_snippet(tmp_path, src) == []
+
+
+def test_exclusive_if_arms_are_not_reuse(tmp_path):
+    src = (
+        "import jax\n"
+        "def sample(key, flag):\n"
+        "    if flag:\n"
+        "        a = jax.random.normal(key, (2,))\n"
+        "    else:\n"
+        "        a = jax.random.uniform(key, (2,))\n"
+        "    return a\n"
+    )
+    assert _run_snippet(tmp_path, src) == []
+
+
+def test_donation_read_in_sibling_branch_is_not_use_after_free(tmp_path):
+    src = (
+        "import jax\n"
+        "def dispatch(state, fast, slow_fn):\n"
+        "    step = jax.jit(lambda s: s, donate_argnums=0)\n"
+        "    if fast:\n"
+        "        metrics = step(state)\n"
+        "    else:\n"
+        "        metrics = slow_fn(state)\n"
+        "    return metrics\n"
+    )
+    assert _run_snippet(tmp_path, src) == []
+
+
+def test_hot_module_pragma_in_docstring_does_not_opt_in(tmp_path):
+    body = (
+        "import numpy as np\n"
+        "def collect(act, obs, steps):\n"
+        "    for _ in range(steps):\n"
+        "        obs = np.asarray(act(obs))\n"
+        "    return obs\n"
+    )
+    doc = '"""Docs may MENTION `# jaxlint: hot-module` safely."""\n'
+    assert _run_snippet(tmp_path, doc + body) == []
+    # ... while a real comment pragma does opt in
+    flagged = _run_snippet(tmp_path, "# jaxlint: hot-module\n" + body)
+    assert [f.check for f in flagged] == ["host-sync"]
+
+
+def test_partial_scan_reports_no_stale_exemptions(capsys):
+    """Scanning ONE algos file (against the repo baseline) must stay
+    clean: neither the other modules' compile_cache.EXEMPT entries nor
+    the unscanned files' baseline entries may read as stale."""
+    cli = _load_cli()
+    rc = cli.main(["actor_critic_tpu/algos/host_loop.py"])
+    out = capsys.readouterr()
+    assert rc == 0, f"{out.out}\n{out.err}"
+    assert "stale" not in out.err
+
+
+def test_write_baseline_scoped_run_keeps_out_of_scope_entries(
+    tmp_path, capsys
+):
+    cli = _load_cli()
+    bl = tmp_path / "bl.json"
+    foreign = {
+        "check": "host-sync",
+        "path": "some/other/file.py",
+        "context": "f",
+        "line_text": "x = np.asarray(y)",
+        "reason": "audited elsewhere",
+    }
+    analysis.save_baseline(str(bl), [foreign])
+    rc = cli.main(
+        [
+            str(FIXTURES / "prng_reuse_flag.py"),
+            "--baseline", str(bl), "--write-baseline",
+        ]
+    )
+    capsys.readouterr()
+    assert rc == 0
+    entries = analysis.load_baseline(str(bl))
+    assert any(e.get("reason") == "audited elsewhere" for e in entries)
+    assert any(e.get("check") == "prng-reuse" for e in entries)
+
+
+def test_multiline_donating_call_is_not_self_reuse(tmp_path):
+    src = (
+        "import jax\n"
+        "def run(state):\n"
+        "    step = jax.jit(lambda s: s, donate_argnums=0)\n"
+        "    out = step(\n"
+        "        state,\n"
+        "    )\n"
+        "    return out\n"
+    )
+    assert _run_snippet(tmp_path, src) == []
+
+
+def test_loop_carried_donation_without_rebind_flags(tmp_path):
+    src = (
+        "import jax\n"
+        "def run(state, n):\n"
+        "    step = jax.jit(lambda s: s, donate_argnums=0)\n"
+        "    for _ in range(n):\n"
+        "        metrics = step(state)\n"  # state freed on iteration 1
+        "    return metrics\n"
+    )
+    flagged = _run_snippet(tmp_path, src)
+    assert [f.check for f in flagged] == ["donation-aliasing"]
+    assert "never rebound" in flagged[0].message
+
+
+def test_standalone_pragma_covers_multiline_statement(tmp_path):
+    src = (
+        "# jaxlint: hot-module\n"
+        "import numpy as np\n"
+        "def collect(act, obs, steps):\n"
+        "    for _ in range(steps):\n"
+        "        # jaxlint: disable=host-sync (fixture reason)\n"
+        "        obs = (\n"
+        "            np.asarray(act(obs))\n"  # finding anchors HERE
+        "        )\n"
+        "    return obs\n"
+    )
+    assert _run_snippet(tmp_path, src) == []
+
+
+def test_standalone_pragma_does_not_disable_a_whole_block(tmp_path):
+    src = (
+        "# jaxlint: hot-module\n"
+        "import numpy as np\n"
+        "def collect(act, obs, steps, flag):\n"
+        "    # jaxlint: disable=host-sync (must cover the header only)\n"
+        "    for _ in range(steps):\n"
+        "        obs = np.asarray(act(obs))\n"
+        "    return obs\n"
+    )
+    flagged = _run_snippet(tmp_path, src)
+    assert [f.check for f in flagged] == ["host-sync"]
+
+
+def test_quoted_pragma_in_comment_does_not_suppress(tmp_path):
+    src = (
+        "# jaxlint: hot-module\n"
+        "import numpy as np\n"
+        "def collect(act, obs, steps):\n"
+        "    for _ in range(steps):\n"
+        "        # TODO: revisit the `# jaxlint: disable=host-sync` idea\n"
+        "        obs = np.asarray(act(obs))\n"
+        "    return obs\n"
+    )
+    flagged = _run_snippet(tmp_path, src)
+    assert [f.check for f in flagged] == ["host-sync"]
+
+
+def test_stale_warnings_are_check_scoped(capsys):
+    """A --checks subset run must not call the deselected checks'
+    baseline entries stale."""
+    cli = _load_cli()
+    rc = cli.main(["actor_critic_tpu", "--checks", "prng-reuse"])
+    out = capsys.readouterr()
+    assert rc == 0, f"{out.out}\n{out.err}"
+    assert "stale" not in out.err
+
+
+def test_write_baseline_refuses_no_baseline(tmp_path, capsys):
+    cli = _load_cli()
+    bl = tmp_path / "bl.json"
+    analysis.save_baseline(
+        str(bl),
+        [{"check": "host-sync", "path": "p.py", "context": "f",
+          "line_text": "x", "reason": "audited"}],
+    )
+    rc = cli.main(
+        [
+            str(FIXTURES / "prng_reuse_flag.py"),
+            "--baseline", str(bl), "--no-baseline", "--write-baseline",
+        ]
+    )
+    capsys.readouterr()
+    assert rc == 2
+    assert analysis.load_baseline(str(bl))[0]["reason"] == "audited"
+
+
+# ---------------------------------------------------------------------------
+# baseline round-trip
+# ---------------------------------------------------------------------------
+
+
+def test_baseline_round_trip(tmp_path):
+    findings = _analyze("prng_reuse_flag.py")
+    assert findings
+    path = tmp_path / "baseline.json"
+    analysis.save_baseline(
+        str(path), analysis.regenerate(findings, [])
+    )
+    entries = analysis.load_baseline(str(path))
+    new, matched, stale = analysis.apply_baseline(findings, entries)
+    assert new == []
+    assert len(matched) == len(findings)
+    assert stale == []
+    # regenerating preserves hand-written reasons by fingerprint
+    entries[0]["reason"] = "audited: deliberate"
+    regen = analysis.regenerate(findings, entries)
+    assert any(e["reason"] == "audited: deliberate" for e in regen)
+
+
+def test_baseline_goes_stale_when_the_line_changes(tmp_path):
+    findings = _analyze("prng_reuse_flag.py")
+    entries = analysis.regenerate(findings, [])
+    entries[0]["line_text"] = "edited since the entry was written"
+    new, _matched, stale = analysis.apply_baseline(findings, entries)
+    # the finding resurfaces as new AND the dead entry is reported
+    assert new and stale
+
+
+def test_malformed_baseline_is_a_crash_not_a_clean_run(tmp_path):
+    path = tmp_path / "baseline.json"
+    path.write_text("{not json")
+    with pytest.raises(analysis.AnalysisError):
+        analysis.load_baseline(str(path))
+
+
+# ---------------------------------------------------------------------------
+# CLI: exit codes, --list-checks, --json
+# ---------------------------------------------------------------------------
+
+
+def test_cli_list_checks_names_all_six(capsys):
+    cli = _load_cli()
+    assert cli.main(["--list-checks"]) == 0
+    out = capsys.readouterr().out
+    for name in (
+        "donation-aliasing", "tracer-leak", "prng-reuse",
+        "recompile-hazard", "host-sync", "warmup-registry",
+    ):
+        assert name in out
+
+
+def test_cli_exit_codes_distinguish_findings_from_crashes(
+    tmp_path, capsys
+):
+    cli = _load_cli()
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    assert cli.main([str(clean), "--no-baseline"]) == 0
+
+    flag = str(FIXTURES / "prng_reuse_flag.py")
+    assert cli.main([flag, "--no-baseline", "--error-on-new"]) == 1
+
+    broken = tmp_path / "broken.py"
+    broken.write_text("def (:\n")
+    assert cli.main([str(broken), "--no-baseline"]) == 2
+    assert cli.main([str(tmp_path / "missing.py"), "--no-baseline"]) == 2
+    assert cli.main([flag, "--no-baseline", "--checks", "no-such"]) == 2
+    capsys.readouterr()
+
+
+def test_cli_json_mode(capsys):
+    cli = _load_cli()
+    rc = cli.main(
+        [str(FIXTURES / "prng_reuse_flag.py"), "--no-baseline", "--json"]
+    )
+    assert rc == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["counts"]["new"] >= 1
+    assert all(f["check"] == "prng-reuse" for f in payload["new"])
+    assert payload["counts"]["stale"] == 0
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 gate: the real tree is clean against the repo baseline
+# ---------------------------------------------------------------------------
+
+
+def test_repo_tree_is_clean(capsys):
+    """`python scripts/jaxlint.py actor_critic_tpu train.py bench` must
+    exit 0: zero un-baselined findings (the ISSUE 5 acceptance
+    criterion, enforced in-process so tier-1 fails with the report)."""
+    cli = _load_cli()
+    rc = cli.main(["actor_critic_tpu", "train.py", "bench", "--error-on-new"])
+    out = capsys.readouterr()
+    assert rc == 0, f"jaxlint found new findings:\n{out.out}\n{out.err}"
