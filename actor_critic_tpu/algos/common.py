@@ -156,6 +156,79 @@ def offpolicy_rollout(
     return rstate, env_steps, traj
 
 
+def corrected_advantages(
+    target_log_probs: jax.Array,
+    behavior_log_probs: jax.Array,
+    rewards: jax.Array,
+    values: jax.Array,
+    dones: jax.Array,
+    bootstrap_value: jax.Array,
+    gamma: float,
+    lam: float,
+    rho_bar: float = 1.0,
+    c_bar: float = 1.0,
+    correction: str = "vtrace",
+    time_axis_name: Optional[str] = None,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """THE staleness-correction machinery the off-policy-tolerant
+    trainers share (IMPALA's fused learner in `algos/impala.py` and the
+    async actor–learner PPO update in `algos/ppo.py` — ISSUE 6).
+
+    `correction="vtrace"`: clipped-importance-weighted value targets and
+    policy-gradient advantages (ops vtrace; ρ̄/c̄ clips, λ damping) — the
+    behavior policy's log-probs were recorded at rollout time, so the
+    ρ = π/μ ratios correct any parameter lag between collection and
+    consumption. `correction="none"`: plain λ-return GAE under the
+    learner's critic with no importance weighting (the A3C rule, which
+    simply tolerates small staleness bias).
+
+    All probability/value inputs must already be stop-gradiented by the
+    caller (targets are targets). With π == μ the V-trace value targets
+    equal the GAE returns exactly for any λ, and the pg advantages
+    coincide at λ=1 (canonical IMPALA) — tested in
+    tests/test_async_host.py. Returns (pg_advantages, value_targets,
+    mean_clipped_rho).
+
+    `time_axis_name` runs the recurrences sequence-parallel inside
+    shard_map via `parallel.seqpar` (the impala sp learner's path).
+    """
+    from actor_critic_tpu.ops.pallas_scan import (
+        gae_auto as _gae,
+        vtrace_auto as _vtrace,
+    )
+
+    if correction == "vtrace":
+        if time_axis_name is not None:
+            from actor_critic_tpu.parallel.seqpar import seqpar_vtrace
+
+            vt = seqpar_vtrace(
+                target_log_probs, behavior_log_probs, rewards, values,
+                dones, bootstrap_value, gamma, rho_bar=rho_bar, c_bar=c_bar,
+                lam=lam, axis_name=time_axis_name,
+            )
+        else:
+            vt = _vtrace(
+                target_log_probs, behavior_log_probs, rewards, values,
+                dones, bootstrap_value, gamma, rho_bar=rho_bar, c_bar=c_bar,
+                lam=lam,
+            )
+        return vt.pg_advantages, vt.vs, jnp.mean(vt.clipped_rhos)
+    if correction == "none":
+        if time_axis_name is not None:
+            from actor_critic_tpu.parallel.seqpar import seqpar_gae
+
+            pg_advantages, value_targets = seqpar_gae(
+                rewards, values, dones, bootstrap_value, gamma, lam,
+                axis_name=time_axis_name,
+            )
+        else:
+            pg_advantages, value_targets = _gae(
+                rewards, values, dones, bootstrap_value, gamma, lam
+            )
+        return pg_advantages, value_targets, jnp.ones(())
+    raise ValueError(f"unknown correction: {correction!r}")
+
+
 def anneal_fraction(
     update_step: jax.Array, anneal_iters: int
 ) -> Optional[jax.Array]:

@@ -45,6 +45,39 @@ class EpisodeTracker:
         }
 
 
+class MergedEpisodeTracker:
+    """Read-only `report()` view over several actors' EpisodeTrackers.
+
+    The async actor–learner driver (ppo.train_host_async / ISSUE 6)
+    runs one EpisodeTracker per actor thread; the learner's log rows
+    want ONE recent-return figure across the fleet. Reads the tail of
+    each tracker's `finished` list (appends from actor threads are
+    atomic; a row that lands mid-read shows up next log row).
+    """
+
+    def __init__(self, trackers: list[EpisodeTracker]):
+        self._trackers = trackers
+
+    def report(self, window: int = 20) -> dict[str, float]:
+        # Mean over EACH actor's last `window` episodes (up to A·window
+        # entries) — truncating the concatenation to one window would
+        # silently drop every actor but the last-listed one as soon as
+        # it alone fills the window (straggler layouts are exactly the
+        # case where actors finish episodes at very different rates).
+        recent: list[float] = []
+        total = 0
+        for t in self._trackers:
+            finished = t.finished
+            total += len(finished)
+            recent.extend(finished[-window:])
+        return {
+            "recent_return": (
+                float(np.mean(recent)) if recent else float("nan")
+            ),
+            "episodes": float(total),
+        }
+
+
 class BlockBuffers:
     """Preallocated, double-buffered time-major [K, E, ...] block storage.
 

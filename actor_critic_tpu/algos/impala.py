@@ -37,6 +37,7 @@ import optax
 
 from actor_critic_tpu.algos.common import (
     RolloutState,
+    corrected_advantages,
     init_rollout,
     rollout_scan,
     episode_metrics_update,
@@ -45,7 +46,6 @@ from actor_critic_tpu.algos.common import (
 from actor_critic_tpu.algos.metrics import aggregate_metrics
 from actor_critic_tpu.envs.jax_env import JaxEnv
 from actor_critic_tpu.models.networks import ActorCriticDiscrete, ActorCriticGaussian
-from actor_critic_tpu.ops.pallas_scan import gae_auto as gae, vtrace_auto as vtrace
 from actor_critic_tpu.parallel import mesh as pmesh
 
 
@@ -182,47 +182,23 @@ def impala_loss(
     else:
         rewards = traj.reward
 
-    values_ng = jax.lax.stop_gradient(values)
-    bootstrap_ng = jax.lax.stop_gradient(bootstrap_value)
-    if cfg.correction == "vtrace":
-        if time_axis_name is not None:
-            from actor_critic_tpu.parallel.seqpar import seqpar_vtrace
-
-            vt = seqpar_vtrace(
-                jax.lax.stop_gradient(target_log_probs),
-                traj.log_prob, rewards, values_ng, traj.done, bootstrap_ng,
-                cfg.gamma, rho_bar=cfg.rho_bar, c_bar=cfg.c_bar, lam=cfg.lam,
-                axis_name=time_axis_name,
-            )
-        else:
-            vt = vtrace(
-                jax.lax.stop_gradient(target_log_probs),
-                traj.log_prob,
-                rewards,
-                values_ng,
-                traj.done,
-                bootstrap_ng,
-                cfg.gamma,
-                rho_bar=cfg.rho_bar,
-                c_bar=cfg.c_bar,
-                lam=cfg.lam,
-            )
-        value_targets = vt.vs
-        pg_advantages = vt.pg_advantages
-        mean_rho = jnp.mean(vt.clipped_rhos)
-    else:  # A3C: λ-return advantages, no importance correction
-        if time_axis_name is not None:
-            from actor_critic_tpu.parallel.seqpar import seqpar_gae
-
-            pg_advantages, value_targets = seqpar_gae(
-                rewards, values_ng, traj.done, bootstrap_ng, cfg.gamma,
-                cfg.lam, axis_name=time_axis_name,
-            )
-        else:
-            pg_advantages, value_targets = gae(
-                rewards, values_ng, traj.done, bootstrap_ng, cfg.gamma, cfg.lam
-            )
-        mean_rho = jnp.ones(())
+    # Correction machinery shared with the async actor–learner PPO
+    # update (ISSUE 6): V-trace or plain λ-return, sequence-parallel
+    # when a time axis name is given.
+    pg_advantages, value_targets, mean_rho = corrected_advantages(
+        jax.lax.stop_gradient(target_log_probs),
+        traj.log_prob,
+        rewards,
+        jax.lax.stop_gradient(values),
+        traj.done,
+        jax.lax.stop_gradient(bootstrap_value),
+        cfg.gamma,
+        cfg.lam,
+        rho_bar=cfg.rho_bar,
+        c_bar=cfg.c_bar,
+        correction=cfg.correction,
+        time_axis_name=time_axis_name,
+    )
 
     pg_loss = -jnp.mean(jax.lax.stop_gradient(pg_advantages) * target_log_probs)
     v_loss = 0.5 * jnp.mean((values - jax.lax.stop_gradient(value_targets)) ** 2)

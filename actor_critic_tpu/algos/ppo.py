@@ -555,6 +555,335 @@ def train_host(
     return params, opt_state, history
 
 
+def make_async_update_step(
+    env_spec,
+    cfg: PPOConfig,
+    can_truncate: bool = True,
+    correction: str = "vtrace",
+    rho_bar: float = 1.0,
+    c_bar: float = 1.0,
+):
+    """Staleness-corrected learner update for the async actor–learner
+    path (ISSUE 6): same positional signature as `make_host_update_step`
+    minus the mirror-value kwargs, on per-actor `[T, E_a]` blocks.
+
+    `correction="vtrace"` re-evaluates π/V at the stored observations
+    under the LEARNER's params and builds V-trace value targets and
+    policy-gradient advantages from the recorded BEHAVIOR log-probs
+    (`common.corrected_advantages`, the machinery shared with
+    `impala.py`), then reuses the batch through the in-jit
+    epoch/minibatch clipped-surrogate loop — IMPACT-style sample reuse
+    with a clipped-target correction; the recorded behavior value stays
+    the value-clip anchor. `correction="none"` returns
+    `make_host_update_step` itself (identical program to the lockstep
+    driver's — the depth-1 equivalence tests rely on this).
+    """
+    if correction == "none":
+        return make_host_update_step(env_spec, cfg, can_truncate)
+    if correction != "vtrace":
+        raise ValueError(f"unknown correction: {correction!r}")
+    from actor_critic_tpu.algos.common import corrected_advantages
+
+    net = make_network(env_spec, cfg)
+    opt = make_optimizer(cfg)
+    apply_fn = net.apply
+
+    @jax.jit
+    def async_update(
+        params, opt_state, obs, action, log_prob, value, reward, done,
+        terminated, final_obs, last_obs, key, progress=None,
+    ):
+        T, E = reward.shape
+        flat_obs = obs.reshape(T * E, *obs.shape[2:])
+        flat_act = action.reshape(T * E, *action.shape[2:])
+        # Targets come from the LEARNER's params — that is the whole
+        # correction: the trajectory was acted under older params.
+        dist, values_cur = apply_fn(params, flat_obs)
+        target_lp = jax.lax.stop_gradient(
+            dist.log_prob(flat_act).reshape(T, E)
+        )
+        values_cur = jax.lax.stop_gradient(values_cur.reshape(T, E))
+        _, bootstrap = apply_fn(params, last_obs)
+        bootstrap = jax.lax.stop_gradient(bootstrap)
+        if can_truncate:
+            _, fv = apply_fn(
+                params, final_obs.reshape(T * E, *final_obs.shape[2:])
+            )
+            fv = jax.lax.stop_gradient(fv.reshape(T, E))
+            truncated = done * (1.0 - terminated)
+            rewards = reward + cfg.gamma * fv * truncated
+        else:
+            rewards = reward
+        pg_adv, vs, mean_rho = corrected_advantages(
+            target_lp, log_prob, rewards, values_cur, done, bootstrap,
+            cfg.gamma, cfg.gae_lambda, rho_bar=rho_bar, c_bar=c_bar,
+            correction="vtrace",
+        )
+        batch = PPOBatch(
+            obs=flat_obs,
+            action=flat_act,
+            log_prob_old=log_prob.reshape(T * E),
+            value_old=value.reshape(T * E),
+            advantage=pg_adv.reshape(T * E),
+            ret=vs.reshape(T * E),
+        )
+        new_params, new_opt_state, metrics = ppo_update(
+            params, opt_state, batch, key, apply_fn, opt, cfg,
+            progress=progress, unroll=should_unroll_update(env_spec, cfg),
+        )
+        metrics = dict(metrics, mean_rho=mean_rho)
+        return new_params, new_opt_state, metrics
+
+    return async_update
+
+
+def train_host_async(
+    pools,
+    cfg: PPOConfig,
+    num_iterations: int,
+    seed: int = 0,
+    log_every: int = 10,
+    log_fn: Optional[Callable[[int, dict], None]] = None,
+    eval_every: int = 0,
+    eval_envs: int = 4,
+    eval_steps: int = 1000,
+    updates_per_block: int = 1,
+    queue_depth: int = 4,
+    max_staleness: Optional[int] = 8,
+    correction: str = "vtrace",
+    rho_bar: float = 1.0,
+    c_bar: float = 1.0,
+    strict_lockstep: bool = False,
+):
+    """Async actor–learner PPO on host env pools (ISSUE 6 tentpole).
+
+    One `traj_queue.ActorService` thread per pool collects `[K, E_a]`
+    blocks through the numpy actor mirror (behavior params refreshed
+    from the `PolicyPublisher` once per block) and pushes them into a
+    bounded `TrajQueue`; this (learner) thread drains the queue
+    continuously — a straggler actor slows only its own contribution —
+    and corrects behavior-version lag with V-trace targets
+    (`make_async_update_step`), reusing each block for
+    `updates_per_block` shuffled epoch/minibatch passes (IMPACT-style).
+    A full queue drops its OLDEST block rather than blocking actors;
+    `max_staleness` additionally drops blocks that aged past the bound
+    while queued. `num_iterations` counts blocks consumed.
+
+    Requires the numpy mirror (MLP torsos — every host-env PPO config);
+    pixel pools must run the lockstep `train_host`. Checkpointing is
+    not wired for this mode yet (per-actor pools carry independent
+    normalizer state; see ROADMAP). `strict_lockstep` is the test hook:
+    with one actor, `queue_depth=1`, `updates_per_block=1` and
+    `correction="none"` the run is bit-for-bit `train_host`
+    (tests/test_async_host.py). Returns (params, opt_state, history).
+    """
+    import threading
+
+    import numpy as np
+
+    from actor_critic_tpu.algos.host_loop import (
+        MergedEpisodeTracker,
+        host_evaluate,
+        maybe_log,
+    )
+    from actor_critic_tpu.algos.traj_queue import (
+        ActorService,
+        PolicyPublisher,
+        TrajQueue,
+    )
+    from actor_critic_tpu.models import host_actor
+
+    if not pools:
+        raise ValueError("need at least one actor pool")
+    spec = pools[0].spec
+    E_a = pools[0].num_envs
+    for p in pools[1:]:
+        if p.spec != spec or p.num_envs != E_a:
+            raise ValueError(
+                "actor pools must share one env spec and num_envs (the "
+                "learner compiles ONE [K, E_a] update program)"
+            )
+    if updates_per_block < 1:
+        raise ValueError("updates_per_block must be >= 1")
+
+    key = jax.random.key(seed)
+    key, pkey = jax.random.split(key)
+    params, opt_state = init_host_params(spec, cfg, pkey)
+    np_params = jax.device_get(params)
+    if not host_actor.supports_mirror(np_params):
+        raise ValueError(
+            "async actor–learner mode needs the numpy actor mirror "
+            "(MLP torso; models/host_actor.py) — pixel pools must run "
+            "the lockstep train_host"
+        )
+    host_policy = host_actor.make_ppo_host_policy(spec, cfg)
+    host_value = host_actor.make_ppo_host_value(spec, cfg)
+    host_greedy = host_actor.make_ppo_host_greedy(spec, cfg)
+    update = make_async_update_step(
+        spec, cfg, can_truncate=True, correction=correction,
+        rho_bar=rho_bar, c_bar=c_bar,
+    )
+
+    def make_act_fn(actor_params, rng):
+        def act(o):
+            action, logp, value = host_policy(actor_params, o, rng)
+            return action, {"log_prob": logp, "value": value}
+
+        return act
+
+    block_extras = None
+    if correction == "none":
+        # The lockstep update wants truncation/bootstrap values from the
+        # SAME behavior params as the recorded per-step values (the
+        # overlap-mode contract); the V-trace update recomputes every
+        # value under the learner's params instead.
+        def block_extras(actor_params, last_obs, block):
+            T_, E_ = block["reward"].shape
+            fv = host_value(
+                actor_params,
+                block["final_obs"].reshape(
+                    T_ * E_, *block["final_obs"].shape[2:]
+                ),
+            ).reshape(T_, E_)
+            return {
+                "final_values": fv,
+                "bootstrap_value": host_value(actor_params, last_obs),
+            }
+
+    queue = TrajQueue(
+        depth=queue_depth,
+        max_staleness=None if strict_lockstep else max_staleness,
+        policy="block" if strict_lockstep else "drop_oldest",
+    )
+    publisher = PolicyPublisher(np_params, version=0)
+    stop = threading.Event()
+    actors = [
+        ActorService(
+            i, pool, queue, publisher, cfg.rollout_steps, make_act_fn,
+            # Actor 0 reproduces the lockstep driver's rng stream; the
+            # others offset by a large prime so no two actors (or their
+            # pools' per-env seeds) collide.
+            rng=np.random.default_rng(seed + 0x5EED + i * 7919),
+            stop=stop, block_extras=block_extras, strict=strict_lockstep,
+        )
+        for i, pool in enumerate(pools)
+    ]
+
+    eval_pool = None
+    if eval_every > 0:
+        # Built from the LAST pool: in straggler layouts that is the
+        # fast actor, so eval sweeps don't pay the straggler's pace.
+        eval_pool = pools[-1].eval_pool(eval_envs)
+
+    history: list = []
+    metrics: dict = {}
+    trackers = MergedEpisodeTracker([a.tracker for a in actors])
+    try:
+        for a in actors:
+            a.start()
+        for it in range(num_iterations):
+            telemetry.profiler_tick()
+            # Surface a dead actor's exception EVERY iteration, not only
+            # once the queue drains — surviving actors would otherwise
+            # keep the run "healthy" while collection silently degrades.
+            for a in actors:
+                if a.error is not None:
+                    raise RuntimeError(
+                        f"actor {a.actor_id} died"
+                    ) from a.error
+            with telemetry.span("iteration", it=it + 1):
+                queue.set_consumer_version(it)
+                with telemetry.span("queue_wait", it=it + 1):
+                    block = None
+                    while block is None:
+                        block = queue.get(timeout=0.5)
+                        if block is None:
+                            for a in actors:
+                                if a.error is not None:
+                                    raise RuntimeError(
+                                        f"actor {a.actor_id} died"
+                                    ) from a.error
+                            if not any(a.alive for a in actors):
+                                raise RuntimeError(
+                                    "every actor thread exited with no "
+                                    "blocks pending"
+                                )
+                # Behavior params for the actors' NEXT blocks: this
+                # update's INPUT params (concrete — the previous
+                # dispatched update finished while blocks were being
+                # collected), fetched BEFORE the dispatch below.
+                publisher.publish(jax.device_get(params), version=it)
+                staleness = max(it - block.version, 0)
+                with telemetry.span("host_to_device"):
+                    # jnp.array, NOT asarray: the CPU backend may alias
+                    # numpy buffers zero-copy, and releasing the slot
+                    # below lets the next put() rewrite that memory
+                    # while the dispatched update still reads it — the
+                    # transfer must snapshot the block.
+                    arrays = {
+                        k: jnp.array(v) for k, v in block.arrays.items()
+                    }
+                queue.release(block)
+                kwargs = {}
+                if correction == "none":
+                    kwargs["final_values"] = arrays["final_values"]
+                    kwargs["bootstrap_value"] = arrays["bootstrap_value"]
+                if cfg.anneal_iters > 0:
+                    kwargs["progress"] = jnp.asarray(
+                        min(it / cfg.anneal_iters, 1.0), jnp.float32
+                    )
+                with telemetry.span("update", dispatch="async"):
+                    for _ in range(updates_per_block):
+                        key, ukey = jax.random.split(key)
+                        params, opt_state, metrics = update(
+                            params, opt_state,
+                            arrays["obs"], arrays["action"],
+                            arrays["log_prob"], arrays["value"],
+                            arrays["reward"], arrays["done"],
+                            arrays["terminated"], arrays["final_obs"],
+                            arrays["last_obs"], ukey, **kwargs,
+                        )
+                qs = queue.stats()
+                extra = {
+                    "env_steps": sum(a.steps_collected for a in actors),
+                    "consumed_env_steps": (it + 1) * cfg.rollout_steps * E_a,
+                    # Which actor fed this update — the per-row fairness
+                    # signal (a straggler's id should be rare here).
+                    "block_actor": block.actor_id,
+                    "block_staleness": staleness,
+                    "queue_depth": qs["depth"],
+                    "queue_drops_full": qs["drops_full"],
+                    "queue_drops_stale": qs["drops_stale"],
+                    "learner_idle_s": qs["learner_idle_s"],
+                }
+                if eval_pool is not None and (it + 1) % eval_every == 0:
+                    # Blocks on the in-flight update: eval sees CURRENT
+                    # params, exactly like the lockstep drivers.
+                    ev_params = jax.device_get(params)
+                    with telemetry.span("eval"):
+                        extra["eval_return"] = host_evaluate(
+                            eval_pool,
+                            # jaxlint: disable=host-sync (numpy mirror
+                            # eval — ev_params/obs are host arrays, no
+                            # device value is touched)
+                            lambda o: np.asarray(host_greedy(ev_params, o)),
+                            max_steps=eval_steps,
+                        )
+                maybe_log(
+                    it, log_every, metrics, trackers, history, log_fn,
+                    extra=extra, num_iterations=num_iterations,
+                    force="eval_return" in extra or it == 0,
+                )
+    finally:
+        stop.set()
+        for a in actors:
+            a.join(timeout=30.0)
+        queue.close()
+        if eval_pool is not None:
+            eval_pool.close()
+    return params, opt_state, history
+
+
 def _abstract_host_params(spec, cfg: PPOConfig):
     """(params, opt_state) shape/dtype trees via eval_shape — the same
     constructor the host loop uses, no device allocation."""
@@ -567,8 +896,8 @@ def _abstract_host_params(spec, cfg: PPOConfig):
 
 @_compile_cache.register_warmup("ppo.make_policy_step")
 def _warmup_policy_step(ctx):
-    if ctx.fused or ctx.algo != "ppo":
-        return None
+    if ctx.fused or ctx.algo != "ppo" or ctx.async_actors:
+        return None  # async actors always act through the numpy mirror
     params_abs, _ = _abstract_host_params(ctx.spec, ctx.cfg)
     if _compile_cache.mirror_active(ctx, params_abs):
         return None  # the numpy mirror acts; this program never runs
@@ -578,16 +907,16 @@ def _warmup_policy_step(ctx):
     return lambda: _compile_cache.aot_compile(jitted, params_abs, obs, key)
 
 
-@_compile_cache.register_warmup("ppo.make_host_update_step")
-def _warmup_host_update(ctx):
-    if ctx.fused or ctx.algo != "ppo":
-        return None
+def _host_update_structs(ctx, E: int, mirror: bool):
+    """Abstract argument structs of the host/async update programs at
+    env-batch width E ([T, E] blocks; E_a = E // actors in async mode) —
+    shared by the lockstep and async warmup planners so their
+    signatures can never drift apart."""
     import numpy as np
 
     cfg, spec = ctx.cfg, ctx.spec
-    T, E = cfg.rollout_steps, cfg.num_envs
+    T = cfg.rollout_steps
     params_abs, opt_abs = _abstract_host_params(spec, cfg)
-    mirror = _compile_cache.mirror_active(ctx, params_abs)
     s = _compile_cache.array_struct
     if spec.discrete:
         # The mirror samples with np.argmax (int64); the device policy
@@ -607,13 +936,58 @@ def _warmup_host_update(ctx):
         _compile_cache.host_obs_struct(ctx, (E,)),          # last_obs
         _compile_cache.key_struct(),
     ]
+    return args
+
+
+@_compile_cache.register_warmup("ppo.make_host_update_step")
+def _warmup_host_update(ctx):
+    if ctx.fused or ctx.algo != "ppo" or ctx.async_actors:
+        # Async runs dispatch the [T, E_a] program registered under
+        # ppo.make_async_update_step instead (even correction="none"
+        # reuses this factory's program, but at the per-actor width).
+        return None
+    import numpy as np
+
+    cfg = ctx.cfg
+    T, E = cfg.rollout_steps, cfg.num_envs
+    params_abs, _ = _abstract_host_params(ctx.spec, cfg)
+    mirror = _compile_cache.mirror_active(ctx, params_abs)
+    s = _compile_cache.array_struct
+    args = _host_update_structs(ctx, E, mirror)
     kwargs = {}
     if mirror:
         kwargs["final_values"] = s((T, E), np.float32)
         kwargs["bootstrap_value"] = s((E,), np.float32)
     if cfg.anneal_iters > 0:
         kwargs["progress"] = s((), np.float32)
-    jitted = make_host_update_step(spec, cfg, can_truncate=True)
+    jitted = make_host_update_step(ctx.spec, cfg, can_truncate=True)
+    return lambda: _compile_cache.aot_compile(jitted, *args, **kwargs)
+
+
+@_compile_cache.register_warmup("ppo.make_async_update_step")
+def _warmup_async_update(ctx):
+    """The async learner's corrected-update program ([T, E_a] blocks) —
+    registered so cold starts keep the PR 4 warm-path win and the
+    steady-state compile-count regression test stays at zero."""
+    if ctx.fused or ctx.algo != "ppo" or not ctx.async_actors:
+        return None
+    import numpy as np
+
+    cfg = ctx.cfg
+    T = cfg.rollout_steps
+    E_a = cfg.num_envs // ctx.async_actors
+    s = _compile_cache.array_struct
+    # Acting is always the numpy mirror in async mode → int64 actions.
+    args = _host_update_structs(ctx, E_a, mirror=True)
+    kwargs = {}
+    if ctx.async_correction == "none":
+        kwargs["final_values"] = s((T, E_a), np.float32)
+        kwargs["bootstrap_value"] = s((E_a,), np.float32)
+    if cfg.anneal_iters > 0:
+        kwargs["progress"] = s((), np.float32)
+    jitted = make_async_update_step(
+        ctx.spec, cfg, can_truncate=True, correction=ctx.async_correction
+    )
     return lambda: _compile_cache.aot_compile(jitted, *args, **kwargs)
 
 

@@ -1,0 +1,419 @@
+"""Bounded, fixed-shape trajectory queue between actor and learner
+services (ISSUE 6 tentpole).
+
+`host_loop`'s lockstep drivers run collection and updates in one thread:
+`BlockBuffers` overlaps block N's transfer/update with block N+1's
+collection, but one slow collection block still stalls every SGD step.
+This module is the decoupling layer (IMPACT, arxiv 1912.00167; GA3C,
+arxiv 1611.06256):
+
+- `ActorService` — one thread per actor: steps its own host env pool
+  (whose gym backend may itself shard over `envs/shard_pool.py` worker
+  processes), acts through the numpy mirror (`models/host_actor.py`)
+  with behavior params refreshed from the `PolicyPublisher` once per
+  block, and pushes fixed-shape `[K, E, ...]` numpy blocks tagged with
+  the behavior-policy VERSION into the queue. A straggler actor slows
+  only its own contribution.
+- `TrajQueue` — bounded ring of preallocated block slots. `put` copies
+  the actor's double-buffered arrays into a slot (the actor's buffers
+  are immediately reusable; queued blocks have stable storage), and a
+  full queue DROPS THE OLDEST block rather than blocking the producer
+  (back-pressure never stalls actors; the drop is counted). `get`
+  additionally drops blocks whose version lag exceeds `max_staleness`
+  relative to the consumer's published version. `policy="block"` is the
+  strict mode the lockstep-equivalence tests run under.
+- `PolicyPublisher` — versioned numpy behavior-param store. The learner
+  publishes each update's INPUT params (concrete before dispatch, so
+  publishing never waits on the device) with version = blocks consumed;
+  actors read the latest at each block boundary. Versions are plain
+  monotonically increasing ints carried next to the block, so the same
+  tagging scheme survives a future `jax.distributed` multi-host learner
+  (per-host actor fleets need only a shared counter, not shared
+  memory) — see ROADMAP "Multi-host / multi-chip learner scaling".
+
+The learner side lives with its algorithm (e.g. `ppo.train_host_async`)
+and drains continuously: it never idles on a slow collection block as
+long as ANY actor is producing, and corrects the resulting staleness
+with the V-trace machinery shared through `algos/common.py`
+(`corrected_advantages`).
+
+Blocks are the PR 4 shape-stabilized buckets — every actor pushes the
+same `[K, E, ...]` shapes, so the async learner reuses one compiled
+update program and steady state compiles nothing new
+(tests/test_async_host.py).
+
+Telemetry: every queue registers a gauge with the resource sampler
+(`telemetry/sampler.py register_gauge`) so depth / observe-staleness /
+drop counters / learner idle ride `resources.jsonl` and `/metrics`
+(`actor_critic_traj_queue_*`); `scripts/run_report.py` renders the
+queue row in its Resources section.
+"""
+
+# jaxlint: hot-module
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, NamedTuple, Optional
+
+import numpy as np
+
+
+class TrajBlock(NamedTuple):
+    """One queued trajectory block: fixed-shape numpy arrays plus the
+    behavior-policy version they were collected under."""
+
+    arrays: dict[str, np.ndarray]
+    version: int   # PolicyPublisher version the actor acted with
+    actor_id: int
+    seq: int       # global put order (monotonic; diagnostics)
+
+
+class TrajQueue:
+    """Bounded FIFO of fixed-shape trajectory blocks with drop-oldest
+    back-pressure and staleness-bounded consumption.
+
+    Storage is a recycled slot pool: `put` copies into a free (or
+    reclaimed-oldest) slot dict, `get` leases the slot to the consumer,
+    `release` returns it. After the first few blocks the queue
+    allocates nothing.
+
+    `policy="drop_oldest"` (default): a full queue reclaims its oldest
+    block for the incoming one — actors never wait on the learner.
+    `policy="block"`: `put` waits for a free slot (the strict mode the
+    lockstep-equivalence tests use).
+
+    `max_staleness`: blocks whose `consumer_version - version` exceeds
+    the bound at `get` time are dropped (counted in `drops_stale`);
+    None disables the bound.
+    """
+
+    def __init__(
+        self,
+        depth: int,
+        max_staleness: Optional[int] = None,
+        policy: str = "drop_oldest",
+        gauge_name: str = "traj_queue",
+        register_gauge: bool = True,
+    ):
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        if policy not in ("drop_oldest", "block"):
+            raise ValueError(f"unknown policy {policy!r}")
+        if max_staleness is not None and max_staleness < 0:
+            raise ValueError("max_staleness must be >= 0 or None")
+        self.depth = int(depth)
+        self.max_staleness = max_staleness
+        self.policy = policy
+        self._cv = threading.Condition()
+        self._pending: deque[TrajBlock] = deque()
+        self._free: list[dict[str, np.ndarray]] = []
+        self._leased = 0
+        self._seq = 0
+        self._consumer_version = 0
+        self._puts = 0
+        self._gets = 0
+        self._drops_full = 0
+        self._drops_stale = 0
+        self._last_staleness = 0
+        self._max_staleness_seen = 0
+        self._idle_s = 0.0
+        self._closed = False
+        self._gauge_key: Optional[str] = None
+        if register_gauge:
+            from actor_critic_tpu.telemetry import sampler as _sampler
+
+            self._gauge_key = _sampler.register_gauge(gauge_name, self.stats)
+
+    # -- producer ----------------------------------------------------------
+    def put(
+        self,
+        arrays: dict[str, np.ndarray],
+        version: int,
+        actor_id: int = 0,
+        timeout: Optional[float] = None,
+    ) -> bool:
+        """Copy `arrays` into a queue slot. Returns True once enqueued;
+        False only under `policy="block"` when no slot freed within
+        `timeout` (drop-oldest never waits)."""
+        with self._cv:
+            if self.policy == "block":
+                deadline = (
+                    None if timeout is None else time.monotonic() + timeout
+                )
+                while self._in_flight() >= self.depth:
+                    remaining = (
+                        None if deadline is None
+                        else deadline - time.monotonic()
+                    )
+                    if remaining is not None and remaining <= 0:
+                        return False
+                    self._cv.wait(
+                        0.1 if remaining is None else min(0.1, remaining)
+                    )
+            elif len(self._pending) and self._in_flight() >= self.depth:
+                old = self._pending.popleft()
+                self._free.append(old.arrays)
+                self._drops_full += 1
+            slot = self._free.pop() if self._free else {}
+            for name, value in arrays.items():
+                dst = slot.get(name)
+                if (
+                    dst is None
+                    or dst.shape != value.shape
+                    or dst.dtype != value.dtype
+                ):
+                    slot[name] = value.copy()
+                else:
+                    np.copyto(dst, value)
+            self._pending.append(
+                TrajBlock(slot, int(version), int(actor_id), self._seq)
+            )
+            self._seq += 1
+            self._puts += 1
+            self._cv.notify_all()
+            return True
+
+    def _in_flight(self) -> int:
+        return len(self._pending) + self._leased
+
+    # -- consumer ----------------------------------------------------------
+    def set_consumer_version(self, version: int) -> None:
+        """Record the learner's current version — the reference point the
+        staleness bound (and the observe-staleness gauge) measures lag
+        against."""
+        with self._cv:
+            self._consumer_version = int(version)
+
+    def get(self, timeout: Optional[float] = None) -> Optional[TrajBlock]:
+        """Oldest fresh-enough block (leased until `release`), or None
+        after `timeout` with nothing consumable. Time spent waiting
+        accumulates in the learner-idle gauge."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        t0 = time.monotonic()
+        try:
+            with self._cv:
+                while True:
+                    while self._pending:
+                        block = self._pending.popleft()
+                        lag = self._consumer_version - block.version
+                        if (
+                            self.max_staleness is not None
+                            and lag > self.max_staleness
+                        ):
+                            self._free.append(block.arrays)
+                            self._drops_stale += 1
+                            self._cv.notify_all()
+                            continue
+                        self._leased += 1
+                        self._gets += 1
+                        self._last_staleness = max(lag, 0)
+                        self._max_staleness_seen = max(
+                            self._max_staleness_seen, self._last_staleness
+                        )
+                        return block
+                    remaining = (
+                        None if deadline is None
+                        else deadline - time.monotonic()
+                    )
+                    if remaining is not None and remaining <= 0:
+                        return None
+                    self._cv.wait(
+                        0.1 if remaining is None else min(0.1, remaining)
+                    )
+        finally:
+            self._idle_s += time.monotonic() - t0
+
+    def release(self, block: TrajBlock) -> None:
+        """Return a leased block's storage to the slot pool (call after
+        the host→device transfer; the arrays are rewritten by later
+        puts)."""
+        with self._cv:
+            self._free.append(block.arrays)
+            self._leased -= 1
+            self._cv.notify_all()
+
+    # -- introspection -----------------------------------------------------
+    def __len__(self) -> int:
+        with self._cv:
+            return len(self._pending)
+
+    def stats(self) -> dict:
+        """Gauge row (sampler registry / `/metrics` / run_report): depth,
+        drop counters, behavior-version lag of the last consumed block
+        (`observe_staleness`), and cumulative learner idle seconds."""
+        with self._cv:
+            return {
+                "capacity": self.depth,
+                "depth": len(self._pending),
+                "leased": self._leased,
+                "puts": self._puts,
+                "gets": self._gets,
+                "drops_full": self._drops_full,
+                "drops_stale": self._drops_stale,
+                "observe_staleness": self._last_staleness,
+                "staleness_max": self._max_staleness_seen,
+                "learner_idle_s": round(self._idle_s, 3),
+            }
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self._gauge_key is not None:
+            from actor_critic_tpu.telemetry import sampler as _sampler
+
+            _sampler.unregister_gauge(self._gauge_key)
+
+
+class PolicyPublisher:
+    """Thread-safe versioned store of numpy behavior params.
+
+    The learner `publish`es each update's INPUT params with version =
+    blocks consumed so far; actors `get` the latest at block
+    boundaries. `wait_for` is the strict-mode hook: the equivalence
+    tests pin each block's behavior version to exactly the lockstep
+    driver's one-update-stale schedule.
+    """
+
+    def __init__(self, params: Any, version: int = 0):
+        self._cv = threading.Condition()
+        self._params = params
+        self._version = int(version)
+
+    def publish(self, params: Any, version: int) -> None:
+        with self._cv:
+            self._params = params
+            self._version = int(version)
+            self._cv.notify_all()
+
+    def get(self) -> tuple[int, Any]:
+        with self._cv:
+            return self._version, self._params
+
+    def wait_for(
+        self,
+        version: int,
+        stop: Optional[threading.Event] = None,
+        timeout: Optional[float] = None,
+    ) -> bool:
+        """Block until the published version reaches `version` (True), or
+        `stop` is set / `timeout` elapses (False)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while self._version < version:
+                if stop is not None and stop.is_set():
+                    return False
+                remaining = (
+                    None if deadline is None else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._cv.wait(
+                    0.1 if remaining is None else min(0.1, remaining)
+                )
+            return True
+
+
+class ActorService:
+    """One collection thread: refresh behavior params, collect a
+    `[K, E, ...]` block through `host_loop.host_collect`, push it.
+
+    `make_act_fn(np_params, rng) -> act_fn(obs) -> (action, extras)`
+    builds the per-block acting closure (the PPO driver wires the numpy
+    policy mirror here); `block_extras(np_params, last_obs, block) ->
+    dict` optionally appends per-block arrays computed under the SAME
+    behavior params (e.g. PPO's mirror-computed truncation/bootstrap
+    values). The service also records `last_obs` (the observation after
+    the block's final step) into every block.
+
+    `strict=True` reproduces the lockstep drivers' one-update-stale
+    behavior schedule exactly (block 0 and 1 act under the initial
+    params, block i>=2 under version i-1) — the contract the
+    lockstep-equivalence tests assert bit-for-bit.
+    """
+
+    def __init__(
+        self,
+        actor_id: int,
+        pool,
+        queue: TrajQueue,
+        publisher: PolicyPublisher,
+        num_steps: int,
+        make_act_fn: Callable[[Any, np.random.Generator], Callable],
+        rng: np.random.Generator,
+        stop: threading.Event,
+        block_extras: Optional[Callable[[Any, np.ndarray, dict], dict]] = None,
+        strict: bool = False,
+    ):
+        from actor_critic_tpu.algos.host_loop import (
+            BlockBuffers,
+            EpisodeTracker,
+        )
+
+        self.actor_id = int(actor_id)
+        self.pool = pool
+        self.tracker = EpisodeTracker(pool.num_envs)
+        self.steps_collected = 0
+        self.blocks_pushed = 0
+        self.error: Optional[BaseException] = None
+        self._queue = queue
+        self._publisher = publisher
+        self._num_steps = int(num_steps)
+        self._make_act_fn = make_act_fn
+        self._rng = rng
+        self._stop = stop
+        self._block_extras = block_extras
+        self._strict = strict
+        self._buffers = BlockBuffers(num_steps)
+        self._thread = threading.Thread(
+            target=self._run, name=f"actor-{actor_id}", daemon=True
+        )
+
+    def start(self) -> "ActorService":
+        self._thread.start()
+        return self
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        self._thread.join(timeout)
+
+    @property
+    def alive(self) -> bool:
+        return self._thread.is_alive()
+
+    def _run(self) -> None:
+        from actor_critic_tpu.algos.host_loop import host_collect
+
+        try:
+            obs = self.pool.reset()
+            i = 0
+            while not self._stop.is_set():
+                if self._strict and i >= 2:
+                    # Lockstep schedule: block i acts under version i-1.
+                    if not self._publisher.wait_for(i - 1, stop=self._stop):
+                        return
+                version, params = self._publisher.get()
+                act_fn = self._make_act_fn(params, self._rng)
+                obs, block = host_collect(
+                    self.pool, obs, self._num_steps, act_fn, self.tracker,
+                    buffers=self._buffers,
+                )
+                arrays = dict(block)
+                arrays["last_obs"] = obs
+                if self._block_extras is not None:
+                    arrays.update(self._block_extras(params, obs, block))
+                while not self._stop.is_set():
+                    if self._queue.put(
+                        arrays, version=version, actor_id=self.actor_id,
+                        timeout=0.25,
+                    ):
+                        self.blocks_pushed += 1
+                        self.steps_collected += (
+                            self._num_steps * self.pool.num_envs
+                        )
+                        break
+                i += 1
+        except BaseException as e:  # surfaced by the learner's get loop
+            self.error = e

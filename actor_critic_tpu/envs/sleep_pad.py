@@ -76,8 +76,47 @@ class SleepPadEnv(gym.Env):
         return self._state.copy(), reward, False, truncated, {}
 
 
+CARTPOLE_ENV_ID = "SleepPadCartPole-v0"
+QUALIFIED_CARTPOLE_ID = f"{__name__}:{CARTPOLE_ENV_ID}"
+
+
+class SleepPadCartPoleEnv(gym.Env):
+    """CartPole-v1 with a per-step wall-time pad: REAL dynamics (so a
+    learner can be judged on eval return) under a simulator-shaped wall
+    cost. The async-decoupling bench (`bench/suite.py
+    async_decoupling`, ISSUE 6) pads one worker/actor to make a
+    straggler while the rest run unpadded — lockstep collection slows
+    to the straggler's pace at its sync barrier; the async queue does
+    not. A plain delegating Env (not gym.Wrapper): registered entry
+    points need a class-level `metadata` dict."""
+
+    metadata: dict = {"render_modes": []}
+
+    def __init__(self, sleep_s: float = 0.0):
+        self._env = gym.make("CartPole-v1")
+        self._sleep_s = float(sleep_s)
+        self.observation_space = self._env.observation_space
+        self.action_space = self._env.action_space
+
+    def reset(self, *, seed: Optional[int] = None, options=None):
+        return self._env.reset(seed=seed, options=options)
+
+    def step(self, action):
+        if self._sleep_s > 0:
+            time.sleep(self._sleep_s)
+        return self._env.step(action)
+
+    def close(self):
+        self._env.close()
+
+
 if ENV_ID not in gym.registry:
     gym.register(
         id=ENV_ID,
         entry_point="actor_critic_tpu.envs.sleep_pad:SleepPadEnv",
+    )
+if CARTPOLE_ENV_ID not in gym.registry:
+    gym.register(
+        id=CARTPOLE_ENV_ID,
+        entry_point="actor_critic_tpu.envs.sleep_pad:SleepPadCartPoleEnv",
     )

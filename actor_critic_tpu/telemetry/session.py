@@ -11,10 +11,10 @@ always name the phase that hung. Installing a session (`train.py
     <telemetry-dir>/resources.jsonl  RSS / device memory / recompiles
     <telemetry-dir>/events.jsonl     health + lifecycle events
 
-The open-span stack is a plain module-global (the training loop is
-single-threaded; the sampler and watchdog threads only read it), so a
-cross-thread reader always sees a consistent-enough snapshot for a
-diagnosis line.
+Open-span stacks are PER-THREAD (the async actor–learner services run
+collection spans on actor threads — ISSUE 6); the sampler and watchdog
+threads read a snapshot across all of them, so a diagnosis line names
+the most recently entered phase anywhere in the process.
 """
 
 from __future__ import annotations
@@ -45,9 +45,26 @@ DURABLE_EVENT_KINDS = frozenset(
     {"stall", "divergence", "throughput_regression"}
 )
 
-# Open-span stack: (name, entry perf_counter). Appended/popped by _Span
-# on the training thread; read by the watchdog thread on a stall.
-_OPEN: list[tuple[str, float]] = []
+# Open-span stacks, one per thread: (name, entry perf_counter). A
+# single global list was correct while only the training thread opened
+# spans, but the async actor–learner services (algos/traj_queue.py,
+# ISSUE 6) run collection spans on actor THREADS — interleaved
+# push/pops on one list leave permanently stranded entries. Each thread
+# pushes/pops its own stack; the watchdog/exporter threads read a
+# snapshot across all of them. The registry lock guards only
+# stack creation/removal (the per-span hot path is an append/pop on a
+# list no other thread mutates).
+_OPEN_STACKS: dict[int, list[tuple[str, float]]] = {}
+_OPEN_LOCK = threading.Lock()
+
+
+def _thread_stack() -> list[tuple[str, float]]:
+    ident = threading.get_ident()
+    stack = _OPEN_STACKS.get(ident)
+    if stack is None:
+        with _OPEN_LOCK:
+            stack = _OPEN_STACKS.setdefault(ident, [])
+    return stack
 
 
 class _Span:
@@ -64,13 +81,20 @@ class _Span:
 
     def __enter__(self) -> "_Span":
         self._t0 = time.perf_counter()
-        _OPEN.append((self._name, self._t0))
+        _thread_stack().append((self._name, self._t0))
         return self
 
     def __exit__(self, *exc) -> None:
         dur = time.perf_counter() - self._t0
-        if _OPEN and _OPEN[-1][0] == self._name:
-            _OPEN.pop()
+        stack = _thread_stack()
+        if stack and stack[-1][0] == self._name:
+            stack.pop()
+        if not stack:
+            # Drop the empty stack so short-lived actor threads don't
+            # accumulate registry entries across a run.
+            with _OPEN_LOCK:
+                if not _OPEN_STACKS.get(threading.get_ident()):
+                    _OPEN_STACKS.pop(threading.get_ident(), None)
         s = _SESSION
         if s is not None:
             s.tracer.complete(self._name, self._t0, dur, self._args)
@@ -124,16 +148,23 @@ def set_current(session: Optional["TelemetrySession"]) -> None:
 
 
 def open_spans() -> list[str]:
-    """Names of currently open spans, outermost first."""
-    return [name for name, _ in list(_OPEN)]
+    """Names of THIS thread's currently open spans, outermost first."""
+    return [
+        name
+        for name, _ in list(_OPEN_STACKS.get(threading.get_ident(), []))
+    ]
 
 
 def last_open_span() -> Optional[tuple[str, float]]:
-    """(name, seconds open) of the innermost open span, if any."""
-    snapshot = list(_OPEN)
-    if not snapshot:
+    """(name, seconds open) of the innermost open span across EVERY
+    thread — the most recently entered phase is the one executing when
+    a watchdog/exporter thread asks what the process is doing."""
+    with _OPEN_LOCK:
+        stacks = [list(s) for s in _OPEN_STACKS.values()]
+    candidates = [s[-1] for s in stacks if s]
+    if not candidates:
         return None
-    name, t0 = snapshot[-1]
+    name, t0 = max(candidates, key=lambda x: x[1])
     return name, time.perf_counter() - t0
 
 

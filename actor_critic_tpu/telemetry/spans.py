@@ -31,6 +31,7 @@ CANONICAL_PHASES = frozenset({
     "env_step",         # host env collection block (or fused instant)
     "env_step_worker",  # sharded-pool worker simulator time (relayed)
     "host_to_device",   # block transfer onto the device
+    "queue_wait",       # async learner waiting on the trajectory queue
     "update",           # jitted learner update (async dispatch)
     "eval",             # greedy eval sweep
     "log",              # metrics materialization + sinks

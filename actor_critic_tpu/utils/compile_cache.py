@@ -312,6 +312,12 @@ class WarmupContext:
     eval_envs: int = 4   # --eval-envs (host eval pool batch)
     overlap: bool = True  # host loops: numpy actor mirror enabled
     resume: bool = False  # --resume (realignment chunks possible)
+    # Async actor–learner decoupling (ISSUE 6): actor count (0 =
+    # lockstep) and the learner's staleness correction — together they
+    # decide WHICH update program runs and at what [K, E_a] block shape
+    # (E_a = num_envs // async_actors).
+    async_actors: int = 0
+    async_correction: str = "vtrace"
 
 
 # name -> planner(ctx) -> Optional[() -> None].  A planner returns None

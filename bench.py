@@ -1,9 +1,21 @@
-"""Headline benchmark: A2C CartPole-v1 fused-trainer throughput.
+"""Headline benchmark: A2C CartPole-v1 fused-trainer throughput, plus a
+CPU-measurable multi-metric record that survives a dead TPU tunnel.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "env-steps/sec/chip", "vs_baseline": N}
-or, when the benchmark cannot run (dead/held TPU tunnel, backend error):
-  {"metric": ..., "value": 0.0, ..., "error": "..."}  (exit code 1)
+  {"metric": ..., "value": N, "unit": "env-steps/sec/chip", "vs_baseline": N,
+   "cpu_metrics": {"host_pool_scaling": {...}, "startup_to_first_step": {...},
+                   "async_decoupling": {...}, "update_wall": {...}}}
+or, when the headline cannot run (dead/held TPU tunnel, backend error):
+  {"metric": ..., "value": 0.0, ..., "error": "...",
+   "cpu_metrics": {...}}  (exit code 1)
+
+`cpu_metrics` (ROADMAP "Bench resilience", ISSUE 6 satellite) is
+measured on the disarmed CPU backend EVERY run — the TPU headline is an
+optional layer on top, so a tunnel-dead round still lands real numbers
+(each metric in its own subprocess with its own timeout; see
+DEFAULT_CPU_METRICS / BENCH_CPU_METRICS / BENCH_CPU_METRIC_TIMEOUT).
+Budget note for callers: the CPU block adds roughly 2-3 minutes on this
+host on top of the preflight+bench ceiling documented in supervise().
 
 `vs_baseline` is relative to the BASELINE.json:5 north-star target of
 1,000,000 env-steps/sec (the reference publishes no numbers of its own —
@@ -123,7 +135,7 @@ def _last_green(root: str | None = None) -> dict | None:
     }
 
 
-def _error_line(msg: str, root: str | None = None) -> str:
+def _error_record(msg: str, root: str | None = None) -> dict:
     record = {
         "metric": METRIC,
         "value": 0.0,
@@ -134,7 +146,88 @@ def _error_line(msg: str, root: str | None = None) -> str:
     green = _last_green(root)
     if green is not None:
         record["last_green"] = green
-    return json.dumps(record)
+    return record
+
+
+def _error_line(msg: str, root: str | None = None) -> str:
+    return json.dumps(_error_record(msg, root))
+
+
+# CPU-runnable bench/suite.py metrics promoted into every bench.py
+# record (ROADMAP "Bench resilience"; ISSUE 6 satellite): the TPU
+# headline stays on top when the tunnel is alive, but a dead tunnel no
+# longer means an evidence-free round — host_pool_scaling,
+# startup_to_first_step, async_decoupling and update_wall are measured
+# on the CPU backend regardless. BENCH_CPU_METRICS overrides the set
+# (comma list of bench/suite.py names); "0"/"none"/"off" disables.
+DEFAULT_CPU_METRICS = (
+    "host_pool_scaling,startup_to_first_step,async_decoupling,update_wall"
+)
+
+
+def _cpu_metric_names() -> list[str]:
+    raw = os.environ.get("BENCH_CPU_METRICS", "").strip()
+    if raw.lower() in ("0", "none", "off"):
+        return []
+    if not raw:
+        raw = DEFAULT_CPU_METRICS
+    return [n for n in (s.strip() for s in raw.split(",")) if n]
+
+
+def collect_cpu_metrics() -> dict:
+    """{suite name: its JSON record (or {'error': ...})} for each
+    configured CPU metric, each in its own subprocess (the suite's own
+    isolation rationale) on the disarmed-CPU backend with a per-metric
+    timeout — one wedged bench must not take the record down."""
+    from __graft_entry__ import disarm_axon
+
+    names = _cpu_metric_names()
+    if not names:
+        return {}
+    suite = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "bench", "suite.py"
+    )
+    timeout_s = float(os.environ.get("BENCH_CPU_METRIC_TIMEOUT", 240))
+    env = dict(os.environ)
+    disarm_axon(env)
+    out: dict = {}
+    for name in names:
+        try:
+            proc = subprocess.run(
+                [sys.executable, suite, name],
+                capture_output=True, text=True, timeout=timeout_s, env=env,
+            )
+        except subprocess.TimeoutExpired:
+            out[name] = {"error": f"exceeded {timeout_s:.0f}s"}
+            continue
+        lines = [
+            ln for ln in (proc.stdout or "").strip().splitlines()
+            if ln.startswith("{")
+        ]
+        if proc.returncode != 0 or not lines:
+            tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+            out[name] = {
+                "error": f"rc={proc.returncode}: "
+                + (tail[-1] if tail else "no output")
+            }
+            continue
+        try:
+            out[name] = json.loads(lines[-1])
+        except json.JSONDecodeError:
+            out[name] = {"error": "unparseable JSON"}
+    return out
+
+
+def _with_cpu_metrics(record: dict) -> dict:
+    """Attach the CPU multi-metric block; measurement failure must never
+    break the one-parseable-JSON-line contract."""
+    try:
+        metrics = collect_cpu_metrics()
+    except Exception as e:  # pragma: no cover - defensive
+        metrics = {"error": str(e)[:200]}
+    if metrics:
+        record["cpu_metrics"] = metrics
+    return record
 
 
 def _allow_cpu() -> bool:
@@ -187,26 +280,26 @@ def supervise() -> int:
     preflight_s = float(os.environ.get("BENCH_PREFLIGHT_TIMEOUT", 60))
     bench_s = float(os.environ.get("BENCH_TIMEOUT", 420))
 
+    def emit_error(msg: str) -> int:
+        # Even a dead-tunnel round lands a measured record: the CPU
+        # multi-metric block rides every error line.
+        print(json.dumps(_with_cpu_metrics(_error_record(msg))))
+        return 1
+
     rc, out, err = _run_sub(
         ["-c", "import jax; print('platform:', jax.devices()[0].platform)"],
         preflight_s,
     )
     if rc is None:
-        print(
-            _error_line(
-                f"backend preflight exceeded {preflight_s:.0f}s — TPU tunnel "
-                "dead or held by another process; no benchmark run"
-            )
+        return emit_error(
+            f"backend preflight exceeded {preflight_s:.0f}s — TPU tunnel "
+            "dead or held by another process; no benchmark run"
         )
-        return 1
     if rc != 0:
         tail = (err or out).strip().splitlines()
-        print(
-            _error_line(
-                "backend preflight failed: " + (tail[-1] if tail else f"rc={rc}")
-            )
+        return emit_error(
+            "backend preflight failed: " + (tail[-1] if tail else f"rc={rc}")
         )
-        return 1
     platform = next(
         (
             ln.split("platform:", 1)[1].strip()
@@ -218,50 +311,37 @@ def supervise() -> int:
     if platform not in ("axon", "tpu") and not _allow_cpu():
         # Refuse to pass a CPU fallback off as a per-chip TPU number
         # (VERDICT.md round-1 weakness #2: the perf story must be honest).
-        print(
-            _error_line(
-                f"backend resolved to {platform!r}, not a TPU — set "
-                "BENCH_ALLOW_CPU=1 to benchmark it anyway"
-            )
+        return emit_error(
+            f"backend resolved to {platform!r}, not a TPU — set "
+            "BENCH_ALLOW_CPU=1 to benchmark it anyway"
         )
-        return 1
 
     rc, out, err = _run_sub([os.path.abspath(__file__), "--child"], bench_s)
     if rc is None:
-        print(
-            _error_line(
-                f"benchmark exceeded {bench_s:.0f}s (preflight had passed — "
-                "tunnel died or was claimed mid-run)"
-            )
+        return emit_error(
+            f"benchmark exceeded {bench_s:.0f}s (preflight had passed — "
+            "tunnel died or was claimed mid-run)"
         )
-        return 1
     lines = [ln for ln in out.strip().splitlines() if ln.startswith("{")]
     if rc != 0 or not lines:
         tail = (err or out).strip().splitlines()
-        print(
-            _error_line(
-                f"benchmark child rc={rc}: " + (tail[-1] if tail else "no output")
-            )
+        return emit_error(
+            f"benchmark child rc={rc}: " + (tail[-1] if tail else "no output")
         )
-        return 1
     try:
         record = json.loads(lines[-1])
     except json.JSONDecodeError:
-        print(_error_line("benchmark child emitted unparseable JSON"))
-        return 1
+        return emit_error("benchmark child emitted unparseable JSON")
     # Re-check the platform the child ACTUALLY ran on: a tunnel that dies
     # between preflight and child can silently fall back to CPU, and a CPU
     # number must never pass as a per-chip TPU figure.
     child_platform = record.get("platform", "unknown")
     if child_platform not in ("axon", "tpu") and not _allow_cpu():
-        print(
-            _error_line(
-                f"benchmark ran on {child_platform!r}, not a TPU (backend "
-                "changed after preflight) — set BENCH_ALLOW_CPU=1 to accept"
-            )
+        return emit_error(
+            f"benchmark ran on {child_platform!r}, not a TPU (backend "
+            "changed after preflight) — set BENCH_ALLOW_CPU=1 to accept"
         )
-        return 1
-    print(json.dumps(record))
+    print(json.dumps(_with_cpu_metrics(record)))
     return 0
 
 

@@ -321,6 +321,167 @@ def bench_host_pool_scaling():
     }
 
 
+def bench_async_decoupling():
+    """Lockstep vs async actor–learner PPO under ONE sleep-padded
+    straggler worker (ISSUE 6 acceptance row), on the CartPole/sleep_pad
+    testbed (`envs/sleep_pad.py SleepPadCartPole-v0` — real CartPole
+    dynamics, wall-padded steps).
+
+    Lockstep: one sharded pool, worker 0's shard padded — every
+    collection block waits for the straggler at the shard barrier, and
+    every SGD step waits for collection. Async: the SAME env fleet
+    partitioned per actor (actor 0 = the padded half), a bounded
+    trajectory queue, V-trace-corrected learner. Both modes consume the
+    same total env-steps (async runs 2x blocks at half width) and
+    finish with a greedy eval, so the speedup is at comparable final
+    return. The headline value is async/lockstep consumed env-steps/s
+    (target >= 1.5x)."""
+    from actor_critic_tpu.algos import ppo
+    from actor_critic_tpu.algos.host_loop import host_evaluate
+    from actor_critic_tpu.envs.host_pool import HostEnvPool
+    from actor_critic_tpu.envs.sleep_pad import QUALIFIED_CARTPOLE_ID
+    from actor_critic_tpu.models import host_actor
+
+    E, K, iters, pad = 8, 32, 60, 0.002
+    cfg = ppo.PPOConfig(
+        num_envs=E, rollout_steps=K, epochs=4, num_minibatches=4,
+        lr=3e-3, hidden=(64, 64), entropy_coef=0.001,
+    )
+
+    def greedy_eval(spec, params, pool):
+        greedy = host_actor.make_ppo_host_greedy(spec, cfg)
+        np_params = jax.device_get(params)
+        try:
+            return host_evaluate(
+                pool, lambda o: np.asarray(greedy(np_params, o)),
+                max_steps=520,
+            )
+        finally:
+            pool.close()
+
+    # Lockstep: straggler worker 0 pads E/2 envs; the shard barrier
+    # drags the whole batch to its pace.
+    pool = HostEnvPool(
+        QUALIFIED_CARTPOLE_ID, E, seed=0, workers=2,
+        worker_env_kwargs=[{"sleep_s": pad}, None],
+    )
+    t0 = time.perf_counter()
+    params, _, _ = ppo.train_host(
+        pool, cfg, num_iterations=iters, seed=0, log_every=0
+    )
+    lock_wall = time.perf_counter() - t0
+    lock_eval = greedy_eval(pool.spec, params, pool.eval_pool(8))
+    pool.close()
+    lock_sps = iters * K * E / lock_wall
+
+    # Async: same fleet split per actor; the padded actor slows only
+    # its own contribution. 2x blocks at E/2 = equal consumed steps.
+    pools = [
+        HostEnvPool(
+            QUALIFIED_CARTPOLE_ID, E // 2, seed=0,
+            env_kwargs={"sleep_s": pad},
+        ),
+        HostEnvPool(QUALIFIED_CARTPOLE_ID, E // 2, seed=100003),
+    ]
+    t0 = time.perf_counter()
+    params, _, _ = ppo.train_host_async(
+        pools, cfg, iters * 2, seed=0, log_every=0,
+        updates_per_block=1, queue_depth=4, max_staleness=8,
+        correction="vtrace",
+    )
+    async_wall = time.perf_counter() - t0
+    async_eval = greedy_eval(pools[1].spec, params, pools[1].eval_pool(8))
+    for p in pools:
+        p.close()
+    async_sps = iters * 2 * K * (E // 2) / async_wall
+    return {
+        "metric": "async_decoupling_speedup",
+        "value": round(async_sps / lock_sps, 2),
+        "unit": "x consumed env-steps/s, async vs lockstep, one "
+                "sleep-padded straggler worker (equal consumed steps)",
+        "lockstep": {
+            "steps_per_s": round(lock_sps, 1),
+            "wall_s": round(lock_wall, 2),
+            "eval_return": round(float(lock_eval), 1),
+        },
+        "async": {
+            "steps_per_s": round(async_sps, 1),
+            "wall_s": round(async_wall, 2),
+            "eval_return": round(float(async_eval), 1),
+        },
+        "config": {
+            "num_envs": E, "rollout_steps": K, "iterations": iters,
+            "sleep_s": pad, "correction": "vtrace",
+        },
+    }
+
+
+def bench_update_wall():
+    """Steady-state learner update wall at the host-PPO hot shape: the
+    plain lockstep update program and the V-trace-corrected async one
+    on an identical [K, E] CartPole-shaped block (epochs x minibatches
+    in-jit), each timed with a block_until_ready fence — the
+    denominator of every updates/s claim, and the corrected program's
+    overhead made visible (ROADMAP 'Bench resilience': a CPU-measurable
+    multi-metric record every round)."""
+    from actor_critic_tpu.algos import ppo
+    from actor_critic_tpu.envs.jax_env import EnvSpec
+
+    spec = EnvSpec(
+        obs_shape=(4,), action_dim=2, discrete=True,
+        obs_dtype=np.float32, can_truncate=True,
+    )
+    cfg = ppo.PPOConfig(
+        num_envs=8, rollout_steps=64, epochs=4, num_minibatches=4,
+        hidden=(64, 64),
+    )
+    T, E = cfg.rollout_steps, cfg.num_envs
+    rng = np.random.default_rng(0)
+    key = jax.random.key(0)
+    params, opt_state = ppo.init_host_params(spec, cfg, key)
+    obs = jnp.asarray(rng.normal(size=(T, E, 4)), jnp.float32)
+    last_obs = jnp.asarray(rng.normal(size=(E, 4)), jnp.float32)
+    args = dict(
+        action=jnp.asarray(rng.integers(0, 2, (T, E))),
+        log_prob=jnp.asarray(rng.normal(size=(T, E)) * 0.1 - 0.69, jnp.float32),
+        value=jnp.asarray(rng.normal(size=(T, E)), jnp.float32),
+        reward=jnp.ones((T, E), jnp.float32),
+        done=jnp.zeros((T, E), jnp.float32),
+        terminated=jnp.zeros((T, E), jnp.float32),
+    )
+
+    def timeit(update, reps=20):
+        out = update(
+            params, opt_state, obs, args["action"], args["log_prob"],
+            args["value"], args["reward"], args["done"],
+            args["terminated"], obs, last_obs, key,
+        )
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = update(
+                params, opt_state, obs, args["action"], args["log_prob"],
+                args["value"], args["reward"], args["done"],
+                args["terminated"], obs, last_obs, key,
+            )
+            jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / reps
+
+    plain_s = timeit(ppo.make_host_update_step(spec, cfg))
+    vtrace_s = timeit(
+        ppo.make_async_update_step(spec, cfg, correction="vtrace")
+    )
+    return {
+        "metric": "steady_state_update_wall",
+        "value": round(plain_s * 1e3, 2),
+        "unit": "ms per host-PPO update ([64, 8] block, 4 epochs x 4 "
+                "minibatches, fenced)",
+        "updates_per_s": round(1.0 / plain_s, 1),
+        "vtrace_corrected_ms": round(vtrace_s * 1e3, 2),
+        "vtrace_overhead_x": round(vtrace_s / plain_s, 2),
+    }
+
+
 def bench_mujoco_host():
     """Raw MuJoCo host-stepping rate through HostEnvPool (E=8,
     HalfCheetah-v5) — the 1-core host bound that caps every host-env
@@ -429,6 +590,8 @@ BENCHES = {
     "ddpg": bench_ddpg_updates,
     "host": bench_host_native,
     "host_pool_scaling": bench_host_pool_scaling,
+    "async_decoupling": bench_async_decoupling,
+    "update_wall": bench_update_wall,
     "mujoco": bench_mujoco_host,
     "pallas": bench_pallas_ops,
     "startup_to_first_step": bench_startup_to_first_step,

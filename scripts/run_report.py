@@ -65,6 +65,11 @@ def read_jsonl(path: str) -> list[dict]:
     return rows
 
 
+def np_mean(xs: list) -> float:
+    """Mean without numpy (this script must render anywhere)."""
+    return sum(xs) / len(xs) if xs else 0.0
+
+
 def _fmt_s(seconds: float) -> str:
     if seconds >= 60:
         return f"{seconds / 60:.1f}m"
@@ -225,6 +230,29 @@ def resource_summary(rows: list[dict]) -> list[str]:
         f"- **XLA recompiles**: {growth(rec)} total; {late} in the last "
         f"half of the samples{storm}"
     )
+    # Async actor–learner trajectory queue (algos/traj_queue.py gauge):
+    # depth says whether actors outrun the learner, observe-staleness is
+    # the behavior-version lag of consumed blocks, drops are the
+    # back-pressure record (full = drop-oldest recycles, stale = aged
+    # past --max-staleness), learner idle is the decoupling's residual
+    # wait. Counters reset per process, so the LAST row is the run's
+    # cumulative tally (matching the recompile convention above).
+    q_rows = [
+        r["traj_queue"] for r in rows
+        if isinstance(r.get("traj_queue"), dict)
+    ]
+    if q_rows:
+        depths = [q.get("depth", 0) for q in q_rows]
+        last_q = q_rows[-1]
+        out.append(
+            f"- **traj queue**: depth mean {np_mean(depths):.1f} / max "
+            f"{max(depths)} (capacity {last_q.get('capacity', '?')}); "
+            f"staleness last {last_q.get('observe_staleness', 0)} / max "
+            f"{last_q.get('staleness_max', 0)}; drops "
+            f"{last_q.get('drops_full', 0)} full + "
+            f"{last_q.get('drops_stale', 0)} stale; learner idle "
+            f"{_fmt_s(float(last_q.get('learner_idle_s', 0.0)))}"
+        )
     # Per-device peaks across the run (devices without allocator stats,
     # e.g. CPU, appear with no byte fields and are reported as such).
     dev_peak: dict[int, dict] = {}

@@ -36,7 +36,8 @@ takes_value() {
     --preset|--algo|--env|--iterations|--seed|--set|--env-set|--metrics|\
     --telemetry-dir|--telemetry-port|--telemetry-sample-s|--log-every|\
     --chunk|--eval-every|--eval-envs|--eval-steps|--workers|--ckpt-dir|\
-    --compile-cache-dir|--save-every|--stall-timeout)
+    --compile-cache-dir|--save-every|--stall-timeout|--async-actors|\
+    --updates-per-block|--max-staleness|--queue-depth|--async-correction)
       return 0 ;;
   esac
   return 1

@@ -105,6 +105,41 @@ def test_span_stack_tracked_without_session():
     telemetry.observe(1, {"loss": 0.0})
 
 
+def test_span_stacks_are_per_thread():
+    """Actor-service threads (algos/traj_queue.py, ISSUE 6) open spans
+    concurrently with the learner: each thread gets its OWN stack (no
+    stranded entries from interleaved pops), `open_spans` reports the
+    calling thread only, and `last_open_span` — the watchdog's view —
+    sees the most recently entered phase across all threads."""
+    import threading
+    import time as _time
+
+    entered = threading.Event()
+    release = threading.Event()
+    seen_in_thread: list = []
+
+    def worker():
+        with telemetry.span("env_step", steps=1):
+            seen_in_thread.append(telemetry.open_spans())
+            entered.set()
+            release.wait(5.0)
+
+    t = threading.Thread(target=worker, daemon=True)
+    with telemetry.span("update"):
+        t.start()
+        assert entered.wait(5.0)
+        _time.sleep(0.01)
+        assert telemetry.open_spans() == ["update"]  # this thread only
+        assert seen_in_thread == [["env_step"]]
+        # Cross-thread innermost: the worker's span opened later.
+        assert telemetry.last_open_span()[0] == "env_step"
+        release.set()
+        t.join(5.0)
+        assert telemetry.open_spans() == ["update"]
+    assert telemetry.open_spans() == []
+    assert telemetry.last_open_span() is None  # worker stack reclaimed
+
+
 # -------------------------------------------------------------- sampler
 
 

@@ -1,0 +1,174 @@
+"""TrajQueue / PolicyPublisher unit contracts (ISSUE 6): FIFO with slot
+recycling, drop-oldest back-pressure, staleness-bounded consumption, the
+sampler gauge, and the run_report queue row."""
+
+import importlib.util
+import json
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+
+from actor_critic_tpu.algos.traj_queue import PolicyPublisher, TrajQueue
+from actor_critic_tpu.telemetry import sampler
+
+
+def _block(v: float, shape=(4, 2)) -> dict:
+    return {"obs": np.full(shape, v, np.float32),
+            "reward": np.full(shape[:1], v, np.float32)}
+
+
+def test_fifo_and_copy_semantics():
+    q = TrajQueue(depth=3, register_gauge=False)
+    src = _block(1.0)
+    assert q.put(src, version=0)
+    src["obs"][:] = 99.0  # the queue must have snapshotted
+    assert q.put(_block(2.0), version=1)
+    b1 = q.get(timeout=1.0)
+    assert b1 is not None and b1.version == 0 and b1.seq == 0
+    np.testing.assert_array_equal(b1.arrays["obs"], 1.0)
+    q.release(b1)
+    b2 = q.get(timeout=1.0)
+    assert b2.version == 1
+    np.testing.assert_array_equal(b2.arrays["obs"], 2.0)
+    q.release(b2)
+    assert q.get(timeout=0.05) is None  # empty: timeout, not a hang
+
+
+def test_slot_recycling_reuses_storage():
+    q = TrajQueue(depth=2, register_gauge=False)
+    q.put(_block(1.0), version=0)
+    b = q.get(timeout=1.0)
+    storage = b.arrays["obs"]
+    q.release(b)
+    q.put(_block(2.0), version=1)
+    b2 = q.get(timeout=1.0)
+    # Same preallocated array object, new contents: alloc-free steady state.
+    assert b2.arrays["obs"] is storage
+    np.testing.assert_array_equal(b2.arrays["obs"], 2.0)
+    q.release(b2)
+
+
+def test_drop_oldest_when_full():
+    q = TrajQueue(depth=2, register_gauge=False)
+    for v in range(4):  # capacity 2: blocks 0 and 1 get recycled
+        q.put(_block(float(v)), version=v)
+    assert q.stats()["drops_full"] == 2
+    got = [q.get(timeout=1.0), q.get(timeout=1.0)]
+    assert [b.version for b in got] == [2, 3]  # newest survive, in order
+    for b in got:
+        q.release(b)
+
+
+def test_staleness_drop_at_get():
+    q = TrajQueue(depth=4, max_staleness=2, register_gauge=False)
+    for v in range(3):
+        q.put(_block(float(v)), version=v)
+    q.set_consumer_version(4)  # lags: 4, 3, 2
+    b = q.get(timeout=1.0)
+    assert b is not None and b.version == 2  # 0 and 1 aged out
+    assert q.stats()["drops_stale"] == 2
+    assert q.stats()["observe_staleness"] == 2
+    q.release(b)
+
+
+def test_block_policy_put_waits_for_free_slot():
+    q = TrajQueue(depth=1, policy="block", register_gauge=False)
+    assert q.put(_block(0.0), version=0)
+    assert not q.put(_block(1.0), version=1, timeout=0.05)  # full: timeout
+
+    def consume():
+        b = q.get(timeout=5.0)
+        time.sleep(0.05)
+        q.release(b)
+
+    t = threading.Thread(target=consume)
+    t.start()
+    assert q.put(_block(1.0), version=1, timeout=5.0)  # slot freed mid-wait
+    t.join()
+    assert q.stats()["drops_full"] == 0
+
+
+def test_gauge_rides_sampler_rows_until_close():
+    q = TrajQueue(depth=2)
+    try:
+        q.put(_block(1.0), version=0)
+        q.set_consumer_version(1)
+        b = q.get(timeout=1.0)
+        q.release(b)
+        row = sampler.sample_row()
+        gauge = next(
+            (v for k, v in row.items() if k.startswith("traj_queue")), None
+        )
+        assert gauge is not None, row.keys()
+        assert gauge["observe_staleness"] == 1
+        assert gauge["puts"] == 1 and gauge["gets"] == 1
+    finally:
+        q.close()
+    assert not any(
+        k.startswith("traj_queue") for k in sampler.sample_row()
+    )
+
+
+def test_publisher_versioned_wait():
+    pub = PolicyPublisher({"w": 0}, version=0)
+    assert pub.wait_for(0, timeout=0.1)
+    assert not pub.wait_for(2, timeout=0.05)
+    stop = threading.Event()
+    stop.set()
+    assert not pub.wait_for(2, stop=stop)  # stop wins over the wait
+    pub.publish({"w": 1}, version=2)
+    assert pub.wait_for(2, timeout=0.1)
+    version, params = pub.get()
+    assert version == 2 and params == {"w": 1}
+
+
+def test_merged_episode_tracker_report():
+    from actor_critic_tpu.algos.host_loop import (
+        EpisodeTracker,
+        MergedEpisodeTracker,
+    )
+
+    a, b = EpisodeTracker(2), EpisodeTracker(2)
+    a.finished.extend([10.0, 20.0])
+    b.finished.extend([30.0])
+    merged = MergedEpisodeTracker([a, b])
+    rep = merged.report()
+    assert rep["episodes"] == 3.0
+    assert rep["recent_return"] == 20.0
+    assert np.isnan(MergedEpisodeTracker([]).report()["recent_return"])
+
+
+def test_run_report_renders_queue_row(tmp_path):
+    spec = importlib.util.spec_from_file_location(
+        "run_report",
+        Path(__file__).parent.parent / "scripts" / "run_report.py",
+    )
+    run_report = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(run_report)
+
+    rows = [
+        {"ts": 1.0, "recompiles": 0,
+         "traj_queue": {"capacity": 4, "depth": 1, "puts": 3, "gets": 2,
+                        "drops_full": 0, "drops_stale": 0,
+                        "observe_staleness": 0, "staleness_max": 1,
+                        "learner_idle_s": 0.1}},
+        {"ts": 6.0, "recompiles": 0,
+         "traj_queue": {"capacity": 4, "depth": 3, "puts": 30, "gets": 20,
+                        "drops_full": 5, "drops_stale": 2,
+                        "observe_staleness": 1, "staleness_max": 3,
+                        "learner_idle_s": 0.4}},
+    ]
+    text = "\n".join(run_report.resource_summary(rows))
+    assert "traj queue" in text
+    assert "max 3 (capacity 4)" in text
+    assert "5 full + 2 stale" in text
+    assert "staleness last 1 / max 3" in text
+
+    # And end to end through render(): the row must survive real files.
+    (tmp_path / "resources.jsonl").write_text(
+        "".join(json.dumps(r) + "\n" for r in rows)
+    )
+    report = run_report.render(str(tmp_path))
+    assert "traj queue" in report

@@ -354,6 +354,75 @@ def run_fused(env, preset, args, logger) -> dict:
     return {k: float(v) for k, v in metrics.items()}
 
 
+def build_actor_pools(preset, args, actors: int) -> list:
+    """One HostEnvPool per async actor (E/A envs each, disjoint seeds,
+    the worker fleet split across actors) — the fleet the ISSUE 6
+    actor–learner services collect from."""
+    from actor_critic_tpu.envs.host_pool import HostEnvPool
+
+    kind, _, name = preset.env.partition(":")
+    if kind not in ("host", "native"):
+        raise SystemExit(
+            "--async-actors decouples HOST collection from the learner; "
+            "jax:* envs fuse rollouts into the update program and have "
+            "nothing to decouple"
+        )
+    if preset.algo != "ppo":
+        raise SystemExit(
+            "--async-actors currently drives the PPO host trainer "
+            "(ppo.train_host_async); other host algos run lockstep"
+        )
+    cfg = preset.config
+    if actors > cfg.num_envs or cfg.num_envs % actors != 0:
+        raise SystemExit(
+            f"num_envs={cfg.num_envs} must split evenly across "
+            f"--async-actors={actors} (one fixed [K, E/A] block shape "
+            "keeps the learner on a single compiled program)"
+        )
+    workers_each = max(1, args.workers // actors)
+    return [
+        HostEnvPool(
+            name,
+            num_envs=cfg.num_envs // actors,
+            # Large per-actor seed stride: pools seed their envs
+            # [seed .. seed+E), so adjacent offsets would duplicate
+            # trajectories across actors.
+            seed=args.seed + i * 100003,
+            normalize_obs=True,
+            normalize_reward=True,
+            backend="gym" if kind == "host" else "native",
+            scale_actions=bool(args.scale_actions),
+            env_kwargs=preset.env_kwargs,
+            workers=workers_each,
+        )
+        for i in range(actors)
+    ]
+
+
+def run_host_async(pools, preset, args, logger) -> dict:
+    from actor_critic_tpu.algos import ppo
+
+    last: dict = {}
+
+    def log_fn(it, m):
+        telemetry.observe(it, m)
+        last.clear()
+        last.update(m)
+        logger.log(it, m)
+
+    ppo.train_host_async(
+        pools, preset.config, num_iterations=args.iterations,
+        seed=args.seed, log_every=args.log_every, log_fn=log_fn,
+        eval_every=args.eval_every, eval_envs=args.eval_envs,
+        eval_steps=args.eval_steps,
+        updates_per_block=args.updates_per_block,
+        queue_depth=args.queue_depth,
+        max_staleness=args.max_staleness if args.max_staleness >= 0 else None,
+        correction=args.async_correction,
+    )
+    return last
+
+
 def run_host(pool, preset, args, logger) -> dict:
     from actor_critic_tpu.algos import ddpg, ppo, sac
     from actor_critic_tpu.utils.checkpoint import Checkpointer
@@ -488,6 +557,41 @@ def main(argv=None) -> int:
         "SyncVectorEnv, today's exact semantics; scaling measured by "
         "`bench/suite.py host_pool_scaling`",
     )
+    p.add_argument(
+        "--async-actors", type=int, default=0, metavar="A",
+        help="host PPO only: decouple collection from the learner "
+        "(algos/traj_queue.py) — A actor threads each drive their own "
+        "pool of num_envs/A envs and push [K, E/A] blocks into a "
+        "bounded trajectory queue; the learner drains continuously and "
+        "corrects behavior-policy staleness per --async-correction. "
+        "0 (default) = today's lockstep pipeline. Checkpointing is not "
+        "yet supported in this mode.",
+    )
+    p.add_argument(
+        "--updates-per-block", type=int, default=1, metavar="M",
+        help="async mode: epoch/minibatch passes the learner reuses "
+        "each consumed block for (IMPACT-style sample reuse; the "
+        "clipped surrogate + V-trace targets keep reuse sound)",
+    )
+    p.add_argument(
+        "--max-staleness", type=int, default=8, metavar="S",
+        help="async mode: drop blocks whose behavior-policy version "
+        "lags the learner by more than S at consumption (back-pressure "
+        "drops the OLDEST data rather than blocking actors); -1 = "
+        "unbounded",
+    )
+    p.add_argument(
+        "--queue-depth", type=int, default=4, metavar="D",
+        help="async mode: trajectory-queue capacity in blocks (a full "
+        "queue recycles its oldest block's slot for the incoming one)",
+    )
+    p.add_argument(
+        "--async-correction", choices=("vtrace", "none"), default="vtrace",
+        help="async mode: staleness correction — 'vtrace' (clipped "
+        "importance-weighted targets under the learner's params, "
+        "default) or 'none' (plain GAE under the recorded behavior "
+        "values; tolerates small staleness, A3C-style)",
+    )
     p.add_argument("--quiet", action="store_true", help="no stdout metric echo")
     p.add_argument(
         "--no-overlap", action="store_true",
@@ -583,11 +687,28 @@ def main(argv=None) -> int:
         # on-disk cache so resumed legs start near-instantly.
         compile_cache.enable_persistent_cache(cache_dir)
         print(f"compile cache: {cache_dir}", flush=True)
-    env, fused = build_env(
-        preset.env, preset.algo, preset.config, args.seed,
-        scale_actions=args.scale_actions, env_kwargs=preset.env_kwargs,
-        workers=args.workers,
-    )
+    pools = None
+    if args.async_actors > 0:
+        if args.ckpt_dir or args.resume:
+            raise SystemExit(
+                "--async-actors does not support checkpointing yet (each "
+                "actor pool carries independent normalizer state; see "
+                "ROADMAP) — drop --ckpt-dir/--resume or run lockstep"
+            )
+        if args.no_overlap:
+            print(
+                "--no-overlap is meaningless with --async-actors (actors "
+                "always act through the numpy mirror); ignored",
+                flush=True,
+            )
+        pools = build_actor_pools(preset, args, args.async_actors)
+        env, fused = pools[0], False
+    else:
+        env, fused = build_env(
+            preset.env, preset.algo, preset.config, args.seed,
+            scale_actions=args.scale_actions, env_kwargs=preset.env_kwargs,
+            workers=args.workers,
+        )
     if fused and args.workers > 1:
         print("--workers applies to host pools only; ignored for jax:* "
               "envs (their rollouts are fused on-device)", flush=True)
@@ -651,6 +772,8 @@ def main(argv=None) -> int:
             iterations=args.iterations, eval_every=args.eval_every,
             eval_envs=args.eval_envs, overlap=not args.no_overlap,
             resume=args.resume,
+            async_actors=args.async_actors,
+            async_correction=args.async_correction,
         )
         plan = compile_cache.plan_warmup(ctx)
         if plan:
@@ -684,12 +807,18 @@ def main(argv=None) -> int:
                 if getattr(args, "chunk", 1) > 1:
                     print("--chunk applies to fused (jax:*) envs only; "
                           "ignored for host pools", flush=True)
-                final = run_host(env, preset, args, logger)
+                if pools is not None:
+                    final = run_host_async(pools, preset, args, logger)
+                else:
+                    final = run_host(env, preset, args, logger)
     finally:
         if watchdog is not None:
             watchdog.stop()
         if telemetry_session is not None:
             telemetry_session.close()
+        if pools is not None:
+            for p_ in pools:
+                p_.close()
     wall = time.time() - t0
     print(
         json.dumps(
@@ -697,8 +826,12 @@ def main(argv=None) -> int:
                 "algo": preset.algo,
                 "env": preset.env,
                 "iterations": args.iterations,
+                # Async mode consumes [K, E/A] blocks: env_steps here is
+                # what the LEARNER consumed (actor-side collection,
+                # drops included, rides the metrics rows).
                 "env_steps": args.iterations
-                * steps_per_iteration(preset.algo, preset.config),
+                * steps_per_iteration(preset.algo, preset.config)
+                // max(1, args.async_actors),
                 "wall_s": round(wall, 2),
                 # NaN/Inf → null: the summary line must stay strict JSON
                 **{
