@@ -193,8 +193,11 @@ class TrajQueue:
         accumulates in the learner-idle gauge."""
         deadline = None if timeout is None else time.monotonic() + timeout
         t0 = time.monotonic()
-        try:
-            with self._cv:
+        with self._cv:
+            # try INSIDE the with: the idle accumulation then runs with
+            # the lock already held (stats() readers race an unlocked
+            # +=), and the hot path pays one acquisition, not two.
+            try:
                 while True:
                     while self._pending:
                         block = self._pending.popleft()
@@ -223,8 +226,8 @@ class TrajQueue:
                     self._cv.wait(
                         0.1 if remaining is None else min(0.1, remaining)
                     )
-        finally:
-            self._idle_s += time.monotonic() - t0
+            finally:
+                self._idle_s += time.monotonic() - t0
 
     def release(self, block: TrajBlock) -> None:
         """Return a leased block's storage to the slot pool (call after
@@ -259,13 +262,45 @@ class TrajQueue:
             }
 
     def close(self) -> None:
-        if self._closed:
-            return
-        self._closed = True
-        if self._gauge_key is not None:
+        # Test-and-set under the lock: two threads racing into close()
+        # (learner teardown vs. an exception path) could otherwise both
+        # pass the flag check and double-unregister the gauge.
+        with self._cv:
+            if self._closed:
+                return
+            self._closed = True
+            gauge_key, self._gauge_key = self._gauge_key, None
+        if gauge_key is not None:
             from actor_critic_tpu.telemetry import sampler as _sampler
 
-            _sampler.unregister_gauge(self._gauge_key)
+            _sampler.unregister_gauge(gauge_key)
+
+
+def _snapshot_frozen(tree: Any) -> Any:
+    """Copy every numpy leaf of a (dict/list/tuple-structured) params
+    tree and mark the copies read-only. The publisher stores THESE, so
+    (a) the publisher's caller keeps no writable alias of what actors
+    read — later in-place mutation of the producer's own arrays cannot
+    tear params under an actor mid-block — and (b) an actor that tries
+    to write into behavior params crashes at the write site instead of
+    silently corrupting every pool sharing the tree (the racesan
+    write-after-publish tripwire, always on here)."""
+    if isinstance(tree, np.ndarray):
+        out = tree.copy()
+        out.flags.writeable = False
+        return out
+    if isinstance(tree, dict):
+        return {k: _snapshot_frozen(v) for k, v in tree.items()}
+    if isinstance(tree, tuple):
+        vals = [_snapshot_frozen(v) for v in tree]
+        if hasattr(type(tree), "_fields"):
+            # NamedTuple subclasses (jax.device_get keeps them) take
+            # positional fields; plain tuple(*vals) would TypeError.
+            return type(tree)(*vals)
+        return tuple(vals)
+    if isinstance(tree, list):
+        return [_snapshot_frozen(v) for v in tree]
+    return tree
 
 
 class PolicyPublisher:
@@ -276,16 +311,24 @@ class PolicyPublisher:
     boundaries. `wait_for` is the strict-mode hook: the equivalence
     tests pin each block's behavior version to exactly the lockstep
     driver's one-update-stale schedule.
+
+    Stored params are frozen snapshots (`_snapshot_frozen`): `publish`
+    copies the numpy leaves and flips `writeable = False`, so stale
+    actor-side views can never be mutated and no caller retains a
+    writable alias of what actors act with (ISSUE 7; the
+    publish-aliasing pass exists to catch the by-reference variant of
+    this class reappearing elsewhere).
     """
 
     def __init__(self, params: Any, version: int = 0):
         self._cv = threading.Condition()
-        self._params = params
+        self._params = _snapshot_frozen(params)
         self._version = int(version)
 
     def publish(self, params: Any, version: int) -> None:
+        snapshot = _snapshot_frozen(params)  # copy OUTSIDE the lock
         with self._cv:
-            self._params = params
+            self._params = snapshot
             self._version = int(version)
             self._cv.notify_all()
 
@@ -356,7 +399,12 @@ class ActorService:
         self.actor_id = int(actor_id)
         self.pool = pool
         self.tracker = EpisodeTracker(pool.num_envs)
+        # jaxlint: thread-owned=actor (single writer: this service's own
+        # thread bumps the progress counters; the learner only reads
+        # them for logging and tolerates a stale read by one block)
         self.steps_collected = 0
+        # jaxlint: thread-owned=actor (same single-writer contract as
+        # steps_collected)
         self.blocks_pushed = 0
         self.error: Optional[BaseException] = None
         self._queue = queue

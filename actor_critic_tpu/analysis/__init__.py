@@ -1,9 +1,10 @@
-"""jaxlint: repo-wide JAX correctness analyzer (ISSUE 5).
+"""jaxlint: repo-wide JAX correctness analyzer (ISSUE 5, extended with
+concurrency passes + the racesan runtime sanitizer in ISSUE 7).
 
 AST-based static analysis over this repo's JAX code — pure stdlib
 `ast`, no new dependencies, and (except the `warmup-registry` pass,
 which validates against the live registry) no imports of the code it
-scans. Six registered passes, each grounded in a failure this codebase
+scans. Nine registered passes, each grounded in a failure this codebase
 actually hit or observes at runtime:
 
     donation-aliasing   donated jit args fed restore-aliased/still-live
@@ -15,10 +16,23 @@ actually hit or observes at runtime:
     host-sync           device syncs inside hot collection loops
     warmup-registry     jax.jit entry points without AOT warmup planners
                         (ISSUE 4's lint, folded in)
+    lock-discipline     compound writes to cross-thread shared state
+                        outside a lock (the PR 6 span-stack corruption;
+                        thread model in analysis/thread_model.py)
+    publish-aliasing    ndarray views of recycled slots crossing thread
+                        channels / aliased past release (the PR 6
+                        zero-copy queue race)
+    check-then-act      unlocked read-test-write windows on shared
+                        flags/counters
+
+Runtime companion: `analysis/racesan.py` — seeded cooperative-schedule
+exerciser + write-after-publish poisoner (`scripts/racesan.py`,
+tier-1's quick profile).
 
 CLI: `python scripts/jaxlint.py` (tier-1-gated via
 tests/test_jaxlint.py and scripts/tier1.sh). Per-line suppression:
-`# jaxlint: disable=<check>` with the reason in the same comment.
+`# jaxlint: disable=<check>` with the reason in the same comment;
+audited single-writer state: `# jaxlint: thread-owned=<role>`.
 Accepted findings live in `jaxlint_baseline.json` with reason strings.
 """
 

@@ -87,6 +87,13 @@ _DISABLE_RE = re.compile(
 # pragma (docs, review notes — "the `# jaxlint: hot-module` pragma")
 # cannot opt a file in.
 _HOT_RE = re.compile(r"^#\s*jaxlint:\s*hot-module\b")
+# Concurrency-audit annotation (analysis/thread_model.py): the attribute
+# (or module global) assigned on the annotated line is owned by one
+# thread role; the concurrency checks skip it. Anchored like the others
+# so prose quoting the pragma cannot annotate anything.
+_THREAD_OWNED_RE = re.compile(
+    r"^#\s*jaxlint:\s*thread-owned=([A-Za-z0-9_\-]+)"
+)
 
 
 class ModuleInfo:
@@ -126,6 +133,10 @@ class ModuleInfo:
                 self._stmt_end.get(node.lineno, node.lineno),
                 node.end_lineno or node.lineno,
             )
+        # lineno -> role from `# jaxlint: thread-owned=<role>` comments
+        # (resolution to the annotated attribute/global lives in
+        # analysis/thread_model.py).
+        self.thread_owned: dict[int, str] = {}
         self._suppressions = self._scan_suppressions()
         self.aliases = self._scan_aliases()
 
@@ -196,6 +207,9 @@ class ModuleInfo:
                     # docstring merely *mentioning* the pragma (this
                     # package's own docs do) cannot opt a file in.
                     self.hot_module = True
+                mo = _THREAD_OWNED_RE.match(tok.string)
+                if mo:
+                    self.thread_owned[tok.start[0]] = mo.group(1)
                 m = _DISABLE_RE.match(tok.string)
                 if m:
                     record(
@@ -210,6 +224,9 @@ class ModuleInfo:
                     continue
                 if _HOT_RE.match(ln.lstrip()):
                     self.hot_module = True
+                mo = _THREAD_OWNED_RE.match(ln.lstrip())
+                if mo:
+                    self.thread_owned[i] = mo.group(1)
                 m = _DISABLE_RE.match(ln.lstrip())
                 if m:
                     record(
@@ -346,6 +363,7 @@ def _ensure_builtin_checks() -> None:
     # Import-for-side-effect: each pass module registers itself. Kept
     # lazy so `import actor_critic_tpu.analysis.core` alone stays cheap.
     from actor_critic_tpu.analysis import (  # noqa: F401
+        concurrency,
         donation,
         host_sync,
         prng,

@@ -1,0 +1,581 @@
+"""racesan: deterministic race sanitizer for the async actor–learner
+stack (ISSUE 7 runtime side).
+
+The static concurrency passes reason about code; this module makes the
+RUNTIME deterministic enough to reproduce and detect the races they
+reason about. Two tools, composable:
+
+1. **Cooperative scheduler** (`CoopScheduler`) — real threads, but at
+   most ONE runs at a time: every thread parks at yield points and a
+   seeded RNG picks who proceeds, so a given seed replays its
+   interleaving bit-identically (`trace` records it). Yield points come
+   from `instrument()` (method-boundary yields) and `trace_locks()`
+   (yields around lock acquire/release — NEVER while holding, so a
+   parked thread can never hold a lock the running thread needs).
+   Sweeping seeds permutes interleavings; ~100 seeded schedules over
+   the queue/publisher units run in well under tier-1 noise.
+
+   The scheduler requires NON-BLOCKING participants: a thread that
+   parks inside a real `Condition.wait` while scheduled deadlocks the
+   permutation (nobody else may run), so exercisers use
+   `policy="drop_oldest"` queues and `get(timeout=0)` retry loops; a
+   hung schedule trips `run()`'s deadline with a `RacesanError` rather
+   than eating the pytest budget.
+
+2. **Write-after-publish poisoner** — flips `flags.writeable = False`
+   on numpy blocks at the handoff boundary so the racing WRITE crashes
+   at its own site instead of silently corrupting gradients:
+   `freeze_on_publish(publisher)` freezes the producer's retained view
+   of every published params tree (in-place mutation after publish →
+   ValueError where the mutation happens); `attach_queue_poisoner(q)`
+   freezes leased block slots (a producer recycling a slot the learner
+   still holds → ValueError in `put`'s copy) and SCRIBBLES a sentinel
+   over released slots before they re-enter the pool, so a consumer
+   that kept a zero-copy alias past `release` (the PR 6
+   copy-on-transfer bug) reads deterministic garbage the exerciser's
+   checksum catches on the very first schedule, instead of a
+   corruption that needs an unlucky preemption.
+
+The built-in exercisers (`exercise_queue`, `exercise_publisher`) are
+the units tier-1 runs (tests/test_racesan.py, scripts/racesan.py):
+producers/consumers with per-block fill checksums, a `consumer="alias"`
+mode that reproduces the reverted PR 6 consumer, and an
+`exercise_sweep` driver that aggregates seeds.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Callable, Iterable, Optional
+
+import numpy as np
+
+
+class RacesanError(RuntimeError):
+    """A detected race, or a schedule that stopped making progress."""
+
+
+# ---------------------------------------------------------------------------
+# cooperative scheduler
+# ---------------------------------------------------------------------------
+
+
+class CoopScheduler:
+    """Seeded cooperative scheduler: spawned threads run one at a time,
+    handing control over only at yield points, where the seeded RNG
+    picks the next runnable thread. Candidate order is sorted by thread
+    name before each pick, so OS arrival order cannot perturb replay."""
+
+    def __init__(self, seed: int):
+        self.seed = int(seed)
+        self._rng = random.Random(self.seed)
+        self._cv = threading.Condition()
+        self._local = threading.local()
+        # jaxlint: thread-owned=main (spawn() is setup-phase only —
+        # guarded by _started — so registration happens on the driving
+        # thread before any participant thread exists; run() only reads)
+        self._threads: dict[str, threading.Thread] = {}
+        self._runnable: set[str] = set()
+        self._live: set[str] = set()
+        self._current: Optional[str] = None
+        self._aborted = False
+        self._started = False
+        # Start barrier: no picks until EVERY participant has parked at
+        # its "start" yield — otherwise the first thread the OS happens
+        # to run would schedule itself to completion before the others
+        # even register, collapsing every seed onto one interleaving.
+        self._open = False
+        self.trace: list[tuple[str, str]] = []  # (thread, yield tag)
+        self.errors: list[tuple[str, BaseException]] = []
+
+    # -- registration ------------------------------------------------------
+
+    def spawn(self, name: str, fn: Callable[[], None]) -> None:
+        """Register a participant; threads start inside run()."""
+        if self._started:
+            raise RacesanError("spawn() after run() started")
+        if name in self._threads:
+            raise RacesanError(f"duplicate participant name {name!r}")
+
+        def body() -> None:
+            self._local.name = name
+            try:
+                self._park_until_scheduled("start")
+                fn()
+            except _Aborted:
+                pass
+            except BaseException as e:
+                with self._cv:
+                    self.errors.append((name, e))
+                    # A dead participant ends the schedule: abort so
+                    # the survivors unwind instead of yielding against
+                    # a version/progress that will never arrive.
+                    self._aborted = True
+            finally:
+                with self._cv:
+                    self._live.discard(name)
+                    self._runnable.discard(name)
+                    if self._current == name:
+                        self._pick_next_locked()
+                    self._cv.notify_all()
+
+        self._threads[name] = threading.Thread(
+            target=body, name=f"racesan-{name}", daemon=True
+        )
+
+    # -- scheduling core ---------------------------------------------------
+
+    def yield_point(self, tag: str = "") -> None:
+        """Hand control back to the scheduler. No-op on threads the
+        scheduler does not manage (the main thread driving setup)."""
+        name = getattr(self._local, "name", None)
+        if name is None:
+            return
+        self._park_until_scheduled(tag)
+
+    def _park_until_scheduled(self, tag: str) -> None:
+        name = self._local.name
+        with self._cv:
+            if self._aborted:
+                # Checked at ENTRY too: a thread the scheduler picks
+                # straight back (sole survivor ping-pong) never sits in
+                # the wait loop below, and must still unwind.
+                raise _Aborted()
+            self._runnable.add(name)
+            if self._open and (
+                self._current == name or self._current is None
+            ):
+                self._pick_next_locked()
+            self._cv.notify_all()
+            while self._current != name:
+                if self._aborted:
+                    raise _Aborted()
+                self._cv.wait(0.05)
+            # Record on RESUMPTION, not on park: park order at the
+            # start barrier is OS arrival order, but the sequence of
+            # scheduling decisions is seed-deterministic — that is the
+            # replayable trace.
+            self.trace.append((name, tag))
+
+    def _pick_next_locked(self) -> None:
+        candidates = sorted(self._runnable)
+        if not candidates:
+            self._current = None
+            return
+        self._current = candidates[self._rng.randrange(len(candidates))]
+        self._runnable.discard(self._current)
+
+    # -- driving -----------------------------------------------------------
+
+    def run(self, timeout_s: float = 10.0) -> list[tuple[str, str]]:
+        """Start every participant, drive the schedule to completion,
+        and return the trace. Raises the first participant error, or
+        RacesanError if the schedule stops making progress before
+        `timeout_s` (a real blocking wait inside a scheduled region)."""
+        self._started = True
+        with self._cv:
+            self._live = set(self._threads)
+        for t in self._threads.values():
+            t.start()
+        deadline = time.monotonic() + timeout_s
+        with self._cv:
+            # Start barrier: open the schedule only once every
+            # participant is parked, then make the first (seeded) pick.
+            while len(self._runnable) < len(self._live):
+                if time.monotonic() > deadline:
+                    break
+                self._cv.wait(0.05)
+            self._open = True
+            if self._current is None:
+                self._pick_next_locked()
+            self._cv.notify_all()
+            while self._live:
+                if time.monotonic() > deadline:
+                    self._aborted = True
+                    self._cv.notify_all()
+                    break
+                self._cv.wait(0.05)
+        for t in self._threads.values():
+            t.join(timeout=1.0)
+        if self.errors:
+            name, err = self.errors[0]
+            raise err
+        with self._cv:
+            if self._aborted:
+                raise RacesanError(
+                    f"schedule (seed={self.seed}) made no progress for "
+                    f"{timeout_s:.0f}s — a participant blocked outside "
+                    "the scheduler (real lock wait / full blocking "
+                    "queue); racesan participants must stay non-blocking"
+                )
+            return list(self.trace)
+
+    # -- instrumentation ---------------------------------------------------
+
+    def instrument(self, obj: Any, *methods: str) -> Any:
+        """Wrap bound methods with enter/exit yield points (in place)."""
+        for m in methods:
+            orig = getattr(obj, m)
+
+            def wrapped(*a, __orig=orig, __m=m, **kw):
+                self.yield_point(f"{__m}:enter")
+                try:
+                    return __orig(*a, **kw)
+                finally:
+                    self.yield_point(f"{__m}:exit")
+
+            setattr(obj, m, wrapped)
+        return obj
+
+    def trace_locks(self, obj: Any, *attrs: str) -> Any:
+        """Replace lock/condition attributes (default `_cv`) with traced
+        proxies that yield BEFORE acquire and AFTER release — the
+        boundaries where interleavings differ — never while holding."""
+        for attr in attrs or ("_cv",):
+            setattr(
+                obj, attr, _TracedLock(getattr(obj, attr), self, attr)
+            )
+        return obj
+
+
+class _Aborted(BaseException):
+    """Internal: unwinds a parked thread when the schedule aborts."""
+
+
+class _TracedLock:
+    """Condition/Lock proxy adding scheduler yields around the `with`
+    boundary. Everything else delegates, so `notify_all`/`wait` inside
+    the wrapped object keep working."""
+
+    def __init__(self, inner: Any, sched: CoopScheduler, tag: str):
+        self._inner = inner
+        self._sched = sched
+        self._tag = tag
+
+    def __enter__(self):
+        self._sched.yield_point(f"{self._tag}:acquire")
+        return self._inner.__enter__()
+
+    def __exit__(self, *exc):
+        out = self._inner.__exit__(*exc)
+        self._sched.yield_point(f"{self._tag}:release")
+        return out
+
+    def __getattr__(self, name: str):
+        return getattr(self._inner, name)
+
+
+# ---------------------------------------------------------------------------
+# write-after-publish poisoner
+# ---------------------------------------------------------------------------
+
+
+def iter_array_leaves(tree: Any):
+    """Yield every ndarray in a dict/list/tuple-structured tree."""
+    if isinstance(tree, np.ndarray):
+        yield tree
+    elif isinstance(tree, dict):
+        for v in tree.values():
+            yield from iter_array_leaves(v)
+    elif isinstance(tree, (list, tuple)):
+        for v in tree:
+            yield from iter_array_leaves(v)
+
+
+def freeze_leaves(tree: Any) -> Any:
+    """writeable=False on every leaf IN PLACE: the write-after-publish
+    tripwire — a racing in-place write now raises ValueError at its own
+    site. Returns the tree for chaining."""
+    for a in iter_array_leaves(tree):
+        a.flags.writeable = False
+    return tree
+
+
+def thaw_leaves(tree: Any) -> Any:
+    for a in iter_array_leaves(tree):
+        if a.base is None:  # views regain writability through their base
+            a.flags.writeable = True
+    return tree
+
+
+def _scribble_value(dtype: np.dtype):
+    if np.issubdtype(dtype, np.floating):
+        return np.finfo(dtype).min
+    if np.issubdtype(dtype, np.bool_):
+        return True
+    if np.issubdtype(dtype, np.integer):
+        return np.iinfo(dtype).min
+    return 0
+
+
+def scribble_leaves(tree: Any) -> Any:
+    """Overwrite every leaf with its dtype's sentinel — the quarantine
+    fill that turns a stale zero-copy alias into deterministic garbage
+    instead of a schedule-dependent corruption."""
+    for a in iter_array_leaves(tree):
+        a.fill(_scribble_value(a.dtype))
+    return tree
+
+
+def freeze_on_publish(publisher: Any) -> Any:
+    """Wrap `publisher.publish` so the PRODUCER'S RETAINED view of every
+    published params tree is frozen at the publish boundary: mutating it
+    in place afterwards crashes at the write site. (The hardened
+    `PolicyPublisher` additionally snapshots+freezes what it STORES; the
+    poisoner covers the producer's own copy, and any publisher-shaped
+    object that still stores by reference.)"""
+    orig = publisher.publish
+
+    def publish(params: Any, version: int) -> None:
+        freeze_leaves(params)
+        return orig(params, version)
+
+    publisher.publish = publish
+    return publisher
+
+
+def attach_queue_poisoner(queue: Any, scribble: bool = True) -> Any:
+    """Poison a TrajQueue-shaped object (get/release protocol):
+
+    - `get` freezes the leased block's slot arrays — any producer-side
+      write into a slot the consumer still holds (a recycle-under-the-
+      learner race) raises at the write site;
+    - `release` thaws, then (with `scribble`) sentinel-fills the slot
+      BEFORE it re-enters the pool — a consumer alias held past release
+      reads the sentinel deterministically."""
+    orig_get = queue.get
+    orig_release = queue.release
+
+    def get(timeout: Optional[float] = None):
+        block = orig_get(timeout)
+        if block is not None:
+            freeze_leaves(block.arrays)
+        return block
+
+    def release(block) -> None:
+        thaw_leaves(block.arrays)
+        if scribble:
+            scribble_leaves(block.arrays)
+        orig_release(block)
+
+    queue.get = get
+    queue.release = release
+    return queue
+
+
+# ---------------------------------------------------------------------------
+# exercisers (the tier-1 units)
+# ---------------------------------------------------------------------------
+
+
+def _fill_value(producer: int, block: int) -> float:
+    return float(producer * 1000 + block + 1)
+
+
+def exercise_queue(
+    seed: int,
+    producers: int = 2,
+    blocks_per_producer: int = 4,
+    depth: int = 2,
+    shape: tuple[int, ...] = (4, 3),
+    poison: bool = True,
+    consumer: str = "snapshot",
+    timeout_s: float = 10.0,
+) -> dict:
+    """One seeded schedule over a TrajQueue: P producers refill a
+    preallocated buffer and put(); one consumer drains with
+    `get(timeout=0)` retries and verifies every consumed block is a
+    uniform fill (torn or recycled storage shows mixed values).
+
+    `consumer="snapshot"` is the correct PR 6 consumer (np.array before
+    release); `consumer="alias"` reproduces the reverted copy-on-
+    transfer bug (np.asarray view read after release) — under the
+    poisoner's scribble it is detected on EVERY schedule. Returns a
+    report dict; detection raises RacesanError via run()."""
+    from actor_critic_tpu.algos.traj_queue import TrajQueue
+
+    if consumer not in ("snapshot", "alias"):
+        raise ValueError(f"unknown consumer mode {consumer!r}")
+    queue = TrajQueue(
+        depth=depth, policy="drop_oldest", register_gauge=False
+    )
+    sched = CoopScheduler(seed)
+    sched.trace_locks(queue, "_cv")
+    if poison:
+        attach_queue_poisoner(queue)
+    report = {
+        "seed": seed, "consumed": 0, "produced": 0,
+        "race_detected": False, "consumer": consumer,
+    }
+    done = {"producers": 0}
+
+    def producer(p: int) -> None:
+        buf = np.zeros(shape, np.float32)
+        for b in range(blocks_per_producer):
+            buf.fill(_fill_value(p, b))
+            sched.yield_point("filled")
+            # jaxlint: disable=publish-aliasing (deliberate slot reuse:
+            # TrajQueue.put copies into its own pool — reusing the fill
+            # buffer is exactly the producer contract under test)
+            queue.put({"x": buf}, version=b, actor_id=p)
+        # Participants are serialized by the scheduler (one runs at a
+        # time), so the shared progress dict needs no lock here.
+        done["producers"] += 1
+
+    def consume() -> None:
+        expect = producers * blocks_per_producer
+        while True:
+            all_done = done["producers"] >= producers
+            block = queue.get(timeout=0)
+            if block is None:
+                if all_done and len(queue) == 0:
+                    return
+                sched.yield_point("idle")
+                continue
+            if consumer == "snapshot":
+                view = {k: np.array(v) for k, v in block.arrays.items()}
+                queue.release(block)
+            else:
+                # The reverted PR 6 consumer: zero-copy view, released
+                # before the read completes.
+                # jaxlint: disable=publish-aliasing (this IS the bug —
+                # the alias-mode consumer exists to prove the poisoner
+                # catches it)
+                view = {k: np.asarray(v) for k, v in block.arrays.items()}
+                queue.release(block)
+                sched.yield_point("post-release")
+            x = view["x"]
+            uniform = bool(np.all(x == x.flat[0]))
+            expected = {
+                _fill_value(p, b)
+                for p in range(producers)
+                for b in range(blocks_per_producer)
+            }
+            if not uniform or float(x.flat[0]) not in expected:
+                report["race_detected"] = True
+                raise RacesanError(
+                    f"consumed block corrupted under seed {seed}: "
+                    f"uniform={uniform}, value={float(x.flat[0])!r} — "
+                    "slot storage was recycled/scribbled while a view "
+                    "was still live (PR 6 zero-copy class)"
+                )
+            report["consumed"] += 1
+            if report["consumed"] >= expect:
+                return
+
+    for p in range(producers):
+        sched.spawn(f"producer-{p}", lambda p=p: producer(p))
+    sched.spawn("consumer", consume)
+    try:
+        sched.run(timeout_s=timeout_s)
+    finally:
+        report["produced"] = queue.stats()["puts"]
+        report["trace_len"] = len(sched.trace)
+        queue.close()
+    return report
+
+
+def exercise_publisher(
+    seed: int,
+    versions: int = 6,
+    actors: int = 2,
+    shape: tuple[int, ...] = (3, 2),
+    poison: bool = True,
+    buggy_producer: bool = False,
+    timeout_s: float = 10.0,
+) -> dict:
+    """One seeded schedule over a PolicyPublisher: a learner publishes
+    uniform-fill params trees, actor threads read and verify uniformity.
+    `buggy_producer=True` mutates the producer's RETAINED tree in place
+    after publishing — the write-after-publish poisoner turns that into
+    a ValueError at the mutation site on every schedule."""
+    from actor_critic_tpu.algos.traj_queue import PolicyPublisher
+
+    sched = CoopScheduler(seed)
+    params0 = {"w": np.full(shape, 0.5, np.float32)}
+    publisher = PolicyPublisher(params0, version=0)
+    if poison:
+        freeze_on_publish(publisher)
+    report = {
+        "seed": seed, "published": 0, "reads": 0, "race_detected": False,
+    }
+
+    def learner() -> None:
+        retained = {"w": np.full(shape, 0.5, np.float32)}
+        for v in range(1, versions + 1):
+            if buggy_producer:
+                # In-place refresh of the SAME tree that was published
+                # last round — the PR 6-class hazard the poisoner
+                # freezes: crashes here, at the write.
+                retained["w"][...] = float(v)
+            else:
+                retained = {"w": np.full(shape, float(v), np.float32)}
+            sched.yield_point("pre-publish")
+            publisher.publish(retained, version=v)
+            report["published"] = v
+            sched.yield_point("published")
+
+    def actor(i: int) -> None:
+        # Read (and verify) until the final version is observed — the
+        # learner always publishes it, so every schedule terminates.
+        while True:
+            version, params = publisher.get()
+            w = params["w"]
+            if not bool(np.all(w == w.flat[0])):
+                report["race_detected"] = True
+                raise RacesanError(
+                    f"actor {i} read torn params at version {version} "
+                    f"under seed {seed}"
+                )
+            report["reads"] += 1
+            if version >= versions:
+                return
+            sched.yield_point("read")
+
+    sched.spawn("learner", learner)
+    for i in range(actors):
+        sched.spawn(f"actor-{i}", lambda i=i: actor(i))
+    sched.run(timeout_s=timeout_s)
+    return report
+
+
+def exercise_sweep(
+    seeds: Iterable[int],
+    scenario: Callable[[int], dict],
+) -> dict:
+    """Run `scenario(seed)` across seeds; aggregate. Detection raises —
+    a clean sweep returns counts tier-1 can assert on."""
+    reports = []
+    for seed in seeds:
+        reports.append(scenario(seed))
+    return {
+        "schedules": len(reports),
+        "consumed": sum(r.get("consumed", 0) for r in reports),
+        "reads": sum(r.get("reads", 0) for r in reports),
+        "published": sum(r.get("published", 0) for r in reports),
+        "races": sum(1 for r in reports if r.get("race_detected")),
+    }
+
+
+def quick_profile(schedules: int = 100, seed0: int = 0) -> dict:
+    """The tier-1 fast profile: `schedules` seeded interleavings split
+    across the queue (snapshot consumer, poisoned) and publisher
+    (correct producer, poisoned) units — every schedule must sweep
+    clean. ~100 schedules run in a few seconds on one CPU core."""
+    half = max(schedules // 2, 1)
+    q = exercise_sweep(
+        range(seed0, seed0 + half),
+        lambda s: exercise_queue(s, poison=True, consumer="snapshot"),
+    )
+    p = exercise_sweep(
+        range(seed0, seed0 + (schedules - half)),
+        lambda s: exercise_publisher(s, poison=True),
+    )
+    return {
+        "schedules": q["schedules"] + p["schedules"],
+        "queue": q,
+        "publisher": p,
+        "races": q["races"] + p["races"],
+    }

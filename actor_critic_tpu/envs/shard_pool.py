@@ -383,9 +383,14 @@ class ShardedVecEnv:
         )
 
     def close(self) -> None:
-        if self._closed:
-            return
-        self._closed = True
+        # Test-and-set under the gauge lock: close() can race itself
+        # (training-loop teardown vs. an exception path unwinding), and
+        # two callers passing the flag check would double-close the
+        # worker pipes.
+        with self._gauge_lock:
+            if self._closed:
+                return
+            self._closed = True
         from actor_critic_tpu.telemetry import sampler as _sampler
 
         _sampler.unregister_gauge(self._gauge_name)

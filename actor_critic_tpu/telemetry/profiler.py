@@ -84,6 +84,10 @@ class WindowedProfiler:
         # signal landing between tick's read and its zeroing store
         # would be silently discarded).
         self._pending_arm = DEFAULT_PROFILE_ITERS
+        # jaxlint: thread-owned=signal (single writer BY DESIGN: only
+        # the signal handler bumps the request counter — taking the
+        # non-reentrant lock there would deadlock a handler landing
+        # inside tick(); see the comment block above)
         self._arm_requests = 0
         self._arm_seen = 0
         self._remaining = 0

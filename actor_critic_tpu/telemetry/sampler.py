@@ -45,7 +45,12 @@ _listener_lock = threading.Lock()
 def _on_event_duration(name: str, *args, **kwargs) -> None:
     if name.endswith("backend_compile_duration"):
         global _compile_count
-        _compile_count += 1
+        # Compiles can be reported from more than one thread (the AOT
+        # warmup runner compiles concurrently with the training thread
+        # since PR 4); an unlocked += loses increments. Compile events
+        # are rare, so the lock costs nothing measurable.
+        with _listener_lock:
+            _compile_count += 1
 
 
 def ensure_compile_listener() -> None:

@@ -225,6 +225,10 @@ class TelemetrySession:
         # Live-introspection state the exporter reads: the most recent
         # observe() row and the rates derived from consecutive rows.
         self.last_observation: Optional[dict] = None
+        # jaxlint: thread-owned=train (single writer: observe() runs on
+        # the training thread only; the exporter thread snapshots via
+        # rates()'s dict() copy, one C-level call under the GIL, and a
+        # one-row-stale read is fine for a metrics scrape)
         self._rates: dict[str, float] = {}
         self._prev_observe: Optional[tuple[int, Optional[float], float]] = None
         # The recompile counter must count even when the sampler thread
@@ -237,6 +241,10 @@ class TelemetrySession:
             ),
             DivergenceMonitor(self._emit_health),
         ]
+        # jaxlint: thread-owned=train (session lifecycle — install and
+        # close() — is owned by the run-owning thread; daemon threads
+        # only read these handles, and a close() racing itself is a
+        # caller bug the None-ing below keeps idempotent anyway)
         self.profiler = None
         if profile:
             from actor_critic_tpu.telemetry.profiler import (
@@ -246,11 +254,15 @@ class TelemetrySession:
 
             self.profiler = WindowedProfiler(self.directory)
             ensure_compile_introspection()
+        # jaxlint: thread-owned=train (same lifecycle contract as
+        # profiler above)
         self.sampler: Optional[ResourceSampler] = None
         if sample_resources:
             self.sampler = ResourceSampler(
                 self._resources_fh, interval_s=resource_interval_s
             ).start()
+        # jaxlint: thread-owned=train (same lifecycle contract as
+        # profiler above)
         self.exporter = None
         if serve_port is not None:
             from actor_critic_tpu.telemetry.exporter import TelemetryExporter

@@ -99,9 +99,16 @@ class SpanTracer:
         """Emit a process_name metadata event for a FOREIGN pid (e.g. an
         env-shard worker) so Perfetto labels its lane; idempotent per
         pid so the relay can call it on every drain."""
-        if pid in self._named_pids:
-            return
-        self._named_pids.add(pid)
+        # Test-and-set under the lock: the relay drains from the
+        # training thread today, but nothing stops a second drain site
+        # (async actors relaying their own pools), and two threads
+        # passing the membership test together would emit duplicate
+        # metadata rows. _write reacquires the same lock AFTER this
+        # block releases it — never nested.
+        with self._lock:
+            if pid in self._named_pids:
+                return
+            self._named_pids.add(pid)
         self._write({
             "name": "process_name", "ph": "M", "pid": int(pid), "tid": 0,
             "args": {"name": name},

@@ -68,10 +68,16 @@ _enabled_dir: Optional[str] = None
 
 
 def _on_cache_event(name: str, **kwargs) -> None:
+    # Cache events fire from whichever thread compiles — the AOT warmup
+    # runner overlaps the training thread — and an unlocked += on the
+    # shared counters loses increments. Events are rare; the lock is
+    # noise-level.
     if name.endswith("/cache_hits"):
-        _CACHE_STATS["hits"] += 1
+        with _stats_lock:
+            _CACHE_STATS["hits"] += 1
     elif name.endswith("/cache_misses"):
-        _CACHE_STATS["misses"] += 1
+        with _stats_lock:
+            _CACHE_STATS["misses"] += 1
 
 
 def ensure_cache_stats_listener() -> bool:
@@ -323,6 +329,9 @@ class WarmupContext:
 # name -> planner(ctx) -> Optional[() -> None].  A planner returns None
 # when its entry point will not run under this context (wrong algo, host
 # entry on a fused run, mirror-covered acting path, eval disabled ...).
+# jaxlint: thread-owned=import (populated only by @register_warmup
+# decorators running at module-import time under the import lock; the
+# warmup thread and the registry lint only read it afterwards)
 _REGISTRY: dict[str, Callable[[WarmupContext], Optional[Callable]]] = {}
 
 # jax.jit sites in algos//models/ that the lint must NOT require a
@@ -408,6 +417,9 @@ class WarmupRunner:
 
     def __init__(self, plan: list[tuple[str, Callable]]):
         self._plan = plan
+        # jaxlint: thread-owned=warmup (single writer: only the warmup
+        # thread appends; benches/tests read AFTER wait() — the _done
+        # Event's set/wait pair orders those appends before the read)
         self.results: list[dict] = []
         self._done = threading.Event()
         self._thread = threading.Thread(

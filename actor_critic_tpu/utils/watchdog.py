@@ -29,6 +29,10 @@ from typing import Optional
 
 STALL_EXIT_CODE = 42
 
+# jaxlint: thread-owned=main (arm/disarm — append/remove — happen only
+# on the run-owning thread via start()/stop(); the watchdog daemon and
+# /healthz threads only iterate, and a snapshot that is one
+# arm/disarm stale is harmless for a heartbeat check)
 _ACTIVE: list["StallWatchdog"] = []
 
 
@@ -115,6 +119,10 @@ class StallWatchdog:
         if timeout_s <= 0:
             raise ValueError("timeout_s must be > 0 (use no watchdog instead)")
         self.timeout_s = float(timeout_s)
+        # jaxlint: thread-owned=main (extend_grace raises the deadline
+        # from the run-owning thread only; the watchdog thread reads a
+        # monotonic float — a racing raise-vs-raise would at worst keep
+        # the LARGER deadline's shield, which is the safe direction)
         self._grace_until = time.monotonic() + max(timeout_s, startup_grace_s)
         self._last = time.monotonic()
         self._stopped = False

@@ -4,8 +4,10 @@
     python scripts/jaxlint.py                         # default scan set
     python scripts/jaxlint.py actor_critic_tpu train.py bench
     python scripts/jaxlint.py --list-checks
+    python scripts/jaxlint.py --select lock-discipline,check-then-act
     python scripts/jaxlint.py --json                  # machine output
     python scripts/jaxlint.py --write-baseline        # regenerate
+    python scripts/jaxlint.py --prune-stale           # drop dead entries
     python scripts/jaxlint.py --show-baselined        # audit accepted
 
 Exit codes (tier-1 tells them apart — scripts/tier1.sh):
@@ -69,13 +71,21 @@ def main(argv=None) -> int:
         help="also print baselined findings with their reasons",
     )
     p.add_argument(
-        "--checks", default=None,
-        help="comma-separated subset of checks to run",
+        "--select", "--checks", dest="select", default=None,
+        help="comma-separated subset of checks to run (e.g. "
+        "--select lock-discipline,check-then-act; --checks is the "
+        "original spelling, kept as an alias)",
     )
     p.add_argument(
         "--skip", default=None,
         help="comma-separated checks to skip (e.g. warmup-registry to "
         "stay fully import-free)",
+    )
+    p.add_argument(
+        "--prune-stale", action="store_true",
+        help="rewrite the baseline WITHOUT the stale entries this run "
+        "can see (scanned paths × selected checks) and exit 0 — stale "
+        "fingerprints otherwise linger as warnings forever",
     )
     p.add_argument(
         "--error-on-new", action="store_true",
@@ -93,19 +103,19 @@ def main(argv=None) -> int:
             print(f"{c.name:<{width}}  {c.doc}")
         return 0
 
-    if args.write_baseline and args.no_baseline:
+    if (args.write_baseline or args.prune_stale) and args.no_baseline:
         # --no-baseline empties the loaded entries, so combining it with
-        # --write-baseline would rewrite the file from nothing — every
-        # audited reason silently destroyed. Refuse loudly instead.
+        # a baseline-rewriting mode would rewrite the file from nothing
+        # — every audited reason silently destroyed. Refuse loudly.
         print(
-            "jaxlint: error: --write-baseline cannot be combined with "
-            "--no-baseline (it would discard every existing audited "
-            "entry)",
+            "jaxlint: error: --write-baseline/--prune-stale cannot be "
+            "combined with --no-baseline (it would discard every "
+            "existing audited entry)",
             file=sys.stderr,
         )
         return 2
 
-    checks = args.checks.split(",") if args.checks else None
+    checks = args.select.split(",") if args.select else None
     skip = args.skip.split(",") if args.skip else ()
     baseline_path = args.baseline or analysis.default_baseline_path(REPO)
 
@@ -168,6 +178,24 @@ def main(argv=None) -> int:
         for e in stale
         if e.get("path") in scanned and e.get("check") in selected
     ]
+
+    if args.prune_stale:
+        # Drop exactly the stale-in-scope entries; everything else
+        # (matched entries, out-of-scope files/checks) is retained
+        # verbatim — pruning is scoped the same way stale REPORTING is.
+        drop = {analysis.baseline.entry_fingerprint(e) for e in stale}
+        kept = [
+            e
+            for e in entries
+            if analysis.baseline.entry_fingerprint(e) not in drop
+        ]
+        analysis.save_baseline(baseline_path, kept)
+        print(
+            f"jaxlint: pruned {len(stale)} stale baseline entr"
+            f"{'y' if len(stale) == 1 else 'ies'} "
+            f"({len(kept)} kept) from {baseline_path}"
+        )
+        return 0
 
     if args.json:
         print(

@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""racesan: deterministic-schedule race exerciser for the async
+actor–learner stack (ISSUE 7).
+
+    python scripts/racesan.py                      # quick profile
+    python scripts/racesan.py --schedules 500      # wider sweep
+    python scripts/racesan.py --scenario queue --consumer alias
+                                                   # reproduce the PR 6
+                                                   # zero-copy consumer
+    python scripts/racesan.py --json               # machine output
+
+Exit codes (scripts/tier1.sh runs the quick profile between jaxlint and
+pytest, under its own timeout):
+    0  clean: every seeded schedule swept without a detected race
+    1  race: a schedule detected corruption, or the poisoner crashed a
+       write into published/leased storage (the sanitizer working)
+    2  crash: unexpected error (including a schedule hang past the
+       scheduler deadline — a participant blocked for real)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[1].strip())
+    p.add_argument(
+        "--schedules", type=int, default=100,
+        help="seeded interleavings to sweep (default 100, the tier-1 "
+        "quick profile)",
+    )
+    p.add_argument(
+        "--seed0", type=int, default=0,
+        help="first seed of the sweep (default 0 — fixed seeds keep "
+        "tier-1 deterministic)",
+    )
+    p.add_argument(
+        "--scenario", choices=("all", "queue", "publisher"), default="all",
+        help="which unit to exercise (default: both, split evenly)",
+    )
+    p.add_argument(
+        "--consumer", choices=("snapshot", "alias"), default="snapshot",
+        help="queue consumer mode: 'alias' reproduces the reverted "
+        "PR 6 copy-on-transfer consumer (expected exit 1)",
+    )
+    p.add_argument(
+        "--no-poison", action="store_true",
+        help="disable the write-after-publish poisoner (schedule "
+        "permutation only)",
+    )
+    p.add_argument("--json", action="store_true", help="machine output")
+    args = p.parse_args(argv)
+
+    from actor_critic_tpu.analysis import racesan
+
+    poison = not args.no_poison
+    try:
+        if args.scenario == "all":
+            out = racesan.quick_profile(
+                schedules=args.schedules, seed0=args.seed0
+            )
+        elif args.scenario == "queue":
+            out = racesan.exercise_sweep(
+                range(args.seed0, args.seed0 + args.schedules),
+                lambda s: racesan.exercise_queue(
+                    s, poison=poison, consumer=args.consumer
+                ),
+            )
+        else:
+            out = racesan.exercise_sweep(
+                range(args.seed0, args.seed0 + args.schedules),
+                lambda s: racesan.exercise_publisher(s, poison=poison),
+            )
+    except racesan.RacesanError as e:
+        # A detected race names its seed: rerun that single seed to
+        # replay the interleaving bit-identically.
+        print(f"racesan: RACE DETECTED: {e}", file=sys.stderr)
+        return 1
+    except ValueError as e:
+        if "read-only" not in str(e):
+            # Only numpy's read-only write error is a detection; any
+            # other ValueError is a broken exerciser (exit 2), not a
+            # race to go hunting for.
+            print(
+                f"racesan: error: {type(e).__name__}: {e}",
+                file=sys.stderr,
+            )
+            return 2
+        # The poisoner's write-site crash surfaces as numpy's read-only
+        # ValueError at the racing write.
+        print(
+            f"racesan: RACE DETECTED (poisoned write): {e}",
+            file=sys.stderr,
+        )
+        return 1
+    except Exception as e:
+        print(f"racesan: error: {type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(json.dumps(out, indent=2))
+    else:
+        print(f"racesan: {out.get('schedules', 0)} schedule(s) clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -506,6 +506,22 @@ def static_findings() -> list[str]:
         "(`python scripts/jaxlint.py` for the full report):",
         "",
     ]
+    conc = [
+        f for f in new
+        if f.get("check")
+        in ("lock-discipline", "publish-aliasing", "check-then-act")
+    ]
+    if conc:
+        # Concurrency row (ISSUE 7): thread-safety hazards deserve their
+        # own line — a run being diagnosed for corruption/stalls should
+        # surface "the tree has unaudited races" before the per-finding
+        # list.
+        out += [
+            f"- **concurrency**: {len(conc)} of these are thread-safety "
+            "hazards (lock-discipline / publish-aliasing / "
+            "check-then-act) — `python scripts/racesan.py` exercises "
+            "the queue/publisher units under deterministic schedules",
+        ]
     out += [
         f"- `{f.get('path')}:{f.get('line')}` **[{f.get('check')}]** "
         f"{f.get('message')}"

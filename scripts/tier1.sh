@@ -8,4 +8,9 @@ cd "$(dirname "$0")/.." || exit 1
 # tier-1 before any test runs (exit 1 = findings, 2 = analyzer crash —
 # distinct so CI logs tell them apart).
 env JAX_PLATFORMS=cpu python scripts/jaxlint.py actor_critic_tpu train.py bench --error-on-new || exit $?
+# Race sanitizer quick profile (ISSUE 7): 100 fixed-seed cooperative
+# schedules over the queue/publisher units, under its OWN timeout so a
+# schedule hang (exit 124) cannot eat the pytest budget below
+# (exit 1 = race detected, 2 = exerciser crash).
+timeout -k 5 120 env JAX_PLATFORMS=cpu python scripts/racesan.py --schedules 100 || exit $?
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -1,0 +1,34 @@
+"""Flag fixture: ndarray views of recycled storage crossing a thread
+channel — both sides of the PR 6 zero-copy race. Producer side: a
+preallocated slot (and a view of it) handed to `.put()`/`.publish()`
+without a snapshot. Consumer side: `np.asarray` aliases a block that is
+`release`d back to its slot pool in the same scope."""
+
+import numpy as np
+
+
+class BlockProducer:
+    def __init__(self, queue):
+        self._queue = queue
+        self._slot = np.zeros((8, 4), np.float32)
+
+    def run(self):
+        while True:
+            self._slot[...] = 1.0
+            self._queue.put({"obs": self._slot})  # slot, not snapshot
+            self._queue.put(self._slot[:4])  # view of the slot
+
+
+def publish_loop(publisher, n):
+    buf = np.zeros((4,), np.float32)  # allocated once...
+    for v in range(n):
+        buf[:] = v
+        publisher.publish(buf, version=v)  # ...republished every pass
+
+
+def drain(queue, update, params):
+    while True:
+        block = queue.get()
+        arrays = {k: np.asarray(v) for k, v in block.arrays.items()}
+        queue.release(block)  # slot recycles under the asarray views
+        params = update(params, arrays)

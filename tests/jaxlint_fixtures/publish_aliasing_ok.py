@@ -1,0 +1,32 @@
+"""Near miss: the same shapes as publish_aliasing_flag.py made safe —
+snapshots (`.copy()` / `np.array`) at every channel boundary, a
+per-iteration allocation instead of a republished slot, and the
+consumer snapshotting before `release`."""
+
+import numpy as np
+
+
+class BlockProducer:
+    def __init__(self, queue):
+        self._queue = queue
+        self._slot = np.zeros((8, 4), np.float32)
+
+    def run(self):
+        while True:
+            self._slot[...] = 1.0
+            self._queue.put({"obs": self._slot.copy()})
+            self._queue.put(np.array(self._slot[:4]))
+
+
+def publish_loop(publisher, n):
+    for v in range(n):
+        buf = np.full((4,), float(v), np.float32)  # fresh every pass
+        publisher.publish(buf, version=v)
+
+
+def drain(queue, update, params):
+    while True:
+        block = queue.get()
+        arrays = {k: np.array(v) for k, v in block.arrays.items()}
+        queue.release(block)  # safe: arrays are snapshots
+        params = update(params, arrays)

@@ -39,6 +39,9 @@ PAIRS = [
     ("prng-reuse", "prng_reuse"),
     ("recompile-hazard", "recompile_hazard"),
     ("host-sync", "host_sync"),
+    ("lock-discipline", "lock_discipline"),
+    ("publish-aliasing", "publish_aliasing"),
+    ("check-then-act", "check_then_act"),
 ]
 
 
@@ -424,13 +427,14 @@ def test_malformed_baseline_is_a_crash_not_a_clean_run(tmp_path):
 # ---------------------------------------------------------------------------
 
 
-def test_cli_list_checks_names_all_six(capsys):
+def test_cli_list_checks_names_all_nine(capsys):
     cli = _load_cli()
     assert cli.main(["--list-checks"]) == 0
     out = capsys.readouterr().out
     for name in (
         "donation-aliasing", "tracer-leak", "prng-reuse",
         "recompile-hazard", "host-sync", "warmup-registry",
+        "lock-discipline", "publish-aliasing", "check-then-act",
     ):
         assert name in out
 
@@ -479,3 +483,235 @@ def test_repo_tree_is_clean(capsys):
     rc = cli.main(["actor_critic_tpu", "train.py", "bench", "--error-on-new"])
     out = capsys.readouterr()
     assert rc == 0, f"jaxlint found new findings:\n{out.out}\n{out.err}"
+
+
+# ---------------------------------------------------------------------------
+# --select / --prune-stale (ISSUE 7 satellites)
+# ---------------------------------------------------------------------------
+
+
+def test_select_runs_only_the_named_checks(capsys):
+    cli = _load_cli()
+    # prng_reuse_flag.py HAS prng findings, but a selection that
+    # excludes the check must come back clean.
+    rc = cli.main(
+        [
+            str(FIXTURES / "prng_reuse_flag.py"),
+            "--no-baseline", "--select", "host-sync,lock-discipline",
+        ]
+    )
+    capsys.readouterr()
+    assert rc == 0
+    rc = cli.main(
+        [
+            str(FIXTURES / "prng_reuse_flag.py"),
+            "--no-baseline", "--select", "prng-reuse",
+        ]
+    )
+    capsys.readouterr()
+    assert rc == 1
+    # a typo'd selection is a crash, not a clean run
+    assert (
+        cli.main(
+            [str(FIXTURES / "prng_reuse_flag.py"), "--select", "no-such"]
+        )
+        == 2
+    )
+    capsys.readouterr()
+
+
+def test_prune_stale_drops_only_in_scope_dead_entries(tmp_path, capsys):
+    cli = _load_cli()
+    bl = tmp_path / "bl.json"
+    dead_in_scope = {
+        "check": "prng-reuse",
+        "path": "tests/jaxlint_fixtures/prng_reuse_flag.py",
+        "context": "f",
+        "line_text": "this line no longer exists",
+        "reason": "went stale",
+    }
+    out_of_scope = {
+        "check": "host-sync",
+        "path": "some/other/file.py",
+        "context": "g",
+        "line_text": "x = np.asarray(y)",
+        "reason": "audited elsewhere",
+    }
+    live = analysis.regenerate(_analyze("prng_reuse_flag.py"), [])
+    for e in live:
+        e["reason"] = "kept"
+    analysis.save_baseline(
+        str(bl), [dead_in_scope, out_of_scope, *live]
+    )
+    rc = cli.main(
+        [
+            str(FIXTURES / "prng_reuse_flag.py"),
+            "--baseline", str(bl), "--prune-stale",
+        ]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0 and "pruned 1" in out
+    after = analysis.load_baseline(str(bl))
+    reasons = {e["reason"] for e in after}
+    # dead-in-scope gone; matched entries and out-of-scope retained
+    assert "went stale" not in reasons
+    assert "audited elsewhere" in reasons
+    assert "kept" in reasons
+
+
+def test_prune_stale_refuses_no_baseline(tmp_path, capsys):
+    cli = _load_cli()
+    bl = tmp_path / "bl.json"
+    analysis.save_baseline(
+        str(bl),
+        [{"check": "host-sync", "path": "p.py", "context": "f",
+          "line_text": "x", "reason": "audited"}],
+    )
+    rc = cli.main(
+        [
+            str(FIXTURES / "prng_reuse_flag.py"),
+            "--baseline", str(bl), "--no-baseline", "--prune-stale",
+        ]
+    )
+    capsys.readouterr()
+    assert rc == 2
+    assert analysis.load_baseline(str(bl))[0]["reason"] == "audited"
+
+
+# ---------------------------------------------------------------------------
+# thread-owned annotation mechanics (ISSUE 7)
+# ---------------------------------------------------------------------------
+
+_COUNTER_SNIPPET = (
+    "import threading\n"
+    "class Svc:\n"
+    "    def __init__(self):\n"
+    "{anno}"
+    "        self.blocks = 0\n"
+    "        self._t = threading.Thread(target=self._run)\n"
+    "    def _run(self):\n"
+    "        while True:\n"
+    "            self.blocks += 1\n"
+)
+
+
+def test_thread_owned_annotation_clears_the_attribute(tmp_path):
+    flagged = _run_snippet(tmp_path, _COUNTER_SNIPPET.format(anno=""))
+    assert [f.check for f in flagged] == ["lock-discipline"]
+    clean = _run_snippet(
+        tmp_path,
+        _COUNTER_SNIPPET.format(
+            anno="        # jaxlint: thread-owned=svc (fixture reason)\n"
+        ),
+    )
+    assert clean == []
+
+
+def test_cta_window_with_two_writes_flags_once(tmp_path):
+    """Every unlocked write in a check-then-act window belongs to that
+    finding: lock-discipline must not ALSO flag the second compound
+    write (one defect, one finding)."""
+    src = (
+        "import threading\n"
+        "class Reg:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._subs = []\n"
+        "    def add(self, x):\n"
+        "        if x in self._subs:\n"
+        "            return\n"
+        "        self._subs.append(x)\n"
+        "        self._subs.sort()\n"
+    )
+    flagged = _run_snippet(tmp_path, src)
+    assert [f.check for f in flagged] == ["check-then-act"]
+
+
+def test_thread_owned_in_docstring_does_not_annotate(tmp_path):
+    # The pragma is anchored to comment starts; prose QUOTING it (as
+    # this repo's docs do) must not silence the finding.
+    doc = (
+        '        """Docs may MENTION `# jaxlint: thread-owned=x`."""\n'
+    )
+    src = _COUNTER_SNIPPET.format(anno="").replace(
+        "    def __init__(self):\n",
+        "    def __init__(self):\n" + doc,
+    )
+    flagged = _run_snippet(tmp_path, src)
+    assert [f.check for f in flagged] == ["lock-discipline"]
+
+
+# ---------------------------------------------------------------------------
+# the two PR 6 bugs reproduce as findings (ISSUE 7 acceptance)
+# ---------------------------------------------------------------------------
+
+# telemetry/session.py as it was BEFORE the PR 6 per-thread span-stack
+# fix: one module-global open-span list, pushed/popped from every
+# thread that opens a span (actor services do). Reverting the fix must
+# trip lock-discipline.
+_PRE_FIX_SESSION = (
+    "import threading\n"
+    "import time\n"
+    "_OPEN_SPANS = []\n"
+    "class _Span:\n"
+    "    def __init__(self, name):\n"
+    "        self._name = name\n"
+    "    def __enter__(self):\n"
+    "        _OPEN_SPANS.append((self._name, time.perf_counter()))\n"
+    "        return self\n"
+    "    def __exit__(self, *exc):\n"
+    "        _OPEN_SPANS.pop()\n"
+    "def last_open_span():\n"
+    "    return _OPEN_SPANS[-1] if _OPEN_SPANS else None\n"
+)
+
+
+def test_pr6_span_stack_revert_trips_lock_discipline(tmp_path):
+    flagged = _run_snippet(tmp_path, _PRE_FIX_SESSION)
+    assert {f.check for f in flagged} == {"lock-discipline"}
+    lines = {f.line for f in flagged}
+    assert len(lines) == 2  # the push AND the pop
+    # ...and the FIXED session.py (per-thread stacks, registry lock)
+    # sweeps clean: the finding is the revert, not the fix.
+    assert (
+        analysis.analyze_paths(
+            ["actor_critic_tpu/telemetry/session.py"],
+            str(REPO),
+            checks=["lock-discipline"],
+        )
+        == []
+    )
+
+
+# ppo.train_host_async's transfer as it was BEFORE the PR 6
+# copy-on-transfer fix: jnp.asarray may alias the slot's numpy buffer
+# zero-copy, and the release below hands the slot back to the pool
+# while the dispatched update still reads it.
+_PRE_FIX_TRANSFER = (
+    "import jax.numpy as jnp\n"
+    "def learner(queue, update, params, opt_state, key):\n"
+    "    while True:\n"
+    "        block = queue.get()\n"
+    "        arrays = {k: jnp.asarray(v) for k, v in "
+    "block.arrays.items()}\n"
+    "        queue.release(block)\n"
+    "        params, opt_state = update(params, opt_state, arrays)\n"
+)
+
+
+def test_pr6_copy_on_transfer_revert_trips_publish_aliasing(tmp_path):
+    flagged = _run_snippet(tmp_path, _PRE_FIX_TRANSFER)
+    assert [f.check for f in flagged] == ["publish-aliasing"]
+    assert "jnp.asarray" in flagged[0].message
+    # the fixed consumer (jnp.array snapshots) stays clean
+    fixed = _PRE_FIX_TRANSFER.replace("jnp.asarray", "jnp.array")
+    assert _run_snippet(tmp_path, fixed) == []
+    # ...and so does the real ppo.py this fixture mirrors
+    assert (
+        analysis.analyze_paths(
+            ["actor_critic_tpu/algos/ppo.py"],
+            str(REPO),
+            checks=["publish-aliasing"],
+        )
+        == []
+    )
