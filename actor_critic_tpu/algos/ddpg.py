@@ -79,6 +79,12 @@ class DDPGConfig:
     # timesteps only for a single env (replay.sample_sequences guards
     # the ring seam, not env interleaving).
     nstep: int = 1
+    # --- quantized replay storage (ISSUE 8, replay/quantize.py) ---
+    # "fp32" stores transitions as-is; "mixed" stores obs/rewards as
+    # standardized int8 + done flags as int8 with actions kept fp32
+    # (~3.1x transitions per HBM byte at Pendulum shape); "int8" also
+    # quantizes the bounded actions (~4x, aggressive).
+    replay_dtype: str = "fp32"
 
 
 def td3_config(**overrides) -> DDPGConfig:
@@ -158,7 +164,10 @@ def init_learner(
         target_critic=jax.tree.map(jnp.copy, critic_params),
         actor_opt=optax.adam(cfg.actor_lr).init(actor_params),
         critic_opt=optax.adam(cfg.critic_lr).init(critic_params),
-        replay=replay.init(example, cfg.buffer_capacity),
+        replay=replay.init(
+            example, cfg.buffer_capacity,
+            replay.offpolicy_codecs(cfg.replay_dtype),
+        ),
         key=lkey,
         update_count=jnp.zeros((), jnp.int32),
     )
@@ -258,6 +267,7 @@ def make_update_loop(
     (static program) but params/targets/optimizer state are `where`-kept.
     """
     actor, critic = _modules(action_dim, cfg)
+    codecs = replay.offpolicy_codecs(cfg.replay_dtype)
     if cfg.nstep < 1:
         raise ValueError(f"nstep must be >= 1, got {cfg.nstep}")
     if cfg.nstep > 1 and cfg.num_envs != 1:
@@ -286,11 +296,11 @@ def make_update_loop(
         key, skey, tkey = jax.random.split(ls.key, 3)
         if cfg.nstep > 1:
             seq = replay.sample_sequences(
-                ls.replay, skey, cfg.batch_size, cfg.nstep
+                ls.replay, skey, cfg.batch_size, cfg.nstep, codecs
             )
             batch, boot_discount = nstep_batch(seq, cfg.gamma)
         else:
-            batch = replay.sample(ls.replay, skey, cfg.batch_size)
+            batch = replay.sample(ls.replay, skey, cfg.batch_size, codecs)
             boot_discount = cfg.gamma
 
         # --- TD target from target nets (+TD3 smoothing) ---
@@ -377,6 +387,7 @@ def make_train_step(
     """The fused collect→insert→update program (one jit dispatch)."""
     explore = make_explore_fn(env.spec.action_dim, cfg)
     update_loop = make_update_loop(env.spec.action_dim, cfg, axis_name)
+    codecs = replay.offpolicy_codecs(cfg.replay_dtype)
 
     def train_step(state: OffPolicyState):
         ls = state.learner
@@ -388,7 +399,9 @@ def make_train_step(
             cfg.steps_per_iter, state.env_steps,
         )
         flat = jax.tree.map(lambda x: x.reshape(-1, *x.shape[2:]), traj)
-        rbuf = replay.add_batch(ls.replay, flat)
+        # axis_name syncs the quantizer's running stats across dp so the
+        # replicated QuantStats leaves stay identical on every device.
+        rbuf = replay.add_batch(ls.replay, flat, codecs, axis_name=axis_name)
 
         # --- J gradient updates (gated until warmup + one batch in ring) ---
         # The floor is max(batch_size, nstep): sample_sequences clamps a
@@ -460,11 +473,12 @@ def make_host_ingest_update(action_dim: int, cfg: DDPGConfig):
     update loop stay on-device.
     """
     update_loop = make_update_loop(action_dim, cfg)
+    codecs = replay.offpolicy_codecs(cfg.replay_dtype)
 
     @partial(jax.jit, donate_argnums=0)
     def ingest_update(ls: LearnerState, traj: OffPolicyTransition, env_steps):
         flat = jax.tree.map(lambda x: x.reshape(-1, *x.shape[2:]), traj)
-        rbuf = replay.add_batch(ls.replay, flat)
+        rbuf = replay.add_batch(ls.replay, flat, codecs)
         # Same max(batch_size, nstep) floor as the fused path: n-step
         # windows must never clamp into zero-initialized ring slots.
         do_update = jnp.logical_and(

@@ -255,7 +255,14 @@ def host_ckpt_state(pool, save_replay: bool = True, **device_state) -> dict:
 def strip_replay(learner):
     """Learner with its replay storage truncated to one slot (shape and
     dtype preserved so save/restore templates stay structurally stable;
-    cursors ride along but are discarded on reattach)."""
+    cursors ride along but are discarded on reattach). The quantizer's
+    running mean/scale stats (`ReplayState.quant`, replay/quantize.py)
+    are deliberately NOT touched: they are item-shaped (no capacity
+    axis), cost bytes, and must survive a replay-free checkpoint — a
+    resumed run re-encodes fresh transitions against the SAME
+    standardization the restored critic trained under, instead of
+    re-learning stats that would decode early post-resume batches
+    through a different affine map."""
     import jax
 
     rb = learner.replay
@@ -466,9 +473,14 @@ def off_policy_train_host(
                     stacklevel=2,
                 )
                 # Reattach this run's zeroed full-capacity ring; the
-                # stub's cursors are stale by construction.
+                # stub's cursors are stale by construction. The restored
+                # QUANTIZER stats are kept — strip_replay saved them in
+                # full, and fresh transitions must encode against the
+                # standardization the restored critic trained under.
                 restored_learner = restored_learner._replace(
-                    replay=learner.replay
+                    replay=learner.replay._replace(
+                        quant=restored_learner.replay.quant
+                    )
                 )
             learner = restored_learner
             key = restored["key"]
@@ -496,103 +508,121 @@ def off_policy_train_host(
             host_params = np_params
             rng = np.random.default_rng(seed + 0x5EED)
 
-    for it in range(start_it, num_iterations):
-        # Iteration boundary for any armed on-demand profile window
-        # (telemetry/profiler.py): a capture starts/ends here so it
-        # covers whole iterations.
-        telemetry.profiler_tick()
-        # Per-iteration span: the phase spans inside (env_step /
-        # host_to_device / update / eval / log / checkpoint) nest
-        # under it in the trace, giving per-iteration attribution.
-        with telemetry.span("iteration", it=it + 1):
+    # run_report "Resources" replay row: static ring-capacity facts
+    # (capacity, bytes/transition vs fp32, codec mix). Static on purpose
+    # — a live `size` read from the sampler thread would sync the host
+    # on a donated in-flight device scalar.
+    from actor_critic_tpu.replay import quantize as _quantize
+    from actor_critic_tpu.telemetry import sampler as _sampler
 
-            if host_act is not None:
+    _replay_info = dict(
+        _quantize.capacity_report(
+            learner.replay,
+            _quantize.offpolicy_codecs(getattr(cfg, "replay_dtype", "fp32")),
+        ),
+        mode=getattr(cfg, "replay_dtype", "fp32"),
+    )
+    _replay_gauge = _sampler.register_gauge("replay", lambda: _replay_info)
+    try:
+        for it in range(start_it, num_iterations):
+            # Iteration boundary for any armed on-demand profile window
+            # (telemetry/profiler.py): a capture starts/ends here so it
+            # covers whole iterations.
+            telemetry.profiler_tick()
+            # Per-iteration span: the phase spans inside (env_step /
+            # host_to_device / update / eval / log / checkpoint) nest
+            # under it in the trace, giving per-iteration attribution.
+            with telemetry.span("iteration", it=it + 1):
 
-                def explore_act(o):
-                    nonlocal env_steps
-                    action = host_act(host_params, o, rng, env_steps)
-                    env_steps += E
-                    return action, {}
+                if host_act is not None:
 
-            else:
+                    def explore_act(o):
+                        nonlocal env_steps
+                        action = host_act(host_params, o, rng, env_steps)
+                        env_steps += E
+                        return action, {}
 
-                def explore_act(o):
-                    nonlocal key, env_steps
-                    key, akey = jax.random.split(key)
-                    # jaxlint: disable=host-sync (deliberate: without a
-                    # numpy mirror the pool needs concrete host actions
-                    # every step — the documented non-overlap fallback)
-                    action = np.asarray(
-                        act(learner.actor_params, jnp.asarray(o), akey,
-                            jnp.asarray(env_steps, jnp.int32))
-                    )
-                    env_steps += E
-                    return action, {}
-
-            obs, block = host_collect(
-                pool, obs, cfg.steps_per_iter, explore_act, tracker,
-                buffers=buffers,
-            )
-            with telemetry.span("host_to_device"):
-                traj = OffPolicyTransition(
-                    obs=jnp.asarray(block["obs"]),
-                    action=jnp.asarray(block["action"]),
-                    reward=jnp.asarray(block["reward"]),
-                    next_obs=jnp.asarray(block["final_obs"]),
-                    terminated=jnp.asarray(block["terminated"]),
-                    done=jnp.asarray(block["done"]),
-                )
-            if host_act is not None:
-                # Acting params for the NEXT rollout: this update's INPUT
-                # params, fetched BEFORE the dispatch (ingest_update donates
-                # the learner) — concrete already (the previous update
-                # finished during this collection), so the fetch doesn't
-                # wait, and the update dispatched below computes on-device
-                # while the next rollout is collected.
-                host_params = jax.device_get(learner.actor_params)
-            # The jitted call returns at ENQUEUE time (async dispatch);
-            # the span measures host-side cost only — blocking here to
-            # measure device wall would cost the host/device overlap.
-            with telemetry.span("update", dispatch="async"):
-                learner, metrics = ingest_update(
-                    learner, traj, jnp.asarray(env_steps, jnp.int32)
-                )
-            extra = {"env_steps": env_steps}
-            if eval_pool is not None and (it + 1) % eval_every == 0:
-                # NB: a fresh name — `act` is the jitted explore fn that the
-                # non-mirror explore_act closure reads late-bound; rebinding
-                # it here would crash collection after the first eval.
-                if host_greedy is not None:
-                    # Blocks on the in-flight update: eval sees CURRENT params.
-                    ev_params = jax.device_get(learner.actor_params)
-                    eval_act = lambda o: np.asarray(host_greedy(ev_params, o))  # noqa: E731
                 else:
-                    eval_act = lambda o: np.asarray(  # noqa: E731
-                        greedy(learner.actor_params, jnp.asarray(o))
+
+                    def explore_act(o):
+                        nonlocal key, env_steps
+                        key, akey = jax.random.split(key)
+                        # jaxlint: disable=host-sync (deliberate: without a
+                        # numpy mirror the pool needs concrete host actions
+                        # every step — the documented non-overlap fallback)
+                        action = np.asarray(
+                            act(learner.actor_params, jnp.asarray(o), akey,
+                                jnp.asarray(env_steps, jnp.int32))
+                        )
+                        env_steps += E
+                        return action, {}
+
+                obs, block = host_collect(
+                    pool, obs, cfg.steps_per_iter, explore_act, tracker,
+                    buffers=buffers,
+                )
+                with telemetry.span("host_to_device"):
+                    traj = OffPolicyTransition(
+                        obs=jnp.asarray(block["obs"]),
+                        action=jnp.asarray(block["action"]),
+                        reward=jnp.asarray(block["reward"]),
+                        next_obs=jnp.asarray(block["final_obs"]),
+                        terminated=jnp.asarray(block["terminated"]),
+                        done=jnp.asarray(block["done"]),
                     )
-                with telemetry.span("eval"):
-                    extra["eval_return"] = host_evaluate(
-                        eval_pool, eval_act, max_steps=eval_steps
+                if host_act is not None:
+                    # Acting params for the NEXT rollout: this update's INPUT
+                    # params, fetched BEFORE the dispatch (ingest_update donates
+                    # the learner) — concrete already (the previous update
+                    # finished during this collection), so the fetch doesn't
+                    # wait, and the update dispatched below computes on-device
+                    # while the next rollout is collected.
+                    host_params = jax.device_get(learner.actor_params)
+                # The jitted call returns at ENQUEUE time (async dispatch);
+                # the span measures host-side cost only — blocking here to
+                # measure device wall would cost the host/device overlap.
+                with telemetry.span("update", dispatch="async"):
+                    learner, metrics = ingest_update(
+                        learner, traj, jnp.asarray(env_steps, jnp.int32)
                     )
-            maybe_log(
-                it, log_every, metrics, tracker, history, log_fn,
-                extra=extra,
-                num_iterations=num_iterations,
-                # Force-log eval rows AND the first post-resume iteration (a
-                # resumed long run must produce evidence immediately, same
-                # rationale as should_log's it==1 clause).
-                force="eval_return" in extra or it == start_it,
-            )
-            host_maybe_save(
-                ckpt, it + 1, save_every, num_iterations, pool, metrics,
-                save_replay=save_replay,
-                learner=learner, key=key,
-                # jaxlint: disable=host-sync (python int → np scalar for
-                # the checkpoint tree; no device value is touched)
-                env_steps=np.asarray(env_steps, np.int64),
-            )
-    if ckpt is not None:
-        ckpt.wait()  # the final async save must be durable before return
+                extra = {"env_steps": env_steps}
+                if eval_pool is not None and (it + 1) % eval_every == 0:
+                    # NB: a fresh name — `act` is the jitted explore fn that the
+                    # non-mirror explore_act closure reads late-bound; rebinding
+                    # it here would crash collection after the first eval.
+                    if host_greedy is not None:
+                        # Blocks on the in-flight update: eval sees CURRENT params.
+                        ev_params = jax.device_get(learner.actor_params)
+                        eval_act = lambda o: np.asarray(host_greedy(ev_params, o))  # noqa: E731
+                    else:
+                        eval_act = lambda o: np.asarray(  # noqa: E731
+                            greedy(learner.actor_params, jnp.asarray(o))
+                        )
+                    with telemetry.span("eval"):
+                        extra["eval_return"] = host_evaluate(
+                            eval_pool, eval_act, max_steps=eval_steps
+                        )
+                maybe_log(
+                    it, log_every, metrics, tracker, history, log_fn,
+                    extra=extra,
+                    num_iterations=num_iterations,
+                    # Force-log eval rows AND the first post-resume iteration (a
+                    # resumed long run must produce evidence immediately, same
+                    # rationale as should_log's it==1 clause).
+                    force="eval_return" in extra or it == start_it,
+                )
+                host_maybe_save(
+                    ckpt, it + 1, save_every, num_iterations, pool, metrics,
+                    save_replay=save_replay,
+                    learner=learner, key=key,
+                    # jaxlint: disable=host-sync (python int → np scalar for
+                    # the checkpoint tree; no device value is touched)
+                    env_steps=np.asarray(env_steps, np.int64),
+                )
+        if ckpt is not None:
+            ckpt.wait()  # the final async save must be durable before return
+    finally:
+        _sampler.unregister_gauge(_replay_gauge)
     return learner, history
 
 

@@ -62,6 +62,10 @@ class SACConfig:
     fixed_alpha: Optional[float] = None
     target_entropy: Optional[float] = None
     bf16_compute: bool = False
+    # Quantized replay storage (ISSUE 8, replay/quantize.py): "fp32" |
+    # "mixed" (int8-standardized obs/rewards, fp32 actions — the tanh
+    # actor's actions concentrate where int8 is coarsest) | "int8".
+    replay_dtype: str = "fp32"
 
     def __post_init__(self):
         if self.init_alpha <= 0.0:
@@ -143,7 +147,10 @@ def init_learner(
         critic_opt=optax.adam(cfg.critic_lr).init(critic_params),
         log_alpha=log_alpha,
         alpha_opt=optax.adam(cfg.alpha_lr).init(log_alpha),
-        replay=replay.init(example, cfg.buffer_capacity),
+        replay=replay.init(
+            example, cfg.buffer_capacity,
+            replay.offpolicy_codecs(cfg.replay_dtype),
+        ),
         key=lkey,
         update_count=jnp.zeros((), jnp.int32),
     )
@@ -200,6 +207,7 @@ def make_update_loop(
     a branchless `where`-select, as in ddpg.make_update_loop."""
     actor, critic = _modules(action_dim, cfg)
     h_target = _target_entropy(action_dim, cfg)
+    codecs = replay.offpolicy_codecs(cfg.replay_dtype)
 
     def critic_loss_fn(critic_params, target_q, batch: OffPolicyTransition):
         q1, q2 = critic.apply(critic_params, batch.obs, batch.action)
@@ -219,7 +227,9 @@ def make_update_loop(
 
     def one_update(ls: SACLearnerState, do_update: jax.Array):
         key, skey, tkey, akey = jax.random.split(ls.key, 4)
-        batch: OffPolicyTransition = replay.sample(ls.replay, skey, cfg.batch_size)
+        batch: OffPolicyTransition = replay.sample(
+            ls.replay, skey, cfg.batch_size, codecs
+        )
         alpha = jnp.exp(ls.log_alpha)
 
         # --- soft TD target ---
@@ -312,6 +322,7 @@ def make_train_step(
     """The fused collect→insert→update program (one jit dispatch)."""
     explore = make_explore_fn(env.spec.action_dim, cfg)
     update_loop = make_update_loop(env.spec.action_dim, cfg, axis_name)
+    codecs = replay.offpolicy_codecs(cfg.replay_dtype)
 
     def train_step(state: SACState):
         ls = state.learner
@@ -322,7 +333,9 @@ def make_train_step(
             cfg.steps_per_iter, state.env_steps,
         )
         flat = jax.tree.map(lambda x: x.reshape(-1, *x.shape[2:]), traj)
-        rbuf = replay.add_batch(ls.replay, flat)
+        # axis_name keeps the quantizer stats identical across dp (they
+        # are replicated in parallel.dp.replay_specs).
+        rbuf = replay.add_batch(ls.replay, flat, codecs, axis_name=axis_name)
 
         do_update = jnp.logical_and(
             env_steps >= cfg.warmup_steps, rbuf.size >= cfg.batch_size
@@ -379,11 +392,12 @@ def make_host_act_fn(action_dim: int, cfg: SACConfig):
 def make_host_ingest_update(action_dim: int, cfg: SACConfig):
     """Jitted (learner, [K,E] block, env_steps) → (learner, metrics)."""
     update_loop = make_update_loop(action_dim, cfg)
+    codecs = replay.offpolicy_codecs(cfg.replay_dtype)
 
     @partial(jax.jit, donate_argnums=0)
     def ingest_update(ls: SACLearnerState, traj: OffPolicyTransition, env_steps):
         flat = jax.tree.map(lambda x: x.reshape(-1, *x.shape[2:]), traj)
-        rbuf = replay.add_batch(ls.replay, flat)
+        rbuf = replay.add_batch(ls.replay, flat, codecs)
         do_update = jnp.logical_and(
             env_steps >= cfg.warmup_steps, rbuf.size >= cfg.batch_size
         )

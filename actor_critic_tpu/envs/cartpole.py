@@ -1,4 +1,4 @@
-"""Pure-JAX CartPole-v1 with exact gymnasium dynamics.
+"""Pure-JAX CartPole-v1 with exact gymnasium dynamics + scenario fleet.
 
 Replaces the reference's host-stepped `gym.make("CartPole-v1")`
 (BASELINE.json:7; reference mount empty, SURVEY.md §0) with an on-device
@@ -9,6 +9,16 @@ Dynamics, thresholds, reset distribution, reward (+1 every step, incl.
 the terminating one) and the 500-step time limit match gymnasium 1.2.2's
 `CartPoleEnv` (verified numerically in tests/test_envs.py against the
 installed gymnasium).
+
+Scenario fleet (ISSUE 8): `make_cartpole(randomize=0.3)` (or per-param
+ranges, e.g. `masspole=(0.05, 0.5)` / `--env-set masspole=0.05,0.5`)
+draws per-INSTANCE physics — gravity, cart/pole masses, pole length,
+force magnitude — in `reset` from the instance's own PRNG stream, stored
+in `CartPoleState.scenario` so the vmapped fleet carries thousands of
+different dynamics inside one XLA program and `auto_reset` re-draws a
+fresh scenario each episode (envs/jax_env.py scenario docstring). The
+default env draws every param at its gymnasium constant, so the parity
+tests above keep passing bit-for-bit semantics.
 """
 
 from __future__ import annotations
@@ -18,7 +28,9 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from actor_critic_tpu.envs.jax_env import EnvSpec, JaxEnv, StepOutput, auto_reset
+from actor_critic_tpu.envs.jax_env import (
+    EnvSpec, JaxEnv, StepOutput, auto_reset, draw_scenario, scenario_ranges,
+)
 
 GRAVITY = 9.8
 MASSCART = 1.0
@@ -32,6 +44,25 @@ THETA_THRESHOLD = 12 * 2 * jnp.pi / 360
 X_THRESHOLD = 2.4
 MAX_STEPS = 500
 
+SCENARIO_DEFAULTS = {
+    "gravity": GRAVITY,
+    "masscart": MASSCART,
+    "masspole": MASSPOLE,
+    "length": LENGTH,
+    "force_mag": FORCE_MAG,
+}
+
+
+class CartPoleScenario(NamedTuple):
+    """Per-instance physics (f32 scalars; rides the env state so the
+    vmapped fleet is heterogeneous with no protocol change)."""
+
+    gravity: jax.Array
+    masscart: jax.Array
+    masspole: jax.Array
+    length: jax.Array
+    force_mag: jax.Array
+
 
 class CartPoleState(NamedTuple):
     x: jax.Array
@@ -40,31 +71,27 @@ class CartPoleState(NamedTuple):
     theta_dot: jax.Array
     t: jax.Array  # step count for the TimeLimit truncation
     key: jax.Array
+    scenario: CartPoleScenario
 
 
 def _obs(s: CartPoleState) -> jax.Array:
     return jnp.stack([s.x, s.x_dot, s.theta, s.theta_dot]).astype(jnp.float32)
 
 
-def _reset(key: jax.Array) -> tuple[CartPoleState, jax.Array]:
-    key, sub = jax.random.split(key)
-    vals = jax.random.uniform(sub, (4,), jnp.float32, -0.05, 0.05)
-    state = CartPoleState(
-        x=vals[0], x_dot=vals[1], theta=vals[2], theta_dot=vals[3],
-        t=jnp.zeros((), jnp.int32), key=key,
-    )
-    return state, _obs(state)
-
-
 def _raw_step(state: CartPoleState, action: jax.Array):
-    force = jnp.where(action == 1, FORCE_MAG, -FORCE_MAG).astype(jnp.float32)
+    sc = state.scenario
+    total_mass = sc.masscart + sc.masspole
+    polemass_length = sc.masspole * sc.length
+    force = jnp.where(action == 1, sc.force_mag, -sc.force_mag).astype(
+        jnp.float32
+    )
     costheta = jnp.cos(state.theta)
     sintheta = jnp.sin(state.theta)
-    temp = (force + POLEMASS_LENGTH * state.theta_dot**2 * sintheta) / TOTAL_MASS
-    thetaacc = (GRAVITY * sintheta - costheta * temp) / (
-        LENGTH * (4.0 / 3.0 - MASSPOLE * costheta**2 / TOTAL_MASS)
+    temp = (force + polemass_length * state.theta_dot**2 * sintheta) / total_mass
+    thetaacc = (sc.gravity * sintheta - costheta * temp) / (
+        sc.length * (4.0 / 3.0 - sc.masspole * costheta**2 / total_mass)
     )
-    xacc = temp - POLEMASS_LENGTH * thetaacc * costheta / TOTAL_MASS
+    xacc = temp - polemass_length * thetaacc * costheta / total_mass
     # gymnasium's default Euler integrator
     x = state.x + TAU * state.x_dot
     x_dot = state.x_dot + TAU * xacc
@@ -72,7 +99,7 @@ def _raw_step(state: CartPoleState, action: jax.Array):
     theta_dot = state.theta_dot + TAU * thetaacc
     t = state.t + 1
 
-    nstate = CartPoleState(x, x_dot, theta, theta_dot, t, state.key)
+    nstate = CartPoleState(x, x_dot, theta, theta_dot, t, state.key, sc)
     terminated = (
         (jnp.abs(x) > X_THRESHOLD) | (jnp.abs(theta) > THETA_THRESHOLD)
     ).astype(jnp.float32)
@@ -81,7 +108,37 @@ def _raw_step(state: CartPoleState, action: jax.Array):
     return nstate, _obs(nstate), reward, terminated, truncated
 
 
-def make_cartpole() -> JaxEnv:
+def make_cartpole(
+    randomize: float = 0.0,
+    gravity=None,
+    masscart=None,
+    masspole=None,
+    length=None,
+    force_mag=None,
+) -> JaxEnv:
+    """CartPole-v1, optionally as a domain-randomized scenario fleet.
+
+    `randomize=r` draws each physics parameter per instance/episode in
+    [default·(1−r), default·(1+r)]; the per-param kwargs pin ranges
+    explicitly (a (lo, hi) pair, a "lo,hi" string via --env-set, or a
+    bare number to fix the value). Defaults reproduce gymnasium exactly.
+    """
+    ranges = scenario_ranges(
+        SCENARIO_DEFAULTS, randomize,
+        {"gravity": gravity, "masscart": masscart, "masspole": masspole,
+         "length": length, "force_mag": force_mag},
+    )
+
+    def _reset(key: jax.Array) -> tuple[CartPoleState, jax.Array]:
+        key, sub, skey = jax.random.split(key, 3)
+        scenario = CartPoleScenario(**draw_scenario(skey, ranges))
+        vals = jax.random.uniform(sub, (4,), jnp.float32, -0.05, 0.05)
+        state = CartPoleState(
+            x=vals[0], x_dot=vals[1], theta=vals[2], theta_dot=vals[3],
+            t=jnp.zeros((), jnp.int32), key=key, scenario=scenario,
+        )
+        return state, _obs(state)
+
     spec = EnvSpec(
         obs_shape=(4,), action_dim=2, discrete=True, episode_horizon=500
     )

@@ -20,6 +20,18 @@ Conventions:
   vmapped batch rectangular with no host intervention.
 - Everything is float32; shapes static; randomness via explicit keys
   threaded in `state`.
+
+Scenario fleet (ISSUE 8): envs that support domain randomization carry a
+per-instance `ScenarioParams`-style NamedTuple of physics scalars INSIDE
+their state pytree, drawn in `reset` from configurable ranges
+(`scenario_ranges` / `draw_scenario` below). Because the params live in
+the state, the existing `jax.vmap(env.reset)` / `jax.vmap(env.step)`
+fleet path needs no protocol change: thousands of instances with
+different masses/lengths/force scales step inside ONE fused XLA program,
+and `auto_reset`'s end-of-episode reset re-draws a fresh scenario from
+the instance's own PRNG stream — per-episode re-randomization, the
+standard domain-randomization regime. Same key ⇒ same draw (tested in
+tests/test_scenarios.py), so fleets are reproducible.
 """
 
 from __future__ import annotations
@@ -81,6 +93,86 @@ class JaxEnv:
 
     def __eq__(self, other):
         return self is other
+
+
+def scenario_ranges(
+    defaults: dict[str, float],
+    randomize: float = 0.0,
+    overrides: dict[str, Any] | None = None,
+) -> dict[str, tuple[float, float]]:
+    """Resolve per-parameter (lo, hi) draw ranges for a scenario fleet.
+
+    `randomize=r` widens every default d to [d·(1−r), d·(1+r)] — the one
+    knob that makes a whole fleet heterogeneous (`--env-set
+    randomize=0.3`). `overrides` then pins individual params: a (lo, hi)
+    pair / list, a "lo,hi" string (the `--env-set masspole=0.05,0.5`
+    spelling — env-set coerces unrecognized values to str), or a bare
+    number to FIX the param at a non-default value. randomize <= 0 with
+    no overrides returns degenerate [d, d] ranges (the deterministic
+    single-scenario env).
+    """
+    if randomize < 0:
+        raise ValueError(f"randomize must be >= 0, got {randomize}")
+    out = {}
+    for name, d in defaults.items():
+        r = abs(d) * randomize
+        out[name] = (d - r, d + r)
+    for name, val in (overrides or {}).items():
+        if name not in defaults:
+            raise ValueError(
+                f"unknown scenario parameter {name!r}; "
+                f"valid: {sorted(defaults)}"
+            )
+        if val is None:
+            continue
+        if isinstance(val, str):
+            parts = [p for p in val.split(",") if p.strip()]
+            vals = tuple(float(p) for p in parts)
+        elif isinstance(val, (tuple, list)):
+            vals = tuple(float(v) for v in val)
+        else:
+            vals = (float(val),)
+        if len(vals) == 1:
+            out[name] = (vals[0], vals[0])
+        elif len(vals) == 2:
+            out[name] = (min(vals), max(vals))
+        else:
+            raise ValueError(
+                f"scenario range for {name!r} must be a number or "
+                f"lo,hi pair, got {val!r}"
+            )
+    return out
+
+
+def draw_scenario(key: jax.Array, ranges: dict[str, tuple[float, float]]) -> dict[str, jax.Array]:
+    """One uniform draw per parameter from `ranges`, each from its own
+    stream folded on a stable CRC32 of the parameter NAME — not a
+    positional index, so adding or removing a parameter never perturbs
+    the draws of the others. Deterministic in `key`: the scenario-fleet
+    reproducibility contract. Returns {name: f32 scalar}."""
+    import zlib
+
+    out = {}
+    for name in sorted(ranges):
+        lo, hi = ranges[name]
+        if lo == hi:
+            # Degenerate range: emit the exact constant — float blends
+            # like (1−u)·lo + u·hi need not round back to it, and the
+            # gymnasium-parity tests compare against exact constants.
+            out[name] = jnp.asarray(lo, jnp.float32)
+            continue
+        sub = jax.random.fold_in(
+            key, zlib.crc32(name.encode()) & 0x7FFFFFFF
+        )
+        out[name] = jax.random.uniform(
+            sub, (), jnp.float32, minval=lo, maxval=hi
+        )
+    return out
+
+
+def is_randomized(ranges: dict[str, tuple[float, float]]) -> bool:
+    """Whether any parameter's range is non-degenerate (lo < hi)."""
+    return any(lo != hi for lo, hi in ranges.values())
 
 
 def auto_reset(

@@ -1,4 +1,4 @@
-"""Pure-JAX Pendulum-v1 with exact gymnasium dynamics.
+"""Pure-JAX Pendulum-v1 with exact gymnasium dynamics + scenario fleet.
 
 Gives the fused off-policy trainers (DDPG/TD3/SAC — rollout, HBM
 replay, and updates in ONE XLA program, SURVEY.md §3.2) a real physical
@@ -12,10 +12,18 @@ installed gymnasium). The same dynamics also back the C++ engine
 
 Action convention: policies emit normalized actions in [-1, 1]
 (tanh-Gaussian / clipped Gaussian); by default the env affine-maps them
-onto the ±2.0 torque range — the same convention as
+onto the ±max_torque range — the same convention as
 `HostEnvPool(scale_actions=True)` — so SAC's tanh actor has full
 actuator authority. `make_pendulum(scale_actions=False)` takes raw
-torques (clipped to ±2) for gymnasium-parity testing.
+torques (clipped to ±max_torque) for gymnasium-parity testing.
+
+Scenario fleet (ISSUE 8): `make_pendulum(randomize=0.3)` (or per-param
+ranges, e.g. `mass=(0.5, 2.0)` / `--env-set mass=0.5,2.0`) draws
+per-instance gravity/mass/length/torque-scale in `reset`, stored in
+`PendulumState.scenario`, so a vmapped fleet of thousands of different
+pendulums steps — and feeds the quantized replay ring — inside one
+fused XLA program; `auto_reset` re-draws per episode (envs/jax_env.py).
+Defaults reproduce gymnasium exactly.
 """
 
 from __future__ import annotations
@@ -25,7 +33,9 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from actor_critic_tpu.envs.jax_env import EnvSpec, JaxEnv, auto_reset
+from actor_critic_tpu.envs.jax_env import (
+    EnvSpec, JaxEnv, auto_reset, draw_scenario, scenario_ranges,
+)
 
 GRAVITY = 10.0
 MASS = 1.0
@@ -35,12 +45,29 @@ MAX_SPEED = 8.0
 MAX_TORQUE = 2.0
 MAX_STEPS = 200
 
+SCENARIO_DEFAULTS = {
+    "gravity": GRAVITY,
+    "mass": MASS,
+    "length": LENGTH,
+    "max_torque": MAX_TORQUE,
+}
+
+
+class PendulumScenario(NamedTuple):
+    """Per-instance physics + torque scale (f32 scalars in the state)."""
+
+    gravity: jax.Array
+    mass: jax.Array
+    length: jax.Array
+    max_torque: jax.Array
+
 
 class PendulumState(NamedTuple):
     theta: jax.Array
     theta_dot: jax.Array
     t: jax.Array
     key: jax.Array
+    scenario: PendulumScenario
 
 
 def _obs(s: PendulumState) -> jax.Array:
@@ -53,25 +80,40 @@ def _angle_normalize(x: jax.Array) -> jax.Array:
     return ((x + jnp.pi) % (2.0 * jnp.pi)) - jnp.pi
 
 
-def _reset(key: jax.Array) -> tuple[PendulumState, jax.Array]:
-    key, sub = jax.random.split(key)
-    vals = jax.random.uniform(sub, (2,), jnp.float32) * 2.0 - 1.0
-    state = PendulumState(
-        theta=vals[0] * jnp.pi,
-        theta_dot=vals[1],
-        t=jnp.zeros((), jnp.int32),
-        key=key,
+def make_pendulum(
+    scale_actions: bool = True,
+    randomize: float = 0.0,
+    gravity=None,
+    mass=None,
+    length=None,
+    max_torque=None,
+) -> JaxEnv:
+    ranges = scenario_ranges(
+        SCENARIO_DEFAULTS, randomize,
+        {"gravity": gravity, "mass": mass, "length": length,
+         "max_torque": max_torque},
     )
-    return state, _obs(state)
 
+    def _reset(key: jax.Array) -> tuple[PendulumState, jax.Array]:
+        key, sub, skey = jax.random.split(key, 3)
+        scenario = PendulumScenario(**draw_scenario(skey, ranges))
+        vals = jax.random.uniform(sub, (2,), jnp.float32) * 2.0 - 1.0
+        state = PendulumState(
+            theta=vals[0] * jnp.pi,
+            theta_dot=vals[1],
+            t=jnp.zeros((), jnp.int32),
+            key=key,
+            scenario=scenario,
+        )
+        return state, _obs(state)
 
-def make_pendulum(scale_actions: bool = True) -> JaxEnv:
     def _raw_step(state: PendulumState, action: jax.Array):
+        sc = state.scenario
         a = action.reshape(())
         if scale_actions:
-            u = jnp.clip(a, -1.0, 1.0) * MAX_TORQUE
+            u = jnp.clip(a, -1.0, 1.0) * sc.max_torque
         else:
-            u = jnp.clip(a, -MAX_TORQUE, MAX_TORQUE)
+            u = jnp.clip(a, -sc.max_torque, sc.max_torque)
         th, thdot = state.theta, state.theta_dot
         # Reward from the PRE-step state + clipped torque (gymnasium
         # returns -costs computed before integrating).
@@ -79,14 +121,14 @@ def make_pendulum(scale_actions: bool = True) -> JaxEnv:
             _angle_normalize(th) ** 2 + 0.1 * thdot**2 + 0.001 * u**2
         )
         newthdot = thdot + (
-            3.0 * GRAVITY / (2.0 * LENGTH) * jnp.sin(th)
-            + 3.0 / (MASS * LENGTH**2) * u
+            3.0 * sc.gravity / (2.0 * sc.length) * jnp.sin(th)
+            + 3.0 / (sc.mass * sc.length**2) * u
         ) * DT
         newthdot = jnp.clip(newthdot, -MAX_SPEED, MAX_SPEED)
         newth = th + newthdot * DT
         t = state.t + 1
 
-        nstate = PendulumState(newth, newthdot, t, state.key)
+        nstate = PendulumState(newth, newthdot, t, state.key, sc)
         terminated = jnp.zeros((), jnp.float32)  # never terminates
         truncated = (t >= MAX_STEPS).astype(jnp.float32)
         return nstate, _obs(nstate), -costs, terminated, truncated

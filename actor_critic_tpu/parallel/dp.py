@@ -68,10 +68,15 @@ def replay_specs():
     shard and read by its own sampler — no collectives touch the ring.
     The cursor scalars stay replicated: every device inserts the same
     (static) batch size against the same local capacity each step, so
-    their values evolve identically on all devices."""
+    their values evolve identically on all devices. The quantizer's
+    running stats (ReplayState.quant, replay/quantize.py) are replicated
+    too — unlike the cursors their inputs DIFFER per device (each shard
+    sees its own envs), so `replay.add_batch(..., axis_name=dp)`
+    pmean/pmax-syncs the batch moments, the one (tiny, item-shaped)
+    collective the quantized ring adds."""
     from actor_critic_tpu.replay.buffer import ReplayState
 
-    return ReplayState(storage=P(DP_AXIS), insert_pos=P(), size=P())
+    return ReplayState(storage=P(DP_AXIS), insert_pos=P(), size=P(), quant=P())
 
 
 def offpolicy_state_specs():

@@ -1,3 +1,4 @@
+from actor_critic_tpu.replay import quantize
 from actor_critic_tpu.replay.buffer import (
     ReplayState,
     add_batch,
@@ -6,12 +7,16 @@ from actor_critic_tpu.replay.buffer import (
     sample,
     sample_sequences,
 )
+from actor_critic_tpu.replay.quantize import QuantStats, offpolicy_codecs
 
 __all__ = [
+    "QuantStats",
     "ReplayState",
     "add_batch",
     "capacity_of",
     "init",
+    "offpolicy_codecs",
+    "quantize",
     "sample",
     "sample_sequences",
 ]

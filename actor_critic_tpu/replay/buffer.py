@@ -9,39 +9,59 @@ is an on-device gather with on-device PRNG, and neither ever touches the
 host (BASELINE.json:5 "off-policy replay buffer lives in HBM",
 BASELINE.json:9).
 
+Quantized storage (ISSUE 8, HEPPO-GAE arxiv 2501.12703): every function
+takes an optional per-leaf `codecs` spec (`replay/quantize.py`) — a
+static pytree of codec-kind strings matching the transition structure.
+`add_batch` folds the incoming batch into the running standardization
+stats and encodes before the scatter; `sample`/`sample_sequences` decode
+after the gather; with `codecs=None` (or all-`raw`) the ring behaves
+exactly as before. The stats ride `ReplayState.quant` as ordinary
+donated leaves, so they follow the state through donation, sharding and
+checkpointing with no extra machinery.
+
 Donation discipline (SURVEY.md §7.2 item 4): every function here is pure
 and returns a new `ReplayState`; callers close over them inside a jitted
 train step whose state argument is donated (`donate_argnums=0`), so XLA
 updates the multi-GB storage in place instead of copying it each step
-(verified by the buffer-pointer test in tests/test_replay.py).
+(verified by the buffer-pointer test in tests/test_replay.py — including
+through the encode/decode codec wrappers).
 
 Sharding: under data-parallel training each device holds an independent
 shard of the ring (its own envs feed it, its own sampler reads it) — the
-buffer needs no collectives. `parallel.dp.replay_specs()` builds the
+storage needs no collectives. `parallel.dp.replay_specs()` builds the
 PartitionSpec tree (storage's capacity axis split over dp, cursor
-scalars replicated) and `parallel.dp.offpolicy_state_specs()` /
-`sac_state_specs()` embed it in the full trainer-state layout; tested by
-tests/test_parallel.py's off-policy dp cases on the 8-device CPU mesh.
+scalars and quant stats replicated — `add_batch(..., axis_name=...)`
+pmean/pmax-syncs the stats moments so they stay identical per device)
+and `parallel.dp.offpolicy_state_specs()` / `sac_state_specs()` embed it
+in the full trainer-state layout; tested by tests/test_parallel.py's
+off-policy dp cases on the 8-device CPU mesh.
 """
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple
+from typing import Any, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+
+from actor_critic_tpu.replay import quantize
 
 
 class ReplayState(NamedTuple):
     """The ring: storage pytree of [capacity, ...] arrays + write cursor.
 
     `insert_pos` is the next slot to write; `size` counts valid entries
-    (saturates at capacity once the ring has wrapped).
+    (saturates at capacity once the ring has wrapped). `quant` mirrors
+    the storage structure with one `quantize.QuantStats` per leaf —
+    live running mean/scale for `i8`-coded leaves, zero placeholders
+    elsewhere, so the pytree structure is codec-independent (checkpoint
+    templates and warmup eval_shapes never fork on `--replay-dtype`).
     """
 
     storage: Any
     insert_pos: jax.Array  # int32
     size: jax.Array  # int32
+    quant: Any = None
 
 
 def capacity_of(state: ReplayState) -> int:
@@ -49,64 +69,142 @@ def capacity_of(state: ReplayState) -> int:
     return jax.tree.leaves(state.storage)[0].shape[0]
 
 
-def init(example_item: Any, capacity: int) -> ReplayState:
+def _codec_tree(codecs: Optional[Any], example: Any) -> Any:
+    return quantize.default_codecs(example) if codecs is None else codecs
+
+
+def _guard_defaulted_codecs(state: ReplayState) -> None:
+    """Refuse the codecs=None default against a ring that was built
+    quantized: an all-`raw` spec would scatter/gather the int8/f16
+    codes UNCHANGED — training would silently proceed on ~127x-scaled
+    garbage with no dtype error anywhere. A caller that really wants a
+    raw int8/f16 ring passes an explicit all-`raw` spec."""
+    for leaf in jax.tree.leaves(state.storage):
+        if leaf.dtype in (jnp.int8, jnp.float16):
+            raise ValueError(
+                "this replay ring holds quantized storage "
+                f"(a {leaf.dtype} leaf) but no codec spec was passed — "
+                "pass the same `codecs` used at replay.init "
+                "(e.g. replay.offpolicy_codecs(cfg.replay_dtype)) so "
+                "values are encoded/decoded, not read as raw codes"
+            )
+
+
+def init(example_item: Any, capacity: int, codecs: Optional[Any] = None) -> ReplayState:
     """Allocate a zeroed ring shaped after one example item.
 
     `example_item` is a pytree of per-transition arrays (no batch axis);
-    storage leaves get shape [capacity, *item_shape] and the item's dtype.
+    storage leaves get shape [capacity, *item_shape] at the codec's
+    storage dtype (the item's own dtype for `raw`).
     """
+    codecs = _codec_tree(codecs, example_item)
     storage = jax.tree.map(
-        lambda x: jnp.zeros((capacity, *jnp.shape(x)), jnp.asarray(x).dtype),
-        example_item,
+        lambda kind, x: jnp.zeros(
+            (capacity, *jnp.shape(x)),
+            quantize.storage_dtype(kind, jnp.asarray(x).dtype),
+        ),
+        codecs, example_item,
     )
+    quant = jax.tree.map(quantize.init_stats, codecs, example_item)
     return ReplayState(
         storage=storage,
         insert_pos=jnp.zeros((), jnp.int32),
         size=jnp.zeros((), jnp.int32),
+        quant=quant,
     )
 
 
-def add_batch(state: ReplayState, batch: Any) -> ReplayState:
+def add_batch(
+    state: ReplayState,
+    batch: Any,
+    codecs: Optional[Any] = None,
+    axis_name: Optional[str] = None,
+) -> ReplayState:
     """Insert a [B, ...] batch of transitions, wrapping around the ring.
 
-    B is static (leaf shape). Indices are computed mod capacity so a
-    batch can straddle the wrap point; XLA lowers the `.at[idx].set` to an
-    in-place scatter when the state is donated. A batch larger than the
-    ring keeps only its newest `capacity` rows — mod-indices would
-    otherwise scatter duplicates in undefined order.
+    B is static (leaf shape). The batch first updates the running
+    quantization stats (a no-op for stat-free codecs; `axis_name` syncs
+    the moments across dp so replicated stats stay identical), then each
+    leaf is encoded and scattered. Indices are computed mod capacity so
+    a batch can straddle the wrap point; XLA lowers the `.at[idx].set`
+    to an in-place scatter when the state is donated. A batch larger
+    than the ring keeps only its newest `capacity` rows — mod-indices
+    would otherwise scatter duplicates in undefined order.
     """
+    if codecs is None:
+        _guard_defaulted_codecs(state)
+    codecs = _codec_tree(codecs, batch)
     capacity = capacity_of(state)
     b = jax.tree.leaves(batch)[0].shape[0]
     if b > capacity:
         batch = jax.tree.map(lambda x: x[-capacity:], batch)
         b = capacity
+    quant = state.quant
+    if quant is None:  # pre-quantizer state (e.g. a hand-built test tree)
+        quant = jax.tree.map(
+            lambda kind, x: quantize.init_stats(kind, x[0]), codecs, batch
+        )
+    # tree.map with the codec tree FIRST: codecs is a structure-prefix of
+    # quant, so each mapped call receives one leaf's whole QuantStats.
+    quant = jax.tree.map(
+        lambda kind, stats, x: quantize.update_stats(
+            kind, stats, x, axis_name=axis_name
+        ),
+        codecs, quant, batch,
+    )
     idx = (state.insert_pos + jnp.arange(b, dtype=jnp.int32)) % capacity
     storage = jax.tree.map(
-        lambda s, x: s.at[idx].set(x.astype(s.dtype)), state.storage, batch
+        lambda kind, stats, s, x: s.at[idx].set(
+            quantize.encode(kind, stats, x, s.dtype)
+        ),
+        codecs, quant, state.storage, batch,
     )
     return ReplayState(
         storage=storage,
         insert_pos=(state.insert_pos + b) % capacity,
         size=jnp.minimum(state.size + b, capacity),
+        quant=quant,
     )
 
 
-def sample(state: ReplayState, key: jax.Array, batch_size: int) -> Any:
+def _decode_tree(state: ReplayState, codecs: Any, gathered: Any) -> Any:
+    quant = state.quant
+    if quant is None:
+        quant = jax.tree.map(
+            lambda kind, s: quantize.init_stats(kind, s[0]),
+            codecs, state.storage,
+        )
+    return jax.tree.map(quantize.decode, codecs, quant, gathered)
+
+
+def sample(
+    state: ReplayState,
+    key: jax.Array,
+    batch_size: int,
+    codecs: Optional[Any] = None,
+) -> Any:
     """Uniform sample of `batch_size` transitions (with replacement).
 
-    On-device RNG + gather: no host round-trip (SURVEY §3.2). Callers
-    must not sample an empty buffer (standard warmup contract); the
-    maximum(size, 1) guard only keeps the randint bounds legal under
-    tracing.
+    On-device RNG + gather + codec decode: no host round-trip (SURVEY
+    §3.2). Callers must not sample an empty buffer (standard warmup
+    contract); the maximum(size, 1) guard only keeps the randint bounds
+    legal under tracing.
     """
+    if codecs is None:
+        _guard_defaulted_codecs(state)
+    codecs = _codec_tree(codecs, state.storage)
     idx = jax.random.randint(
         key, (batch_size,), 0, jnp.maximum(state.size, 1), dtype=jnp.int32
     )
-    return jax.tree.map(lambda s: s[idx], state.storage)
+    return _decode_tree(state, codecs, jax.tree.map(lambda s: s[idx], state.storage))
 
 
 def sample_sequences(
-    state: ReplayState, key: jax.Array, batch_size: int, seq_len: int
+    state: ReplayState,
+    key: jax.Array,
+    batch_size: int,
+    seq_len: int,
+    codecs: Optional[Any] = None,
 ) -> Any:
     """Sample `batch_size` sequences of `seq_len` consecutive INSERTS.
 
@@ -114,12 +212,16 @@ def sample_sequences(
     valid entry, so a window can wrap around the physical ring but never
     crosses the write-cursor seam (which would splice the newest and
     oldest transitions into a fabricated sequence). Callers ensure
-    size >= seq_len. Returned leaves are [batch_size, seq_len, ...].
-    Sequences may still span episode boundaries; consumers mask on their
-    stored `done` flags — see `algos.ddpg` `DDPGConfig.nstep`, whose
-    n-step TD target is the in-tree consumer (ADVICE: a sequence/R2D2
-    style recurrent consumer would sit on the same call).
+    size >= seq_len. Returned leaves are [batch_size, seq_len, ...]
+    (codec-decoded like `sample`). Sequences may still span episode
+    boundaries; consumers mask on their stored `done` flags — see
+    `algos.ddpg` `DDPGConfig.nstep`, whose n-step TD target is the
+    in-tree consumer (ADVICE: a sequence/R2D2 style recurrent consumer
+    would sit on the same call).
     """
+    if codecs is None:
+        _guard_defaulted_codecs(state)
+    codecs = _codec_tree(codecs, state.storage)
     capacity = capacity_of(state)
     # Oldest valid entry: physical slot 0 until the ring fills, then the
     # slot the cursor is about to overwrite.
@@ -128,4 +230,4 @@ def sample_sequences(
     start = jax.random.randint(key, (batch_size,), 0, max_start, dtype=jnp.int32)
     offsets = jnp.arange(seq_len, dtype=jnp.int32)
     idx = (oldest + start[:, None] + offsets[None, :]) % capacity
-    return jax.tree.map(lambda s: s[idx], state.storage)
+    return _decode_tree(state, codecs, jax.tree.map(lambda s: s[idx], state.storage))

@@ -1,0 +1,280 @@
+"""Quantized replay storage — per-leaf codecs behind dynamic standardization.
+
+fp32 storage caps the HBM ring at a fraction of the transitions the same
+memory could hold. HEPPO-GAE (arxiv 2501.12703) shows int8/fp16 storage
+behind *dynamic standardization* — running mean/scale stats that encode
+values into the quantized range as the data distribution reveals itself —
+multiplies replay capacity with no measurable learning-quality loss, and
+Accelerated Methods for Deep RL (arxiv 1803.02811) shows bigger,
+better-mixed replay buys off-policy throughput and stability directly.
+
+This module is the codec layer `replay/buffer.py` calls on every
+`add_batch` (encode + stats update) and `sample`/`sample_sequences`
+(decode after the gather). Everything is pure and shape-static, so the
+donated in-place scatter/gather discipline — and jaxlint's
+donation-aliasing guarantees — survive unchanged: encode produces the
+quantized `[B, ...]` batch that `.at[idx].set` scatters, decode maps the
+gathered rows back to float32, and the running stats ride `ReplayState`
+(and therefore the checkpoint save tree) as ordinary donated leaves.
+
+Codecs (per storage leaf, selected by a static string):
+
+| kind      | storage      | stats            | decode error bound        |
+|-----------|--------------|------------------|---------------------------|
+| `raw`     | leaf dtype   | —                | exact                     |
+| `f16`     | float16      | —                | ~2^-11 relative           |
+| `i8`      | int8         | mean/scale EMA   | scale/127 per element     |
+| `i8_unit` | int8         | — ([-1,1] fixed) | 1/127                     |
+| `bool8`   | int8         | — ({0,1} exact)  | exact                     |
+
+`i8` standardizes with a cumulative-average mean and a monotone
+running-max scale (never shrinks), so entries encoded earlier decode
+under stats that only *widen* — the drift error HEPPO-GAE's dynamic
+standardization accepts, bounded here by the scale staying a superset of
+every range it ever encoded against. Under data-parallel sharding the
+batch moments are pmean/pmax-synced across the dp axis (`axis_name`
+threaded from the trainer), so the stats stay bit-identical on every
+device and `parallel.dp.replay_specs()` can replicate them.
+
+Mode presets for the off-policy `OffPolicyTransition` ring
+(`train.py --replay-dtype`):
+
+- `fp32`  — everything raw (today's behavior; uint8 pixel obs already
+  pass through untouched).
+- `mixed` — obs/next_obs and reward `i8`-standardized, done/terminated
+  `bool8`, actions kept fp32: a tanh-squashed policy concentrates
+  actions near the bounds where int8 resolution is coarsest and the
+  critic's action-gradient is steepest, so quantizing them is the one
+  unsafe default (the HEPPO-GAE rationale). ~3.1x transitions per HBM
+  byte at Pendulum shape.
+- `int8`  — mixed plus `i8_unit` actions (bounded in [-1, 1] by the
+  acting convention): the aggressive mode, ~4x at Pendulum shape;
+  measured fine on the analytic testbeds, unsafe in general.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+# Leaves whose codec carries running stats.
+STAT_KINDS = ("i8",)
+KINDS = ("raw", "f16", "i8", "i8_unit", "bool8")
+MODES = ("fp32", "mixed", "int8")
+
+_EPS = 1e-6  # scale floor: an all-constant leaf must not divide by zero
+_MEAN_SATURATE = 1 << 30  # count saturation, same rationale as env_steps
+
+# Calibration window (transitions) for `i8` stats, after which mean and
+# scale FREEZE. A ring decodes every entry with the CURRENT stats, so a
+# mean that keeps drifting re-biases every previously-encoded entry by
+# the full drift — measured in-session to cost DDPG point_mass ~2.7
+# return while TD3/SAC merely tolerated it. Freezing after a short
+# calibration phase bounds that drift to the calibration window (whose
+# entries are the low-value random-warmup data) and makes decode exact-
+# per-encode afterwards. The uniform-random warmup policy is the widest-
+# coverage calibration set the run will ever see; later out-of-range
+# values clip to ±scale (the HEPPO-GAE clipping regime).
+CALIBRATION_TRANSITIONS = 4096
+
+
+class QuantStats(NamedTuple):
+    """Running standardization stats for one `i8` leaf (item-shaped, so
+    obs quantize per-feature; scalar leaves carry scalar stats). Every
+    leaf gets a QuantStats slot — non-stat codecs hold zeros-shaped
+    placeholders — so the ReplayState pytree structure is uniform across
+    modes and checkpoint templates never depend on the codec spec."""
+
+    mean: jax.Array
+    scale: jax.Array
+    count: jax.Array  # int32 transitions absorbed (saturating)
+
+
+def offpolicy_codecs(mode: str) -> Any:
+    """The per-leaf codec spec for the DDPG/TD3/SAC transition ring.
+
+    Returns an `OffPolicyTransition` of codec-kind strings (static —
+    closed over by the jitted trainers, never traced).
+    """
+    from actor_critic_tpu.algos.common import OffPolicyTransition
+
+    if mode not in MODES:
+        raise ValueError(f"replay_dtype must be one of {MODES}, got {mode!r}")
+    if mode == "fp32":
+        k = dict(obs="raw", action="raw", reward="raw", next_obs="raw",
+                 terminated="raw", done="raw")
+    else:
+        k = dict(
+            obs="i8", next_obs="i8", reward="i8",
+            terminated="bool8", done="bool8",
+            action="i8_unit" if mode == "int8" else "raw",
+        )
+    return OffPolicyTransition(**k)
+
+
+def default_codecs(example: Any) -> Any:
+    """All-`raw` codec tree matching `example`'s structure (the
+    pass-through spec `buffer.py` uses when callers give none)."""
+    return jax.tree.map(lambda _: "raw", example)
+
+
+def storage_dtype(kind: str, dtype) -> Any:
+    """The ring dtype a codec stores its leaf at."""
+    if kind == "raw":
+        return dtype
+    if kind == "f16":
+        return jnp.float16
+    if kind in ("i8", "i8_unit", "bool8"):
+        return jnp.int8
+    raise ValueError(f"unknown codec kind {kind!r}; valid: {KINDS}")
+
+
+def init_stats(kind: str, example_leaf) -> QuantStats:
+    """Zeroed stats slot for one leaf: item-shaped mean/scale for `i8`,
+    scalar placeholders for everything else. scale seeds at the _EPS
+    floor, NOT 1.0: the running max can only grow, so a 1.0 seed would
+    permanently floor the quantization step at 1/127 and throw away
+    almost all int8 resolution on leaves whose data magnitude sits well
+    below 1 (sampling before the first add_batch is already outside the
+    buffer contract, so no real decode sees the seed value)."""
+    if kind in STAT_KINDS:
+        shape = jnp.shape(example_leaf)
+    else:
+        shape = ()
+    return QuantStats(
+        mean=jnp.zeros(shape, jnp.float32),
+        scale=jnp.full(shape, _EPS, jnp.float32),
+        count=jnp.zeros((), jnp.int32),
+    )
+
+
+def update_stats(
+    kind: str, stats: QuantStats, batch, axis_name=None
+) -> QuantStats:
+    """Fold one `[B, ...]` batch into the running stats (no-op for
+    stat-free codecs).
+
+    mean: cumulative average over transitions (early batches move it
+    fast). scale: monotone running max of |x − mean| with an _EPS floor.
+    Both FREEZE once `CALIBRATION_TRANSITIONS` transitions have been
+    absorbed (branchless where-select): past calibration, every entry
+    decodes through exactly the stats it was encoded with — no drift
+    re-biasing of old ring entries. Under dp the batch moments are
+    pmean/pmax-synced so all devices hold identical stats
+    (replay_specs replicates them).
+    """
+    if kind not in STAT_KINDS:
+        return stats
+    x = batch.astype(jnp.float32)
+    # Reduce over the batch axes (everything leading the item shape).
+    item_ndim = stats.mean.ndim
+    axes = tuple(range(x.ndim - item_ndim))
+    b = 1
+    for a in axes:
+        b *= x.shape[a]
+    batch_mean = jnp.mean(x, axis=axes)
+    if axis_name is not None:
+        batch_mean = jax.lax.pmean(batch_mean, axis_name)
+    w = b / jnp.maximum(stats.count + b, 1).astype(jnp.float32)
+    mean = stats.mean + (batch_mean - stats.mean) * w
+    absmax = jnp.max(jnp.abs(x - mean), axis=axes)
+    if axis_name is not None:
+        absmax = jax.lax.pmax(absmax, axis_name)
+    scale = jnp.maximum(jnp.maximum(stats.scale, absmax), _EPS)
+    calibrating = stats.count < CALIBRATION_TRANSITIONS
+    mean = jnp.where(calibrating, mean, stats.mean)
+    scale = jnp.where(calibrating, scale, stats.scale)
+    count = jnp.minimum(stats.count + b, jnp.int32(_MEAN_SATURATE))
+    return QuantStats(mean=mean, scale=scale, count=count)
+
+
+def encode(kind: str, stats: QuantStats, x, store_dtype) -> jax.Array:
+    """One leaf batch → its stored representation (pure; the caller
+    scatters the result into the donated ring)."""
+    if kind == "raw":
+        return x.astype(store_dtype)
+    if kind == "f16":
+        return x.astype(jnp.float16)
+    if kind == "bool8":
+        return jnp.round(x).astype(jnp.int8)
+    if kind == "i8_unit":
+        q = jnp.clip(x.astype(jnp.float32), -1.0, 1.0) * 127.0
+        return jnp.round(q).astype(jnp.int8)
+    if kind == "i8":
+        z = (x.astype(jnp.float32) - stats.mean) / stats.scale
+        return jnp.round(jnp.clip(z, -1.0, 1.0) * 127.0).astype(jnp.int8)
+    raise ValueError(f"unknown codec kind {kind!r}; valid: {KINDS}")
+
+
+def decode(kind: str, stats: QuantStats, q) -> jax.Array:
+    """Stored representation → float32 (identity for `raw`)."""
+    if kind == "raw":
+        return q
+    if kind == "f16":
+        return q.astype(jnp.float32)
+    if kind == "bool8":
+        return q.astype(jnp.float32)
+    if kind == "i8_unit":
+        return q.astype(jnp.float32) / 127.0
+    if kind == "i8":
+        return q.astype(jnp.float32) * (stats.scale / 127.0) + stats.mean
+    raise ValueError(f"unknown codec kind {kind!r}; valid: {KINDS}")
+
+
+# ---------------------------------------------------------------------------
+# Capacity accounting (run_report Resources row, bench records)
+# ---------------------------------------------------------------------------
+
+def _item_bytes(leaf, dtype) -> int:
+    n = 1
+    for d in leaf.shape[1:]:  # drop the capacity axis
+        n *= d
+    return n * jnp.dtype(dtype).itemsize
+
+
+def capacity_report(state, codecs=None) -> dict:
+    """{capacity, bytes_per_transition, fp32_bytes_per_transition,
+    capacity_multiplier, codec_mix} for one ring — the honest
+    bytes-per-transition numbers behind every capacity claim. The fp32
+    reference prices quantized leaves at 4 bytes/element and leaves
+    `raw` leaves (incl. uint8 pixel obs) at their own dtype, so the
+    multiplier never counts pass-through bytes as savings."""
+    storage = state.storage
+    if codecs is None:
+        codecs = default_codecs(storage)
+    leaves = jax.tree.leaves(storage)
+    kinds = jax.tree.leaves(codecs)
+    names = _leaf_names(codecs)
+    stored = fp32 = 0
+    mix = []
+    for name, kind, leaf in zip(names, kinds, leaves):
+        stored += _item_bytes(leaf, leaf.dtype)
+        ref_dtype = leaf.dtype if kind == "raw" else jnp.float32
+        fp32 += _item_bytes(leaf, ref_dtype)
+        mix.append(f"{name}:{kind}")
+    cap = leaves[0].shape[0]
+    return {
+        "capacity": int(cap),
+        "bytes_per_transition": int(stored),
+        "fp32_bytes_per_transition": int(fp32),
+        "capacity_multiplier": round(fp32 / max(stored, 1), 2),
+        "ring_bytes": int(cap * stored),
+        "codec_mix": ",".join(mix),
+    }
+
+
+def _leaf_names(codecs) -> list[str]:
+    """Dotted key path per codec leaf (for the codec_mix string)."""
+    paths, _ = jax.tree_util.tree_flatten_with_path(codecs)
+    out = []
+    for path, _leaf in paths:
+        out.append(
+            ".".join(
+                str(getattr(p, "name", getattr(p, "key", getattr(p, "idx", p))))
+                for p in path
+            )
+            or "leaf"
+        )
+    return out

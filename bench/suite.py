@@ -482,6 +482,121 @@ def bench_update_wall():
     }
 
 
+def bench_replay_sample_throughput():
+    """On-device replay sampling rate, fp32 vs quantized (ROADMAP "Bench
+    resilience" replay-sample-throughput; ISSUE 8 satellite): a filled
+    Pendulum-shaped ring is sampled at batch 256, many draws scanned
+    inside ONE jitted program (summed to force materialization), fenced
+    with block_until_ready. The headline value is the MIXED-codec
+    samples/s (gather + int8 decode — the path every quantized update
+    pays); fp32 rides along so the decode overhead is visible, and the
+    bytes/transition block carries the capacity-per-HBM-byte evidence."""
+    from actor_critic_tpu import replay
+    from actor_critic_tpu.algos.common import OffPolicyTransition
+    from actor_critic_tpu.replay import quantize
+
+    capacity, batch, draws, reps = 65536, 256, 64, 10
+    rng = np.random.default_rng(0)
+    n = capacity
+    fill = OffPolicyTransition(
+        obs=jnp.asarray(rng.normal(0, 2, (n, 3)), jnp.float32),
+        action=jnp.asarray(np.tanh(rng.normal(size=(n, 1))), jnp.float32),
+        reward=jnp.asarray(rng.normal(-5, 4, (n,)), jnp.float32),
+        next_obs=jnp.asarray(rng.normal(0, 2, (n, 3)), jnp.float32),
+        terminated=jnp.asarray(rng.random(n) < 0.05, jnp.float32),
+        done=jnp.asarray(rng.random(n) < 0.05, jnp.float32),
+    )
+    example = jax.tree.map(lambda x: x[0], fill)
+
+    def measure(mode: str) -> dict:
+        # One jit per MODE (each codec spec is a different program by
+        # construction); built here, outside any loop, per the
+        # recompile-hazard discipline.
+        codecs = quantize.offpolicy_codecs(mode)
+        state = replay.add_batch(
+            replay.init(example, capacity, codecs), fill, codecs
+        )
+
+        @jax.jit
+        def run(state, key):
+            def body(acc, k):
+                s = replay.sample(state, k, batch, codecs)
+                return acc + sum(
+                    jnp.sum(leaf.astype(jnp.float32))
+                    for leaf in jax.tree.leaves(s)
+                ), None
+
+            keys = jax.random.split(key, draws)
+            acc, _ = jax.lax.scan(body, jnp.zeros(()), keys)
+            return acc
+
+        acc = run(state, jax.random.key(0))
+        jax.block_until_ready(acc)
+        t0 = time.perf_counter()
+        for r in range(reps):
+            acc = run(state, jax.random.key(r))
+        jax.block_until_ready(acc)
+        dt = time.perf_counter() - t0
+        return {
+            "samples_per_s": round(reps * draws * batch / dt, 1),
+            **{k: v for k, v in quantize.capacity_report(state, codecs).items()
+               if k != "capacity"},
+        }
+
+    out = {mode: measure(mode) for mode in ("fp32", "mixed")}
+    return {
+        "metric": "replay_sample_throughput",
+        "value": out["mixed"]["samples_per_s"],
+        "unit": f"sampled transitions/s (batch {batch}, mixed codec, "
+                "gather+decode, fenced)",
+        "fp32_samples_per_s": out["fp32"]["samples_per_s"],
+        "decode_overhead_x": round(
+            out["fp32"]["samples_per_s"] / out["mixed"]["samples_per_s"], 2
+        ),
+        "bytes_per_transition": {
+            m: out[m]["bytes_per_transition"] for m in out
+        },
+        "capacity_multiplier_mixed": out["mixed"]["capacity_multiplier"],
+        "config": {"capacity": capacity, "batch": batch, "draws": draws,
+                   "reps": reps, "obs_dim": 3},
+    }
+
+
+def bench_scenario_fleet():
+    """Domain-randomized on-device env fleet (ISSUE 8 acceptance row):
+    >=1k CartPole instances with per-instance randomized physics
+    (randomize=0.3 over gravity/masses/length/force) step inside ONE
+    fused A2C XLA program — rollout + scenario redraws + update, no host
+    in the loop. Reports env-steps/s of the randomized fleet and the
+    uniform fleet on the same shape, so the randomization overhead is
+    visible (scenario params ride the env state; the dynamics math is
+    identical, just per-instance)."""
+    from actor_critic_tpu.algos import a2c
+    from actor_critic_tpu.envs import make_cartpole
+
+    E, T = 2048, 32
+    cfg = a2c.A2CConfig(num_envs=E, rollout_steps=T, hidden=(64,))
+    rates = {}
+    for name, env in (
+        ("randomized", make_cartpole(randomize=0.3)),
+        ("uniform", make_cartpole()),
+    ):
+        rates[name] = _fused_steps_per_sec(
+            a2c, env, cfg, E * T, iters_per_call=10, calls=3
+        )
+    return {
+        "metric": "scenario_fleet_throughput",
+        "value": round(rates["randomized"], 1),
+        "unit": f"env-steps/sec/chip ({E} domain-randomized CartPole "
+                "instances, fused A2C, one XLA program)",
+        "uniform_steps_per_s": round(rates["uniform"], 1),
+        "randomization_overhead_x": round(
+            rates["uniform"] / rates["randomized"], 2
+        ),
+        "config": {"num_envs": E, "rollout_steps": T, "randomize": 0.3},
+    }
+
+
 def bench_mujoco_host():
     """Raw MuJoCo host-stepping rate through HostEnvPool (E=8,
     HalfCheetah-v5) — the 1-core host bound that caps every host-env
@@ -592,6 +707,8 @@ BENCHES = {
     "host_pool_scaling": bench_host_pool_scaling,
     "async_decoupling": bench_async_decoupling,
     "update_wall": bench_update_wall,
+    "replay_sample_throughput": bench_replay_sample_throughput,
+    "scenario_fleet": bench_scenario_fleet,
     "mujoco": bench_mujoco_host,
     "pallas": bench_pallas_ops,
     "startup_to_first_step": bench_startup_to_first_step,

@@ -1,0 +1,197 @@
+#!/usr/bin/env python
+"""Round-over-round bench trend: the multi-metric view of BENCH_r*.json.
+
+The round driver's artifact (`BENCH_rNN.json`, one JSON line per round)
+used to be read headline-only — a dead-tunnel round looked like "0.0"
+even though PR 6 started attaching a CPU-measured `cpu_metrics` block to
+EVERY record. This script is the second half of ROADMAP's "Bench
+resilience" item: it trends the WHOLE block across rounds, so
+regressions in host_pool_scaling / startup_to_first_step /
+async_decoupling / update_wall / replay_sample_throughput are visible
+even across rounds whose TPU headline never ran.
+
+Usage:
+    python scripts/bench_trend.py            # repo-root BENCH_r*.json
+    python scripts/bench_trend.py --root DIR # a fixture/scratch tree
+    python scripts/bench_trend.py --json     # machine-readable rows
+
+Output: one markdown table, rounds as columns — headline first
+(dead-tunnel rounds show `code-dead`, with `last_green` carried when the
+record embeds it), then one row per cpu_metrics entry ever seen (`-`
+before a metric existed, `err` where a round's subprocess failed).
+Tolerant of malformed files: a round that cannot be parsed shows as a
+column of `?` rather than taking the report down.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+
+def round_files(root: str) -> list[tuple[int, str]]:
+    """(round number, path) sorted by round, from BENCH_r*.json names."""
+    out = []
+    for path in glob.glob(os.path.join(root, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", os.path.basename(path))
+        if m:
+            out.append((int(m.group(1)), path))
+    return sorted(out)
+
+
+def load_record(path: str) -> dict | None:
+    """The bench record inside one round file, else None.
+
+    Two shapes exist: the driver's wrapper object ({"n", "cmd", "rc",
+    "tail", "parsed": <record>} — pretty-printed, multi-line; `parsed`
+    holds the bench.py JSON line, with `tail` as the raw fallback) and
+    bench.py's own one-record-per-line output."""
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError:
+        return None
+    try:
+        rec = json.loads(text)
+    except json.JSONDecodeError:
+        rec = None
+    if isinstance(rec, dict):
+        if isinstance(rec.get("parsed"), dict):
+            return rec["parsed"]
+        if "metric" in rec:
+            return rec
+        # Wrapper without a parsed record (e.g. a crashed child): the
+        # tail may still carry bench.py's JSON line.
+        tail = rec.get("tail")
+        if isinstance(tail, str):
+            for ln in reversed(tail.strip().splitlines()):
+                try:
+                    inner = json.loads(ln)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(inner, dict) and "metric" in inner:
+                    return inner
+        return None
+    # Line-oriented fallback (bench.py's direct output).
+    for ln in reversed([l for l in text.splitlines() if l.strip()]):
+        try:
+            inner = json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(inner, dict):
+            return inner
+    return None
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, bool):
+        return str(v)
+    if isinstance(v, (int, float)):
+        if v == 0:
+            return "0"
+        if abs(v) >= 10000:
+            return f"{v:.3g}"
+        return f"{v:g}"
+    return str(v)[:12]
+
+
+def headline_cell(rec: dict | None) -> str:
+    if rec is None:
+        return "?"
+    value = rec.get("value")
+    if rec.get("error") or not value:
+        green = rec.get("last_green") or {}
+        lg = green.get("value")
+        return f"dead (lg {_fmt(lg)})" if lg else "dead"
+    return _fmt(value)
+
+
+def cpu_cell(rec: dict | None, name: str) -> str:
+    if rec is None:
+        return "?"
+    block = rec.get("cpu_metrics")
+    if not isinstance(block, dict):
+        return "-"
+    entry = block.get(name)
+    if entry is None:
+        return "-"
+    if not isinstance(entry, dict):
+        return _fmt(entry)
+    if "error" in entry:
+        return "err"
+    return _fmt(entry.get("value"))
+
+
+def trend_rows(root: str) -> tuple[list[int], list[tuple[str, list[str]]]]:
+    """(round numbers, [(row label, cells per round)]) — the table body.
+
+    The row set is the UNION of cpu_metrics names across all rounds, so
+    a metric added in round N trends as `-` before N instead of
+    silently starting the table late."""
+    files = round_files(root)
+    rounds = [n for n, _ in files]
+    recs = [load_record(p) for _, p in files]
+    names: list[str] = []
+    for rec in recs:
+        if rec and isinstance(rec.get("cpu_metrics"), dict):
+            for k in rec["cpu_metrics"]:
+                if k != "error" and k not in names:
+                    names.append(k)
+    rows = [("tpu_headline", [headline_cell(r) for r in recs])]
+    for name in names:
+        rows.append((name, [cpu_cell(r, name) for r in recs]))
+    return rounds, rows
+
+
+def render(rounds: list[int], rows: list[tuple[str, list[str]]]) -> str:
+    if not rounds:
+        return "(no BENCH_r*.json rounds found)"
+    head = ["metric"] + [f"r{n:02d}" for n in rounds]
+    widths = [
+        max(len(head[i]), *(len(r[1][i - 1]) if i else len(r[0]) for r in rows))
+        for i in range(len(head))
+    ]
+    lines = [
+        " | ".join(h.ljust(w) for h, w in zip(head, widths)),
+        "-|-".join("-" * w for w in widths),
+    ]
+    for label, cells in rows:
+        lines.append(
+            " | ".join(
+                c.ljust(w) for c, w in zip([label, *cells], widths)
+            )
+        )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument(
+        "--root",
+        default=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        help="directory holding BENCH_r*.json (default: repo root)",
+    )
+    p.add_argument(
+        "--json", action="store_true",
+        help="emit {rounds, rows} as JSON instead of the table",
+    )
+    args = p.parse_args(argv)
+    rounds, rows = trend_rows(args.root)
+    if args.json:
+        print(json.dumps({
+            "rounds": rounds,
+            "rows": {label: cells for label, cells in rows},
+        }))
+    else:
+        print(render(rounds, rows))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

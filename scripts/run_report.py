@@ -253,6 +253,24 @@ def resource_summary(rows: list[dict]) -> list[str]:
             f"{last_q.get('drops_stale', 0)} stale; learner idle "
             f"{_fmt_s(float(last_q.get('learner_idle_s', 0.0)))}"
         )
+    # Off-policy replay ring (host_loop's static gauge, ISSUE 8): ring
+    # size, bytes/transition vs the fp32 reference, and the per-leaf
+    # codec mix — the capacity-per-HBM-byte evidence behind
+    # --replay-dtype. Static facts, so the LAST row suffices.
+    rp_rows = [
+        r["replay"] for r in rows if isinstance(r.get("replay"), dict)
+    ]
+    if rp_rows:
+        rp = rp_rows[-1]
+        out.append(
+            f"- **replay ring**: {rp.get('capacity', '?')} slots x "
+            f"{rp.get('bytes_per_transition', '?')} B/transition "
+            f"({_fmt_bytes(rp.get('ring_bytes', 0))} total, mode "
+            f"{rp.get('mode', 'fp32')}); fp32 reference "
+            f"{rp.get('fp32_bytes_per_transition', '?')} B — "
+            f"{rp.get('capacity_multiplier', 1.0)}x transitions/byte; "
+            f"codecs {rp.get('codec_mix', '?')}"
+        )
     # Per-device peaks across the run (devices without allocator stats,
     # e.g. CPU, appear with no byte fields and are reported as such).
     dev_peak: dict[int, dict] = {}

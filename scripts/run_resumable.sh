@@ -37,7 +37,8 @@ takes_value() {
     --telemetry-dir|--telemetry-port|--telemetry-sample-s|--log-every|\
     --chunk|--eval-every|--eval-envs|--eval-steps|--workers|--ckpt-dir|\
     --compile-cache-dir|--save-every|--stall-timeout|--async-actors|\
-    --updates-per-block|--max-staleness|--queue-depth|--async-correction)
+    --updates-per-block|--max-staleness|--queue-depth|--async-correction|\
+    --replay-dtype)
       return 0 ;;
   esac
   return 1

@@ -16,3 +16,12 @@ def double_use(step_fn, state):
     step = jax.jit(step_fn, donate_argnums=0)
     metrics = step(state)  # donates `state` ...
     return metrics, state  # ... then reads it again
+
+
+def quantized_ingest_stale_read(encode, state, batch):
+    """Codec-wrapper near-bug: the ring state is donated into the
+    encoding ingest, then the OLD binding's quantizer stats are read —
+    a buffer XLA already reused."""
+    step = jax.jit(lambda s, b: encode(s, b), donate_argnums=0)
+    new_state = step(state, batch)  # donates `state` ...
+    return new_state, state.quant   # ... then reads the donated tree

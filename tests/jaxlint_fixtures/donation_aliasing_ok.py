@@ -17,3 +17,19 @@ def loop_step(step_fn, state, n):
     for _ in range(n):
         state, metrics = step(state)  # rebound every iteration
     return state, metrics
+
+
+def quantized_ingest(encode, decode, state, batch, key):
+    """The ISSUE 8 codec-wrapper shape: encode-on-add / decode-on-sample
+    closed over by a donating jit, the donated name rebound by the call
+    — the codec layer must not break donation discipline."""
+
+    def ingest(s, b):
+        q = encode(s.quant, b)  # pure: quantize, then in-place scatter
+        storage = jax.tree.map(lambda st, x: st.at[0].set(x), s.storage, q)
+        return s._replace(storage=storage)
+
+    step = jax.jit(ingest, donate_argnums=0)
+    state = step(state, batch)  # rebound by the donating call
+    sampled = decode(state.quant, state.storage)  # reads the NEW binding
+    return state, sampled

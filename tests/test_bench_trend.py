@@ -1,0 +1,95 @@
+"""scripts/bench_trend.py: the round driver's multi-metric trend view
+(ROADMAP "Bench resilience", ISSUE 8 satellite) — wrapper and raw round
+formats parse, the cpu_metrics block trends as rows (union across
+rounds), dead-tunnel headlines show last_green, malformed files degrade
+to `?` columns instead of crashing."""
+
+import importlib.util
+import json
+from pathlib import Path
+
+REPO = Path(__file__).parent.parent
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location(
+        "bench_trend", REPO / "scripts" / "bench_trend.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write_rounds(root: Path):
+    # r01: driver wrapper, dead tunnel, cpu_metrics present, last_green.
+    rec1 = {
+        "metric": "a2c", "value": 0.0, "error": "tunnel dead",
+        "last_green": {"value": 2.6e10},
+        "cpu_metrics": {
+            "host_pool_scaling": {"value": 3.0},
+            "update_wall": {"error": "rc=1: boom"},
+        },
+    }
+    (root / "BENCH_r01.json").write_text(
+        json.dumps({"n": 1, "rc": 1, "parsed": rec1}, indent=2)
+    )
+    # r02: raw bench.py line format, green, adds a NEW metric.
+    rec2 = {
+        "metric": "a2c", "value": 123456.0,
+        "cpu_metrics": {
+            "host_pool_scaling": {"value": 2.9},
+            "update_wall": {"value": 10.4},
+            "replay_sample_throughput": {"value": 2.07e6},
+        },
+    }
+    (root / "BENCH_r02.json").write_text(json.dumps(rec2) + "\n")
+    # r03: malformed.
+    (root / "BENCH_r03.json").write_text("{not json")
+
+
+def test_trend_rows_union_and_cells(tmp_path):
+    mod = _load()
+    _write_rounds(tmp_path)
+    rounds, rows = mod.trend_rows(str(tmp_path))
+    assert rounds == [1, 2, 3]
+    table = dict(rows)
+    # Headline: dead w/ last_green, green value, unparseable.
+    assert table["tpu_headline"][0].startswith("dead (lg")
+    assert table["tpu_headline"][1] != "dead"
+    assert table["tpu_headline"][2] == "?"
+    # Union of metric names across rounds; '-' before a metric existed,
+    # 'err' where a round's subprocess failed.
+    assert table["host_pool_scaling"] == ["3", "2.9", "?"]
+    assert table["update_wall"][0] == "err"
+    assert table["replay_sample_throughput"][0] == "-"
+    assert table["replay_sample_throughput"][1] != "-"
+
+
+def test_render_and_cli(tmp_path, capsys):
+    mod = _load()
+    _write_rounds(tmp_path)
+    assert mod.main(["--root", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "r01" in out and "r03" in out
+    assert "replay_sample_throughput" in out
+    assert mod.main(["--root", str(tmp_path), "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["rounds"] == [1, 2, 3]
+    assert "host_pool_scaling" in payload["rows"]
+
+
+def test_empty_root(tmp_path, capsys):
+    mod = _load()
+    assert mod.main(["--root", str(tmp_path)]) == 0
+    assert "no BENCH_r" in capsys.readouterr().out
+
+
+def test_parses_committed_rounds():
+    """The real repo-root BENCH_r*.json history must parse (wrapper
+    format with parsed/tail): at least one round resolves to a real
+    record rather than '?'."""
+    mod = _load()
+    rounds, rows = mod.trend_rows(str(REPO))
+    assert rounds, "no committed rounds found"
+    headline = dict(rows)["tpu_headline"]
+    assert any(c != "?" for c in headline), headline

@@ -249,6 +249,78 @@ def test_host_ppo_steady_state_zero_recompiles(tmp_path):
     assert counts[4] == counts[2], records
 
 
+def test_quantized_ingest_warmup_steady_state_zero_recompiles(tmp_path):
+    """ISSUE 8: the QUANTIZED off-policy ingest+update path keeps the
+    compile-once contract — the registered `ddpg.make_host_ingest_update`
+    planner derives the abstract learner tree WITH QuantStats leaves
+    (replay_dtype rides the config), warmup's one true compile makes the
+    live loop's first dispatch a persistent-cache hit, and repeat
+    dispatches compile nothing."""
+    _require_introspection()
+    import jax.numpy as jnp
+
+    from actor_critic_tpu.algos import ddpg
+    from actor_critic_tpu.algos.common import OffPolicyTransition
+    from actor_critic_tpu.envs.jax_env import EnvSpec
+
+    cfg = ddpg.DDPGConfig(
+        num_envs=2, steps_per_iter=4, updates_per_iter=1,
+        buffer_capacity=256, batch_size=8, warmup_steps=0, hidden=(16,),
+        replay_dtype="mixed",
+    )
+    spec = EnvSpec(obs_shape=(3,), action_dim=1, discrete=False)
+    with compile_cache.temporary_cache(tmp_path / "cc"):
+        ctx = compile_cache.WarmupContext(
+            algo="ddpg", fused=False, spec=spec, cfg=cfg,
+            eval_every=0, overlap=False,
+        )
+        plan = compile_cache.plan_warmup(ctx)
+        ingest_entries = [
+            n for n, _ in plan if n == "ddpg.make_host_ingest_update"
+        ]
+        assert ingest_entries, [n for n, _ in plan]
+        n0 = len(profiler.compile_records())
+        runner = compile_cache.WarmupRunner(
+            [e for e in plan if e[0] == "ddpg.make_host_ingest_update"]
+        ).start()
+        assert runner.wait(300) and "error" not in runner.results[0], (
+            runner.results
+        )
+
+        # The live loop's own jit objects (fresh trace, same HLO).
+        ingest = ddpg.make_host_ingest_update(1, cfg)
+        learner = ddpg.init_learner((3,), 1, cfg, jax.random.key(0))
+        assert learner.replay.storage.obs.dtype == jnp.int8
+        K, E = cfg.steps_per_iter, cfg.num_envs
+
+        def block(seed):
+            r = np.random.default_rng(seed)
+            return OffPolicyTransition(
+                obs=jnp.asarray(r.normal(size=(K, E, 3)), jnp.float32),
+                action=jnp.asarray(r.uniform(-1, 1, (K, E, 1)), jnp.float32),
+                reward=jnp.asarray(r.normal(size=(K, E)), jnp.float32),
+                next_obs=jnp.asarray(r.normal(size=(K, E, 3)), jnp.float32),
+                terminated=jnp.zeros((K, E), jnp.float32),
+                done=jnp.zeros((K, E), jnp.float32),
+            )
+
+        counts = []
+        for it in range(4):
+            learner, _ = ingest(
+                learner, block(it), jnp.asarray(64, jnp.int32)
+            )
+            jax.block_until_ready(learner.replay.quant)
+            counts.append(profiler.compile_event_count())
+
+    records = _new_records(n0)
+    evs = [r for r in records if r["name"] == "jit_ingest_update"]
+    real = [r for r in evs if not r.get("cache_hit")]
+    assert len(real) == 1, evs          # warmup's one true compile
+    assert any(r.get("cache_hit") for r in evs), evs  # live loop hit it
+    # Steady state: iterations past the first compile NOTHING.
+    assert counts[-1] == counts[1], records
+
+
 def test_restore_normalizes_for_compile_cache(tmp_path):
     """A restored state must (a) carry UNCOMMITTED, XLA-owned leaves —
     orbax's committed arrays lower byte-different HLO (per-arg

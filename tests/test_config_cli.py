@@ -155,6 +155,41 @@ def test_cli_end_to_end(tmp_path):
     assert "resumed from iteration 6" in r2.stdout
 
 
+def test_cli_replay_dtype_flag(tmp_path):
+    """--replay-dtype threads into the off-policy config (fused DDPG
+    run completes with a quantized ring) and refuses algos without
+    replay storage."""
+    import os
+
+    env = {"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu",
+           "PATH": "/usr/bin:/bin"}
+    env.update({k: v for k, v in os.environ.items() if k not in env})
+    cmd = [
+        sys.executable, "train.py",
+        "--algo", "ddpg", "--env", "jax:point_mass",
+        "--iterations", "2", "--log-every", "1", "--quiet",
+        "--set", "num_envs=4", "--set", "steps_per_iter=2",
+        "--set", "updates_per_iter=1", "--set", "buffer_capacity=64",
+        "--set", "batch_size=4", "--set", "warmup_steps=0",
+        "--set", "hidden=16",
+        "--replay-dtype", "mixed",
+        "--metrics", str(tmp_path / "m.jsonl"),
+    ]
+    r = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                       cwd="/root/repo")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "replay_dtype': 'mixed'" in r.stdout  # config echo line
+
+    bad = subprocess.run(
+        [sys.executable, "train.py", "--algo", "a2c",
+         "--env", "jax:two_state", "--iterations", "1",
+         "--replay-dtype", "mixed"],
+        capture_output=True, text=True, env=env, cwd="/root/repo",
+    )
+    assert bad.returncode != 0
+    assert "no replay storage" in bad.stderr
+
+
 @pytest.mark.slow
 def test_cli_chunked_dispatch(tmp_path):
     """--chunk N scans N iterations per dispatch: same training

@@ -212,6 +212,64 @@ def replay_capacity(learner):
     return jax.tree.leaves(learner.replay.storage)[0].shape[0]
 
 
+def test_replay_free_checkpoint_keeps_quantizer_stats(tmp_path):
+    """ISSUE 8: with quantized replay, save_replay=False still saves the
+    running mean/scale stats (strip_replay truncates STORAGE only), and
+    resume reattaches the fresh full-capacity ring while keeping the
+    restored stats — fresh transitions must encode against the
+    standardization the restored critic trained under, and a re-zeroed
+    scale would decode early post-resume batches through a different
+    affine map."""
+    import dataclasses
+
+    cfg = dataclasses.replace(_tiny_ddpg_cfg(), replay_dtype="mixed")
+
+    pool = HostEnvPool(
+        "Pendulum-v1", num_envs=2, seed=0,
+        normalize_obs=False, normalize_reward=False,
+    )
+    with Checkpointer(tmp_path / "qslim") as ck:
+        learner1, _ = ddpg.train_host(
+            pool, cfg, num_iterations=3, seed=0, log_every=0,
+            ckpt=ck, save_every=3, save_replay=False,
+        )
+        ck.wait()
+    pool.close()
+    # The run really quantized (int8 ring) and really learned stats.
+    assert jax.tree.leaves(learner1.replay.storage)[0].dtype == np.int8
+    assert int(learner1.replay.quant.obs.count) > 0
+
+    # The SAVED tree: one-slot storage stub, stats intact.
+    from actor_critic_tpu.algos.host_loop import host_ckpt_state
+
+    pool = HostEnvPool(
+        "Pendulum-v1", num_envs=2, seed=0,
+        normalize_obs=False, normalize_reward=False,
+    )
+    saved_tree = host_ckpt_state(pool, save_replay=False, learner=learner1)
+    stub = saved_tree["learner"].replay
+    assert all(leaf.shape[0] == 1 for leaf in jax.tree.leaves(stub.storage))
+    _trees_equal(stub.quant, learner1.replay.quant)
+    pool.close()
+
+    # Resume: empty full-capacity ring, EXACT stats back.
+    pool = HostEnvPool(
+        "Pendulum-v1", num_envs=2, seed=0,
+        normalize_obs=False, normalize_reward=False,
+    )
+    with Checkpointer(tmp_path / "qslim") as ck:
+        with pytest.warns(UserWarning, match="replay-free"):
+            learner2, history = ddpg.train_host(
+                pool, cfg, num_iterations=3, seed=0, log_every=0,
+                ckpt=ck, resume=True, save_replay=False,
+            )
+    pool.close()
+    assert history == []
+    assert int(learner2.replay.size) == 0
+    assert replay_capacity(learner2) == cfg.buffer_capacity
+    _trees_equal(learner2.replay.quant, learner1.replay.quant)
+
+
 @pytest.mark.parametrize("trained_normalized", [True, False],
                          ids=["norm-ckpt-raw-pool", "raw-ckpt-norm-pool"])
 def test_resume_warns_on_normalization_mismatch(tmp_path, trained_normalized):

@@ -200,6 +200,61 @@ def test_dp_offpolicy_train_step_runs_shards_replay():
     assert int(state.learner.update_count) == 4
 
 
+def test_dp_offpolicy_quantized_replay_shards_and_syncs_stats():
+    """ISSUE 8: the QUANTIZED ring under dp on the 8-device CPU mesh —
+    int8 storage sharded over devices like the fp32 ring, quantizer
+    running stats replicated AND bit-identical across devices (add_batch
+    pmean/pmax-syncs the batch moments over the dp axis), train step
+    runs with finite losses."""
+    from jax.sharding import PartitionSpec as P
+
+    from actor_critic_tpu.algos import ddpg
+    from actor_critic_tpu.envs import make_point_mass
+    from actor_critic_tpu.parallel import offpolicy_state_specs
+
+    env = make_point_mass()
+    cfg = ddpg.td3_config(
+        num_envs=16, steps_per_iter=4, updates_per_iter=2,
+        buffer_capacity=512, batch_size=8, warmup_steps=0, hidden=(16,),
+        replay_dtype="mixed",
+    )
+    mesh = _mesh()
+    state = ddpg.init_state(env, cfg, jax.random.key(0))
+    state = distribute_state(state, mesh, offpolicy_state_specs())
+
+    obs_leaf = state.learner.replay.storage.obs
+    assert obs_leaf.dtype == jnp.int8  # quantized storage, dp-sharded
+    assert obs_leaf.sharding.spec == P(DP_AXIS)
+    assert obs_leaf.addressable_shards[0].data.shape[0] == 512 // 8
+
+    step = make_dp_train_step(
+        ddpg.make_train_step(env, cfg, axis_name=DP_AXIS),
+        mesh,
+        offpolicy_state_specs(),
+    )
+    state, metrics = step(state)
+    jax.block_until_ready(state)
+    state, metrics = step(state)
+    jax.block_until_ready(state)
+
+    # Quantizer stats: live (count > 0, scale grew) and IDENTICAL on
+    # every device — each device folds different env transitions, so
+    # only the cross-device moment sync keeps the replicated spec true.
+    stats = state.learner.replay.quant.obs
+    assert int(stats.count) > 0
+    for leaf in (stats.mean, stats.scale):
+        shards = [np.asarray(s.data) for s in leaf.addressable_shards]
+        for s in shards[1:]:
+            np.testing.assert_array_equal(shards[0], s)
+    # The sub-rings themselves still differ (per-device env shards).
+    shard0, shard1 = (
+        np.asarray(s.data)
+        for s in state.learner.replay.storage.obs.addressable_shards[:2]
+    )
+    assert not np.array_equal(shard0, shard1)
+    assert np.isfinite(float(metrics["critic_loss"]))
+
+
 def test_dp_sac_train_step_runs_and_replicates():
     """SAC fused trainer under dp: same layout as DDPG plus replicated
     log-α; two steps run with finite losses and replicated params."""

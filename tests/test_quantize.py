@@ -1,0 +1,491 @@
+"""Quantized replay tests (ISSUE 8): per-codec round-trip error bounds,
+capacity accounting (the ≥3x mixed-mode acceptance number), ring-level
+encode/decode through wraparound and donation, quantizer stats riding
+the checkpoint save tree (fused restore-then-continue bitwise), and
+DDPG/TD3/SAC eval-return parity fp32 vs mixed at the same seed."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from actor_critic_tpu import replay
+from actor_critic_tpu.algos.common import OffPolicyTransition
+from actor_critic_tpu.replay import quantize
+
+
+def _transition_example(obs_dim=3, act_dim=1):
+    return OffPolicyTransition(
+        obs=jnp.zeros((obs_dim,), jnp.float32),
+        action=jnp.zeros((act_dim,), jnp.float32),
+        reward=jnp.zeros((), jnp.float32),
+        next_obs=jnp.zeros((obs_dim,), jnp.float32),
+        terminated=jnp.zeros((), jnp.float32),
+        done=jnp.zeros((), jnp.float32),
+    )
+
+
+def _transition_batch(n, obs_dim=3, act_dim=1, seed=0):
+    rng = np.random.default_rng(seed)
+    return OffPolicyTransition(
+        obs=jnp.asarray(rng.normal(1.5, 2.0, (n, obs_dim)), jnp.float32),
+        action=jnp.asarray(
+            np.tanh(rng.normal(size=(n, act_dim))), jnp.float32
+        ),
+        reward=jnp.asarray(rng.normal(-2.0, 3.0, (n,)), jnp.float32),
+        next_obs=jnp.asarray(rng.normal(1.5, 2.0, (n, obs_dim)), jnp.float32),
+        terminated=jnp.asarray(rng.random(n) < 0.1, jnp.float32),
+        done=jnp.asarray(rng.random(n) < 0.15, jnp.float32),
+    )
+
+
+class TestCodecRoundTrip:
+    """decode(encode(x)) error bounds per codec vs fp32 ground truth."""
+
+    def _roundtrip(self, kind, x, stats=None):
+        if stats is None:
+            stats = quantize.init_stats(kind, x[0])
+            stats = quantize.update_stats(kind, stats, x)
+        q = quantize.encode(kind, stats, x, quantize.storage_dtype(kind, x.dtype))
+        return np.asarray(quantize.decode(kind, stats, q)), stats
+
+    def test_raw_exact(self):
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(64, 3)), jnp.float32)
+        out, _ = self._roundtrip("raw", x)
+        np.testing.assert_array_equal(out, np.asarray(x))
+
+    def test_bool8_exact(self):
+        x = jnp.asarray(np.random.default_rng(1).random((256,)) < 0.5, jnp.float32)
+        out, _ = self._roundtrip("bool8", x)
+        np.testing.assert_array_equal(out, np.asarray(x))
+
+    def test_f16_relative_bound(self):
+        x = jnp.asarray(
+            np.random.default_rng(2).normal(0, 10, (512,)), jnp.float32
+        )
+        out, _ = self._roundtrip("f16", x)
+        np.testing.assert_allclose(out, np.asarray(x), rtol=2**-10)
+
+    def test_i8_unit_bound(self):
+        x = jnp.asarray(
+            np.random.default_rng(3).uniform(-1, 1, (512,)), jnp.float32
+        )
+        out, _ = self._roundtrip("i8_unit", x)
+        assert np.abs(out - np.asarray(x)).max() <= 1.0 / 127.0
+        # And the bound is exactly the quantization step: the codec must
+        # not silently rescale inside [-1, 1].
+        assert np.abs(out).max() <= 1.0
+
+    def test_i8_standardized_bound(self):
+        """Error <= scale/127 per element for in-range data, with
+        per-FEATURE stats (each column standardized by its own range)."""
+        rng = np.random.default_rng(4)
+        x = jnp.asarray(
+            np.stack(
+                [rng.normal(100.0, 1.0, 1024), rng.normal(-3.0, 30.0, 1024)],
+                axis=-1,
+            ),
+            jnp.float32,
+        )
+        out, stats = self._roundtrip("i8", x)
+        step = np.asarray(stats.scale) / 127.0  # per-feature
+        err = np.abs(out - np.asarray(x))
+        assert (err <= step + 1e-5).all(), (err.max(0), step)
+        # Feature 0 (tight range around 100) must quantize ~30x finer
+        # than feature 1 (wide range) — the point of per-feature stats.
+        assert step[0] < step[1] / 10.0
+
+    def test_i8_out_of_range_clips(self):
+        x = jnp.asarray([0.0, 1.0, -1.0, 50.0], jnp.float32)
+        stats = quantize.QuantStats(
+            mean=jnp.zeros(()), scale=jnp.ones(()), count=jnp.ones((), jnp.int32)
+        )
+        out, _ = self._roundtrip("i8", x, stats)
+        np.testing.assert_allclose(out[-1], 1.0, atol=1e-6)  # clipped to scale
+
+    def test_unknown_kind_raises(self):
+        with pytest.raises(ValueError, match="unknown codec"):
+            quantize.storage_dtype("f8", jnp.float32)
+        with pytest.raises(ValueError, match="replay_dtype"):
+            quantize.offpolicy_codecs("bf16")
+
+
+class TestStats:
+    def test_scale_monotone_mean_tracks(self):
+        """scale never shrinks (old entries always decode in-range);
+        mean converges to the data mean via cumulative averaging."""
+        stats = quantize.init_stats("i8", jnp.zeros(()))
+        rng = np.random.default_rng(5)
+        scales = []
+        for i in range(20):
+            batch = jnp.asarray(rng.normal(7.0, 2.0, (256,)), jnp.float32)
+            stats = quantize.update_stats("i8", stats, batch)
+            scales.append(float(stats.scale))
+        assert all(b >= a for a, b in zip(scales, scales[1:]))
+        assert abs(float(stats.mean) - 7.0) < 0.2
+        assert int(stats.count) == 20 * 256
+
+    def test_stats_freeze_after_calibration(self):
+        """Past CALIBRATION_TRANSITIONS the stats must STOP moving, even
+        under a shifted data distribution — the drift-free-decode
+        guarantee for post-calibration ring entries (a drifting mean
+        re-biases every old entry by the full drift; measured to cost
+        DDPG ~2.7 return on point_mass before the freeze)."""
+        stats = quantize.init_stats("i8", jnp.zeros(()))
+        rng = np.random.default_rng(6)
+        b = quantize.CALIBRATION_TRANSITIONS  # one batch = whole window
+        stats = quantize.update_stats(
+            "i8", stats, jnp.asarray(rng.normal(0.0, 1.0, (b,)), jnp.float32)
+        )
+        frozen_mean, frozen_scale = float(stats.mean), float(stats.scale)
+        stats = quantize.update_stats(
+            "i8", stats, jnp.asarray(rng.normal(50.0, 9.0, (b,)), jnp.float32)
+        )
+        assert float(stats.mean) == frozen_mean
+        assert float(stats.scale) == frozen_scale
+        assert int(stats.count) == 2 * b  # count still tallies
+
+    def test_stat_free_codecs_untouched(self):
+        stats = quantize.init_stats("f16", jnp.zeros((3,)))
+        out = quantize.update_stats(
+            "f16", stats, jnp.ones((8, 3), jnp.float32)
+        )
+        assert out is stats  # literally a no-op
+
+
+class TestCapacityAccounting:
+    def test_mixed_mode_hits_3x(self):
+        """ISSUE 8 acceptance: mixed-precision replay stores >=3x
+        transitions per HBM byte vs fp32 at the Pendulum transition
+        shape (obs 3, action 1)."""
+        codecs = quantize.offpolicy_codecs("mixed")
+        state = replay.init(_transition_example(), 128, codecs)
+        rep = quantize.capacity_report(state, codecs)
+        assert rep["fp32_bytes_per_transition"] == 40
+        assert rep["bytes_per_transition"] == 13
+        assert rep["capacity_multiplier"] >= 3.0
+        assert "action:raw" in rep["codec_mix"]  # actions stay fp32
+
+    def test_int8_mode_hits_4x(self):
+        codecs = quantize.offpolicy_codecs("int8")
+        state = replay.init(_transition_example(), 128, codecs)
+        rep = quantize.capacity_report(state, codecs)
+        assert rep["capacity_multiplier"] >= 4.0
+
+    def test_fp32_mode_is_identity(self):
+        codecs = quantize.offpolicy_codecs("fp32")
+        state = replay.init(_transition_example(), 128, codecs)
+        rep = quantize.capacity_report(state, codecs)
+        assert rep["capacity_multiplier"] == 1.0
+        assert state.storage.obs.dtype == jnp.float32
+
+
+class TestQuantizedRing:
+    def test_add_sample_roundtrip_within_bounds(self):
+        codecs = quantize.offpolicy_codecs("mixed")
+        state = replay.init(_transition_example(), 256, codecs)
+        batch = _transition_batch(128)
+        state = replay.add_batch(state, batch, codecs)
+        assert state.storage.obs.dtype == jnp.int8
+        assert state.storage.done.dtype == jnp.int8
+        out = replay.sample(state, jax.random.key(0), 512, codecs)
+        # Decoded samples stay float32 and inside the encoded range.
+        assert out.obs.dtype == jnp.float32
+        step = np.asarray(state.quant.obs.scale) / 127.0
+        src = np.asarray(batch.obs)
+        lo = src.min(0) - step - 1e-5
+        hi = src.max(0) + step + 1e-5
+        o = np.asarray(out.obs)
+        assert (o >= lo).all() and (o <= hi).all()
+        # Flags decode exactly.
+        assert set(np.unique(np.asarray(out.done))) <= {0.0, 1.0}
+        # Actions pass through untouched in mixed mode.
+        assert state.storage.action.dtype == jnp.float32
+
+    def test_wraparound_preserves_newest(self):
+        """The quantized ring keeps the same wrap semantics as fp32:
+        reward values survive (within codec error) across the seam."""
+        codecs = quantize.offpolicy_codecs("mixed")
+        state = replay.init(_transition_example(), 8, codecs)
+        for start in range(0, 16, 4):
+            vals = np.arange(start, start + 4, dtype=np.float32)
+            b = _transition_batch(4, seed=start)._replace(
+                reward=jnp.asarray(vals)
+            )
+            state = replay.add_batch(state, b, codecs)
+        assert int(state.size) == 8
+        dec = np.asarray(
+            replay.sample(state, jax.random.key(1), 256, codecs).reward
+        )
+        step = float(state.quant.reward.scale) / 127.0
+        # Only the newest 8 rewards (8..15) are sampleable.
+        assert dec.min() >= 8.0 - step - 1e-5
+        assert dec.max() <= 15.0 + step + 1e-5
+
+    def test_sample_sequences_decodes(self):
+        codecs = quantize.offpolicy_codecs("mixed")
+        state = replay.init(_transition_example(), 64, codecs)
+        vals = np.arange(40, dtype=np.float32)
+        b = _transition_batch(40)._replace(reward=jnp.asarray(vals))
+        state = replay.add_batch(state, b, codecs)
+        out = replay.sample_sequences(state, jax.random.key(2), 16, 5, codecs)
+        r = np.asarray(out.reward)
+        assert r.shape == (16, 5) and r.dtype == np.float32
+        # Consecutive inserts stay consecutive after decode (within the
+        # reward codec's step).
+        step = float(state.quant.reward.scale) / 127.0
+        assert np.abs(np.diff(r, axis=1) - 1.0).max() <= 2 * step + 1e-5
+
+    def test_defaulted_codecs_on_quantized_ring_refused(self):
+        """sample/add_batch without a codec spec against a quantized
+        ring must raise, not silently hand back raw int8 codes (a
+        ~127x-scaled garbage batch with no dtype error anywhere)."""
+        codecs = quantize.offpolicy_codecs("mixed")
+        state = replay.init(_transition_example(), 64, codecs)
+        state = replay.add_batch(state, _transition_batch(8), codecs)
+        with pytest.raises(ValueError, match="quantized storage"):
+            replay.sample(state, jax.random.key(0), 4)
+        with pytest.raises(ValueError, match="quantized storage"):
+            replay.sample_sequences(state, jax.random.key(0), 4, 2)
+        with pytest.raises(ValueError, match="quantized storage"):
+            replay.add_batch(state, _transition_batch(8))
+        # Explicit codecs keep working, and fp32 rings keep the old
+        # no-codecs call shape.
+        replay.sample(state, jax.random.key(0), 4, codecs)
+        fp32 = replay.init(_transition_example(), 64)
+        fp32 = replay.add_batch(fp32, _transition_batch(8))
+        replay.sample(fp32, jax.random.key(0), 4)
+
+    def test_small_magnitude_leaf_keeps_resolution(self):
+        """The scale seed must not floor the quantization step: a leaf
+        whose values live at ~0.05 magnitude must round-trip with error
+        bounded by ITS OWN range, not by a fixed 1/127 step."""
+        rng = np.random.default_rng(7)
+        x = jnp.asarray(rng.uniform(-0.05, 0.05, (2048,)), jnp.float32)
+        stats = quantize.update_stats(
+            "i8", quantize.init_stats("i8", x[0]), x
+        )
+        q = quantize.encode("i8", stats, x, jnp.int8)
+        out = np.asarray(quantize.decode("i8", stats, q))
+        assert float(stats.scale) < 0.2
+        assert np.abs(out - np.asarray(x)).max() <= float(stats.scale) / 127
+
+    def test_inplace_update_under_donation(self):
+        """The donated jitted add must reuse the int8 storage buffer —
+        the codec wrappers must not break the in-place scatter."""
+        codecs = quantize.offpolicy_codecs("mixed")
+        state = replay.init(_transition_example(), 1024, codecs)
+        add = jax.jit(
+            lambda s, b: replay.add_batch(s, b, codecs), donate_argnums=0
+        )
+        state = add(state, _transition_batch(4, seed=0))  # compile
+        before = state.storage.obs.unsafe_buffer_pointer()
+        state = add(state, _transition_batch(4, seed=1))
+        jax.block_until_ready(state)
+        after = state.storage.obs.unsafe_buffer_pointer()
+        if before != after:
+            pytest.skip("platform did not honor donation")
+        assert int(state.size) == 8
+
+
+# ---------------------------------------------------------------------------
+# Quantizer stats ride the save tree: fused restore-then-continue bitwise
+# ---------------------------------------------------------------------------
+
+
+def _tiny_mixed_ddpg():
+    from actor_critic_tpu.algos import ddpg
+    from actor_critic_tpu.envs import make_point_mass
+
+    env = make_point_mass()
+    cfg = ddpg.DDPGConfig(
+        num_envs=8, steps_per_iter=4, updates_per_iter=2,
+        buffer_capacity=512, batch_size=32, hidden=(16,),
+        warmup_steps=16, replay_dtype="mixed",
+    )
+    state = ddpg.init_state(env, cfg, jax.random.key(0))
+    step = jax.jit(ddpg.make_train_step(env, cfg))
+    return state, step
+
+
+def test_fused_mixed_resume_bitwise(tmp_path):
+    """Save a quantized-replay DDPG state mid-run, restore into a fresh
+    template, continue — bitwise equal to the uninterrupted run. This is
+    the proof the QuantStats (mean/scale/count) ride the save tree: a
+    restore that dropped or re-zeroed them would decode every sampled
+    batch through a different affine map and diverge immediately."""
+    from actor_critic_tpu.utils.checkpoint import Checkpointer
+
+    state0, step = _tiny_mixed_ddpg()
+
+    full = state0
+    for _ in range(4):
+        full, _ = step(full)
+
+    half = state0
+    for _ in range(2):
+        half, _ = step(half)
+    with Checkpointer(tmp_path / "ck") as ck:
+        jax.block_until_ready(half)
+        ck.save(2, half, force=True)
+        ck.wait()
+        fresh, _ = _tiny_mixed_ddpg()
+        resumed = ck.restore(fresh, 2)
+    # The restored stats must be LIVE values, not the template's zeros.
+    assert float(resumed.learner.replay.quant.obs.count) > 0
+    for _ in range(2):
+        resumed, _ = step(resumed)
+
+    la, lb = jax.tree.leaves(full), jax.tree.leaves(resumed)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        if jnp.issubdtype(x.dtype, jax.dtypes.prng_key):
+            x, y = jax.random.key_data(x), jax.random.key_data(y)
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# ---------------------------------------------------------------------------
+# Telemetry: the host loop's replay gauge and run_report's Resources row
+# ---------------------------------------------------------------------------
+
+
+def test_host_loop_registers_replay_gauge():
+    """off_policy_train_host registers a 'replay' sampler gauge while it
+    runs (capacity/bytes-per-transition/codec mix — the run_report
+    Resources row's source) and unregisters it on exit."""
+    import dataclasses
+
+    pytest.importorskip("gymnasium")
+    from actor_critic_tpu.algos import ddpg
+    from actor_critic_tpu.envs.host_pool import HostEnvPool
+    from actor_critic_tpu.telemetry import sampler
+
+    cfg = ddpg.DDPGConfig(
+        num_envs=2, steps_per_iter=4, updates_per_iter=1,
+        buffer_capacity=256, batch_size=8, warmup_steps=8, hidden=(16,),
+        replay_dtype="mixed",
+    )
+    seen: dict = {}
+
+    def log_fn(it, m):
+        row = sampler.sample_row()
+        if isinstance(row.get("replay"), dict):
+            seen.update(row["replay"])
+
+    pool = HostEnvPool(
+        "Pendulum-v1", num_envs=2, seed=0,
+        normalize_obs=False, normalize_reward=False,
+    )
+    try:
+        ddpg.train_host(
+            pool, cfg, num_iterations=2, seed=0, log_every=1, log_fn=log_fn
+        )
+    finally:
+        pool.close()
+    assert seen.get("capacity") == 256
+    assert seen.get("mode") == "mixed"
+    assert seen.get("bytes_per_transition") == 13
+    assert seen.get("capacity_multiplier") >= 3.0
+    # Unregistered after the loop returns.
+    assert "replay" not in sampler.sample_row()
+    # fields() sanity so a config rename can't silently skip this test.
+    assert any(
+        f.name == "replay_dtype" for f in dataclasses.fields(cfg)
+    )
+
+
+def test_run_report_renders_replay_row(tmp_path):
+    import importlib.util
+    import json
+    from pathlib import Path
+
+    spec = importlib.util.spec_from_file_location(
+        "run_report",
+        Path(__file__).parent.parent / "scripts" / "run_report.py",
+    )
+    run_report = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(run_report)
+
+    rows = [
+        {"ts": 1.0, "recompiles": 0,
+         "replay": {"capacity": 65536, "bytes_per_transition": 13,
+                    "fp32_bytes_per_transition": 40,
+                    "capacity_multiplier": 3.08, "ring_bytes": 851968,
+                    "codec_mix": "obs:i8,action:raw,reward:i8",
+                    "mode": "mixed"}},
+    ]
+    text = "\n".join(run_report.resource_summary(rows))
+    assert "replay ring" in text
+    assert "65536 slots x 13 B/transition" in text
+    assert "3.08x transitions/byte" in text
+    assert "mode mixed" in text
+
+    (tmp_path / "resources.jsonl").write_text(
+        "".join(json.dumps(r) + "\n" for r in rows)
+    )
+    report = run_report.render(str(tmp_path))
+    assert "replay ring" in report
+
+
+# ---------------------------------------------------------------------------
+# fp32 vs mixed eval-return parity, same seed (tolerance-gated)
+# ---------------------------------------------------------------------------
+
+
+def _eval_offpolicy(env, cfg, state, algo_mod):
+    from actor_critic_tpu.algos.common import evaluate
+
+    actor, _ = algo_mod._modules(env.spec.action_dim, cfg)
+    if hasattr(actor, "apply") and type(actor).__name__ == "SquashedGaussianActor":
+        act = lambda p, o: actor.apply(p, o).mode()  # noqa: E731
+    else:
+        act = actor.apply
+    return float(
+        evaluate(
+            env, act, state.learner.actor_params, jax.random.key(99),
+            num_envs=32, num_steps=16,
+        )
+    )
+
+
+@pytest.mark.parametrize("algo", ["ddpg", "td3", "sac"])
+def test_eval_parity_fp32_vs_mixed(algo):
+    """ISSUE 8 acceptance: same-seed short runs in fp32 and mixed modes
+    both learn point_mass (optimal 0, random ~-6) and land within a
+    tolerance of each other — the quantization must not change what the
+    policy converges to, only the bytes it trains from."""
+    from actor_critic_tpu.algos import ddpg, sac
+    from actor_critic_tpu.envs import make_point_mass
+
+    env = make_point_mass()
+    results = {}
+    # Configs/seeds mirror the proven single-mode learning tests in
+    # test_ddpg.py / test_sac.py — the fp32 leg IS that test, so a
+    # parity failure isolates the codec, not the tuning.
+    for mode in ("fp32", "mixed"):
+        if algo == "sac":
+            cfg = sac.SACConfig(
+                num_envs=16, steps_per_iter=4, updates_per_iter=4,
+                buffer_capacity=32768, batch_size=64, hidden=(32, 32),
+                actor_lr=1e-3, critic_lr=1e-3, alpha_lr=1e-3,
+                warmup_steps=256, replay_dtype=mode,
+            )
+            state, _ = sac.train(env, cfg, num_iterations=250, seed=0)
+            results[mode] = _eval_offpolicy(env, cfg, state, sac)
+        else:
+            kw = dict(
+                num_envs=16, steps_per_iter=4, updates_per_iter=4,
+                buffer_capacity=32768, batch_size=64, hidden=(32, 32),
+                actor_lr=1e-3, critic_lr=1e-3, warmup_steps=256,
+                exploration_noise=0.2, replay_dtype=mode,
+            )
+            seed = 2 if algo == "td3" else 1
+            cfg = (
+                ddpg.td3_config(**kw) if algo == "td3"
+                else ddpg.DDPGConfig(**kw)
+            )
+            state, _ = ddpg.train(env, cfg, num_iterations=250, seed=seed)
+            results[mode] = _eval_offpolicy(env, cfg, state, ddpg)
+    assert results["fp32"] > -1.0, results
+    assert results["mixed"] > -1.0, results
+    assert abs(results["fp32"] - results["mixed"]) < 1.0, results

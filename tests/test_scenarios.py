@@ -1,0 +1,146 @@
+"""Scenario-fleet tests (ISSUE 8): deterministic per-instance physics
+draws, per-episode re-randomization through auto_reset, range
+configuration (fractional + per-param + --env-set string spellings),
+default-env gymnasium-constant parity, and a domain-randomized fused
+A2C smoke run stepping a heterogeneous fleet in one XLA program."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from actor_critic_tpu.envs import make_cartpole, make_pendulum
+from actor_critic_tpu.envs import cartpole as cp
+from actor_critic_tpu.envs.jax_env import (
+    draw_scenario, is_randomized, scenario_ranges,
+)
+
+
+class TestRanges:
+    def test_fractional_randomize(self):
+        r = scenario_ranges({"mass": 2.0}, randomize=0.25)
+        assert r["mass"] == (1.5, 2.5)
+        assert is_randomized(r)
+
+    def test_degenerate_without_randomize(self):
+        r = scenario_ranges({"mass": 2.0})
+        assert r["mass"] == (2.0, 2.0)
+        assert not is_randomized(r)
+
+    def test_override_spellings(self):
+        """(lo, hi) tuples, '--env-set'-style 'lo,hi' strings, and bare
+        numbers (pin) all resolve."""
+        r = scenario_ranges(
+            {"a": 1.0, "b": 1.0, "c": 1.0}, randomize=0.1,
+            overrides={"a": (0.5, 2.0), "b": "0.25,4", "c": 3.0},
+        )
+        assert r["a"] == (0.5, 2.0)
+        assert r["b"] == (0.25, 4.0)
+        assert r["c"] == (3.0, 3.0)
+
+    def test_unknown_param_raises(self):
+        with pytest.raises(ValueError, match="unknown scenario parameter"):
+            scenario_ranges({"mass": 1.0}, overrides={"masss": 2.0})
+
+    def test_bad_range_raises(self):
+        with pytest.raises(ValueError, match="lo,hi"):
+            scenario_ranges({"mass": 1.0}, overrides={"mass": "1,2,3"})
+        with pytest.raises(ValueError, match="randomize"):
+            scenario_ranges({"mass": 1.0}, randomize=-0.5)
+
+    def test_draw_determinism(self):
+        """Same key ⇒ same randomized params; different keys differ —
+        the scenario-fleet reproducibility contract."""
+        r = scenario_ranges({"mass": 1.0, "g": 10.0}, randomize=0.5)
+        a = draw_scenario(jax.random.key(7), r)
+        b = draw_scenario(jax.random.key(7), r)
+        c = draw_scenario(jax.random.key(8), r)
+        for name in r:
+            assert float(a[name]) == float(b[name])
+        assert any(float(a[n]) != float(c[n]) for n in r)
+        for name, (lo, hi) in r.items():
+            assert lo <= float(a[name]) <= hi
+
+
+class TestScenarioEnvs:
+    def test_default_env_uses_exact_constants(self):
+        """The non-randomized env must carry gymnasium's exact constants
+        (the parity tests in test_envs.py compare dynamics against the
+        installed gymnasium)."""
+        env = make_cartpole()
+        state, _ = env.reset(jax.random.key(0))
+        sc = state.scenario
+        assert float(sc.gravity) == np.float32(cp.GRAVITY)
+        assert float(sc.masspole) == np.float32(cp.MASSPOLE)
+        assert float(sc.force_mag) == np.float32(cp.FORCE_MAG)
+
+    def test_fleet_is_heterogeneous_and_reproducible(self):
+        env = make_cartpole(randomize=0.3)
+        keys = jax.random.split(jax.random.key(0), 64)
+        s1, _ = jax.vmap(env.reset)(keys)
+        s2, _ = jax.vmap(env.reset)(keys)
+        masses = np.asarray(s1.scenario.masspole)
+        assert len(np.unique(masses)) > 32  # per-instance draws
+        assert (masses >= cp.MASSPOLE * 0.7 - 1e-6).all()
+        assert (masses <= cp.MASSPOLE * 1.3 + 1e-6).all()
+        np.testing.assert_array_equal(
+            masses, np.asarray(s2.scenario.masspole)
+        )  # same keys ⇒ same fleet
+
+    def test_autoreset_redraws_scenario(self):
+        """An episode end re-randomizes the instance's physics (fresh
+        draw from its own PRNG stream) while non-done instances keep
+        theirs — per-episode domain randomization."""
+        env = make_pendulum(randomize=0.4)
+        keys = jax.random.split(jax.random.key(1), 4)
+        state, obs = jax.vmap(env.reset)(keys)
+        before = np.asarray(state.scenario.mass)
+        # Pendulum truncates at MAX_STEPS; force it by setting t high.
+        state = state._replace(
+            t=jnp.full_like(state.t, 10_000),
+        )
+        out = jax.vmap(env.step)(state, jnp.zeros((4, 1), jnp.float32))
+        assert (np.asarray(out.done) == 1.0).all()
+        after = np.asarray(out.state.scenario.mass)
+        assert (before != after).all()
+
+    def test_scenario_changes_dynamics(self):
+        """Heavier pole / stronger force actually alters the step output
+        (the scenario is load-bearing, not decorative)."""
+        heavy = make_cartpole(masspole=1.0)
+        light = make_cartpole(masspole=0.05)
+        sh, _ = heavy.reset(jax.random.key(3))
+        sl, _ = light.reset(jax.random.key(3))
+        # Same kinematic start, different physics.
+        sl = sl._replace(scenario=sl.scenario)
+        a = jnp.asarray(1, jnp.int32)
+        oh = heavy.step(sh, a)
+        ol = light.step(sl, a)
+        assert float(oh.state.theta_dot) != float(ol.state.theta_dot)
+
+    def test_env_set_string_ranges(self):
+        """--env-set masspole=0.05,0.5 reaches the maker as a string and
+        becomes a live per-instance range."""
+        env = make_cartpole(masspole="0.05,0.5")
+        keys = jax.random.split(jax.random.key(4), 32)
+        s, _ = jax.vmap(env.reset)(keys)
+        m = np.asarray(s.scenario.masspole)
+        assert m.min() >= 0.05 and m.max() <= 0.5
+        assert len(np.unique(m)) > 16
+
+
+def test_randomized_fused_a2c_smoke():
+    """ISSUE 8: a domain-randomized fleet steps and TRAINS inside one
+    fused XLA program — A2C on scenario-randomized CartPole, finite
+    metrics, episode accounting alive."""
+    from actor_critic_tpu.algos import a2c
+
+    env = make_cartpole(randomize=0.3)
+    cfg = a2c.A2CConfig(num_envs=64, rollout_steps=16, hidden=(32,))
+    state, metrics = a2c.train(env, cfg, num_iterations=3, seed=0)
+    assert int(state.update_step) == 3
+    for k, v in metrics.items():
+        assert np.isfinite(float(v)), (k, v)
+    # The trained fleet really is heterogeneous.
+    masses = np.asarray(state.rollout.env_state.scenario.masspole)
+    assert len(np.unique(masses)) > 32

@@ -592,6 +592,16 @@ def main(argv=None) -> int:
         "default) or 'none' (plain GAE under the recorded behavior "
         "values; tolerates small staleness, A3C-style)",
     )
+    p.add_argument(
+        "--replay-dtype", choices=("fp32", "mixed", "int8"), default=None,
+        help="off-policy algos (ddpg/td3/sac): replay-ring storage codec "
+        "(replay/quantize.py). 'mixed' stores obs/rewards as int8 behind "
+        "running mean/scale standardization with actions kept fp32 "
+        "(~3x transitions per HBM byte); 'int8' also quantizes the "
+        "bounded actions (~4x, aggressive); default fp32. Equivalent to "
+        "--set replay_dtype=...; never flip it on a resumed run whose "
+        "checkpoint carries a full ring (the template dtype must match).",
+    )
     p.add_argument("--quiet", action="store_true", help="no stdout metric echo")
     p.add_argument(
         "--no-overlap", action="store_true",
@@ -667,6 +677,19 @@ def main(argv=None) -> int:
         args.preset, args.algo, args.env, parse_set_args(args.set),
         env_overrides=parse_env_set_args(args.env_set),
     )
+    if args.replay_dtype is not None:
+        if not hasattr(preset.config, "replay_dtype"):
+            raise SystemExit(
+                f"--replay-dtype applies to the off-policy algos "
+                f"(ddpg/td3/sac) with an HBM replay ring; {preset.algo} "
+                "has no replay storage"
+            )
+        preset = dataclasses.replace(
+            preset,
+            config=dataclasses.replace(
+                preset.config, replay_dtype=args.replay_dtype
+            ),
+        )
     if args.iterations is None:
         args.iterations = preset.iterations
 
