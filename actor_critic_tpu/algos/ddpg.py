@@ -544,6 +544,43 @@ def train_host(
     )
 
 
+def train_host_async(
+    pools,
+    cfg: DDPGConfig,
+    num_iterations: int,
+    seed: int = 0,
+    log_every: int = 10,
+    log_fn: Optional[Callable[[int, dict], None]] = None,
+    eval_every: int = 0,
+    eval_envs: int = 4,
+    eval_steps: int = 1000,
+    queue_depth: int = 4,
+    max_staleness: Optional[int] = None,
+):
+    """DDPG/TD3 with decoupled actor services (ISSUE 9 satellite; the
+    PPO-only restriction of `--async-actors` lifted): one exploration
+    thread per pool pushes [K, E_a] transition blocks through the
+    bounded trajectory queue; the learner ingests each into the replay
+    ring and updates — replay absorbs the behavior staleness natively,
+    so there is no correction knob here. Returns (learner, history)."""
+    from actor_critic_tpu.algos.host_loop import off_policy_train_host_async
+    from actor_critic_tpu.models.host_actor import (
+        make_ddpg_host_explore,
+        make_ddpg_host_greedy,
+    )
+
+    return off_policy_train_host_async(
+        pools, cfg, num_iterations,
+        init_learner=init_learner,
+        make_ingest_update=make_host_ingest_update,
+        make_host_explore=make_ddpg_host_explore,
+        make_host_greedy=make_ddpg_host_greedy,
+        seed=seed, log_every=log_every, log_fn=log_fn,
+        eval_every=eval_every, eval_envs=eval_envs, eval_steps=eval_steps,
+        queue_depth=queue_depth, max_staleness=max_staleness,
+    )
+
+
 # -- AOT warmup registry (utils/compile_cache.py, ISSUE 4) ------------------
 # Registers the host-path act / ingest+update / greedy programs (skipped
 # where the numpy mirror replaces them) and the fused step/eval pair, so

@@ -317,32 +317,22 @@ def _host_save(ckpt, it, pool, metrics, save_replay, device_state):
     )
 
 
-def host_resume(ckpt, template: dict, pool) -> tuple[Optional[dict], int]:
-    """Restore the latest host checkpoint into `template`'s structure and
-    push the pool state back; (None, 0) when nothing is saved yet.
+def _warn_restore_mismatch(restored_pool: dict, pool, saved_scale) -> None:
+    """The resume-contract warnings shared by the single-pool and
+    async multi-pool restore paths: action-convention flips and
+    normalization-contract flips must never degrade in silence.
 
-    Resume semantics on host envs: learner/params/optimizer/PRNG/
-    normalizer stats restore EXACTLY; the env simulator state does not
-    (gymnasium can't serialize it), so the pool restarts fresh episodes —
-    same contract as the reference genre's tf.train.Saver restarts.
+    Normalization check: a checkpoint whose obs-normalizer accumulated
+    real statistics came from a run that FED NORMALIZED observations to
+    the networks. Resuming it into a raw-obs pool (e.g. after the
+    off-policy default flipped to normalize_obs=False) silently puts
+    the restored policy/critic off-distribution. (The flags themselves
+    are not checkpointed, so the stats are the only available signal.)
     """
-    step = ckpt.latest_step()
-    if step is None:
-        return None, 0
-    restored = ckpt.restore(template, step)
-    pool.set_state(restored["pool"])
-    # Normalization-contract check: a checkpoint whose obs-normalizer
-    # accumulated real statistics came from a run that FED NORMALIZED
-    # observations to the networks. Resuming it into a raw-obs pool
-    # (e.g. after the off-policy default flipped to normalize_obs=False)
-    # silently puts the restored policy/critic off-distribution — warn
-    # loudly instead of degrading in silence. (The flags themselves are
-    # not checkpointed, so the stats are the only available signal.)
     try:
-        saved_count = float(np.asarray(restored["pool"]["obs_rms"]["count"]))
+        saved_count = float(np.asarray(restored_pool["obs_rms"]["count"]))
     except (KeyError, TypeError):
         saved_count = 0.0
-    saved_scale = ckpt.restore_metrics(step).get("_pool_scale_actions")
     if saved_scale is not None and bool(saved_scale) != getattr(
         pool, "scales_actions", False
     ):
@@ -353,7 +343,7 @@ def host_resume(ckpt, template: dict, pool) -> tuple[Optional[dict], int]:
             f"{getattr(pool, 'scales_actions', False)} — the restored "
             "policy's actions will execute differently than they trained. "
             "Relaunch with the run's original --scale-actions setting.",
-            stacklevel=2,
+            stacklevel=3,
         )
     trained_normalized = saved_count > 1.0
     if trained_normalized != pool.normalizes_obs:
@@ -368,8 +358,105 @@ def host_resume(ckpt, template: dict, pool) -> tuple[Optional[dict], int]:
             "observation scaling no longer matches the pool's). Rebuild "
             f"the pool with normalize_obs={trained_normalized} (or "
             "restart the run from scratch).",
-            stacklevel=2,
+            stacklevel=3,
         )
+
+
+def host_resume(ckpt, template: dict, pool) -> tuple[Optional[dict], int]:
+    """Restore the latest host checkpoint into `template`'s structure and
+    push the pool state back; (None, 0) when nothing is saved yet.
+
+    Resume semantics on host envs: learner/params/optimizer/PRNG/
+    normalizer stats restore EXACTLY; the env simulator state does not
+    (gymnasium can't serialize it), so the pool restarts fresh episodes —
+    same contract as the reference genre's tf.train.Saver restarts.
+    """
+    step = ckpt.latest_step()
+    if step is None:
+        return None, 0
+    restored = ckpt.restore(template, step)
+    pool.set_state(restored["pool"])
+    _warn_restore_mismatch(
+        restored["pool"], pool,
+        ckpt.restore_metrics(step).get("_pool_scale_actions"),
+    )
+    return restored, step
+
+
+def async_host_ckpt_state(pools, **device_state) -> dict:
+    """Checkpoint pytree for the ASYNC actor–learner drivers: the
+    device state plus ALL A per-actor pools' normalizer states (each
+    actor pool runs independent running stats — saving only one would
+    resume A-1 actors with wrong observation scaling; ISSUE 9
+    satellite). The learner thread snapshots pool stats while actor
+    threads may be mid-block: each leaf read is atomic (numpy arrays
+    rebound per update), so a snapshot can at worst be one batch-update
+    stale per leaf — tolerable drift for running statistics, the same
+    tolerance `host_resume` already grants the +1 reset batch."""
+    return {
+        **device_state,
+        "pools": [np_tree(p.get_state()) for p in pools],
+    }
+
+
+def async_host_maybe_save(
+    ckpt, it: int, save_every: int, num_iterations: int, pools,
+    metrics: dict, **device_state,
+) -> None:
+    """Async-driver twin of `host_maybe_save` over the whole actor
+    fleet's pools (`it` is 1-based consumed-block count)."""
+    if ckpt is None or not should_save(it, save_every, num_iterations):
+        return
+    import jax
+
+    with telemetry.span("checkpoint", step=it):
+        jax.block_until_ready(device_state)
+        metrics = {
+            **(metrics or {}),
+            "_pool_scale_actions": float(
+                getattr(pools[0], "scales_actions", False)
+            ),
+            # Resume guard: the tree carries one pool state per actor,
+            # so the fleet size must match (async_host_resume checks
+            # this BEFORE orbax's opaque structure-mismatch error).
+            "_async_actors": float(len(pools)),
+        }
+        ckpt.save(
+            it, async_host_ckpt_state(pools, **device_state),
+            metrics=metrics, force=True,
+        )
+
+
+def async_host_resume(ckpt, template: dict, pools) -> tuple[Optional[dict], int]:
+    """Restore the latest async checkpoint and push every actor pool's
+    normalizer state back; (None, 0) when nothing is saved yet. The
+    saved tree must carry the same number of pool states as the resuming
+    fleet (`--async-actors` must not change across a resume — each
+    pool's stats belong to its own actor's env shard)."""
+    step = ckpt.latest_step()
+    if step is None:
+        return None, 0
+    saved_metrics = ckpt.restore_metrics(step)
+    saved_actors = saved_metrics.get("_async_actors")
+    if saved_actors is not None and int(saved_actors) != len(pools):
+        raise ValueError(
+            f"checkpoint carries {int(saved_actors)} actor-pool states "
+            f"but this run has {len(pools)} actors — resume with the "
+            "original --async-actors count"
+        )
+    restored = ckpt.restore(template, step)
+    saved_pools = restored["pools"]
+    if len(saved_pools) != len(pools):
+        # Fallback for checkpoints predating the _async_actors metric.
+        raise ValueError(
+            f"checkpoint carries {len(saved_pools)} actor-pool states "
+            f"but this run has {len(pools)} actors — resume with the "
+            "original --async-actors count"
+        )
+    saved_scale = saved_metrics.get("_pool_scale_actions")
+    for pool, saved in zip(pools, saved_pools):
+        pool.set_state(saved)
+        _warn_restore_mismatch(saved, pool, saved_scale)
     return restored, step
 
 
@@ -623,6 +710,199 @@ def off_policy_train_host(
             ckpt.wait()  # the final async save must be durable before return
     finally:
         _sampler.unregister_gauge(_replay_gauge)
+    return learner, history
+
+
+def off_policy_train_host_async(
+    pools,
+    cfg,
+    num_iterations: int,
+    *,
+    init_learner: Callable,
+    make_ingest_update: Callable,
+    make_host_explore: Callable,
+    make_host_greedy: Optional[Callable] = None,
+    seed: int = 0,
+    log_every: int = 10,
+    log_fn: Optional[Callable[[int, dict], None]] = None,
+    eval_every: int = 0,
+    eval_envs: int = 4,
+    eval_steps: int = 1000,
+    queue_depth: int = 4,
+    max_staleness: Optional[int] = None,
+):
+    """Async actor–learner loop for the off-policy trainers (DDPG/TD3,
+    SAC) — the ROADMAP item PR 6 left open: replay absorbs behavior-
+    policy staleness natively (every consumed block just lands in the
+    ring; updates sample uniformly regardless of which params collected
+    a transition), so only the ingest hand-off needed wiring through
+    `traj_queue.ActorService`.
+
+    One actor thread per pool explores through the numpy mirror
+    (`make_host_explore(spec, cfg)`, behavior params refreshed from the
+    `PolicyPublisher` once per block) and pushes `[K, E_a]` transition
+    blocks; this (learner) thread drains the queue and feeds each block
+    to the jitted ingest+update program. `max_staleness` defaults to
+    None — dropping stale blocks would throw away valid off-policy
+    experience; the queue's drop-oldest back-pressure still bounds
+    memory. Each actor warms up on uniform-random actions for its share
+    (`warmup_steps / A`) of the fleet warmup budget: the mirror's gate
+    compares against `cfg.warmup_steps`, so the actor feeds it its own
+    step count scaled by the fleet size. The update gate sees the
+    FLEET's total collected steps. `num_iterations` counts blocks
+    consumed. Checkpointing is not wired for this mode (per-actor pools
+    carry independent normalizer state; the PPO async driver grew the
+    multi-pool save tree first — see ppo.train_host_async).
+
+    Returns (learner, history).
+    """
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    from actor_critic_tpu.algos.common import OffPolicyTransition
+    from actor_critic_tpu.algos.traj_queue import (
+        ActorService,
+        PolicyPublisher,
+        TrajQueue,
+        consume_block,
+        validate_pools,
+    )
+    from actor_critic_tpu.models import host_actor
+
+    spec, E_a = validate_pools(pools)
+    A = len(pools)
+
+    key = jax.random.key(seed)
+    key, lkey = jax.random.split(key)
+    learner = init_learner(spec.obs_shape, spec.action_dim, cfg, lkey)
+    np_params = jax.device_get(learner.actor_params)
+    if not host_actor.supports_mirror(np_params):
+        raise ValueError(
+            "async actor–learner mode needs the numpy actor mirror "
+            "(MLP torso; models/host_actor.py)"
+        )
+    host_explore = make_host_explore(spec, cfg)
+    ingest_update = make_ingest_update(spec.action_dim, cfg)
+
+    def actor_act_factory(actor_id: int):
+        # Per-actor step counter, read/written only on that actor's
+        # thread; scaled by A it approximates the fleet total, so the
+        # mirror's `env_steps < warmup_steps` gate hands each actor its
+        # 1/A share of the uniform-random warmup budget.
+        counter = {"steps": 0}
+
+        def make_act_fn(actor_params, rng):
+            def act(o):
+                action = host_explore(
+                    actor_params, o, rng, counter["steps"] * A
+                )
+                counter["steps"] += np.asarray(o).shape[0]
+                return action, {}
+
+            return act
+
+        return make_act_fn
+
+    queue = TrajQueue(
+        depth=queue_depth, max_staleness=max_staleness,
+        policy="drop_oldest",
+    )
+    publisher = PolicyPublisher(np_params, version=0)
+    stop = threading.Event()
+    actors = [
+        ActorService(
+            i, pool, queue, publisher, cfg.steps_per_iter,
+            actor_act_factory(i),
+            rng=np.random.default_rng(seed + 0x5EED + i * 7919),
+            stop=stop,
+        )
+        for i, pool in enumerate(pools)
+    ]
+
+    eval_pool = host_greedy = None
+    if eval_every > 0 and make_host_greedy is not None:
+        eval_pool = pools[-1].eval_pool(eval_envs)
+        host_greedy = make_host_greedy(spec, cfg)
+
+    history: list = []
+    metrics: dict = {}
+    trackers = MergedEpisodeTracker([a.tracker for a in actors])
+    try:
+        for a in actors:
+            a.start()
+        for it in range(num_iterations):
+            telemetry.profiler_tick()
+            for a in actors:
+                if a.error is not None:
+                    raise RuntimeError(
+                        f"actor {a.actor_id} died"
+                    ) from a.error
+            with telemetry.span("iteration", it=it + 1):
+                queue.set_consumer_version(it)
+                with telemetry.span("queue_wait", it=it + 1):
+                    block = consume_block(queue, actors)
+                # Behavior params for the actors' NEXT blocks: this
+                # update's INPUT params, fetched BEFORE the donating
+                # dispatch below (concrete — the previous update
+                # finished during collection).
+                publisher.publish(
+                    jax.device_get(learner.actor_params), version=it
+                )
+                staleness = max(it - block.version, 0)
+                env_steps = sum(a.steps_collected for a in actors)
+                with telemetry.span("host_to_device"):
+                    # jnp.array, NOT asarray: the transfer must snapshot
+                    # the slot before release (the PR 6 contract).
+                    traj = OffPolicyTransition(
+                        obs=jnp.array(block.arrays["obs"]),
+                        action=jnp.array(block.arrays["action"]),
+                        reward=jnp.array(block.arrays["reward"]),
+                        next_obs=jnp.array(block.arrays["final_obs"]),
+                        terminated=jnp.array(block.arrays["terminated"]),
+                        done=jnp.array(block.arrays["done"]),
+                    )
+                queue.release(block)
+                with telemetry.span("update", dispatch="async"):
+                    learner, metrics = ingest_update(
+                        learner, traj, jnp.asarray(env_steps, jnp.int32)
+                    )
+                qs = queue.stats()
+                extra = {
+                    "env_steps": env_steps,
+                    "consumed_env_steps": (it + 1) * cfg.steps_per_iter * E_a,
+                    "block_actor": block.actor_id,
+                    "block_staleness": staleness,
+                    "queue_depth": qs["depth"],
+                    "queue_drops_full": qs["drops_full"],
+                    "queue_drops_stale": qs["drops_stale"],
+                    "learner_idle_s": qs["learner_idle_s"],
+                }
+                if eval_pool is not None and (it + 1) % eval_every == 0:
+                    # Blocks on the in-flight update: eval sees CURRENT
+                    # params, like the lockstep drivers.
+                    ev_params = jax.device_get(learner.actor_params)
+                    with telemetry.span("eval"):
+                        extra["eval_return"] = host_evaluate(
+                            eval_pool,
+                            # jaxlint: disable=host-sync (numpy mirror
+                            # eval — no device value is touched)
+                            lambda o: np.asarray(host_greedy(ev_params, o)),
+                            max_steps=eval_steps,
+                        )
+                maybe_log(
+                    it, log_every, metrics, trackers, history, log_fn,
+                    extra=extra, num_iterations=num_iterations,
+                    force="eval_return" in extra or it == 0,
+                )
+    finally:
+        stop.set()
+        for a in actors:
+            a.join(timeout=30.0)
+        queue.close()
+        if eval_pool is not None:
+            eval_pool.close()
     return learner, history
 
 

@@ -555,31 +555,22 @@ def train_host(
     return params, opt_state, history
 
 
-def make_async_update_step(
+def make_async_update_fn(
     env_spec,
     cfg: PPOConfig,
     can_truncate: bool = True,
     correction: str = "vtrace",
     rho_bar: float = 1.0,
     c_bar: float = 1.0,
+    axis_name: Optional[str] = None,
 ):
-    """Staleness-corrected learner update for the async actor–learner
-    path (ISSUE 6): same positional signature as `make_host_update_step`
-    minus the mirror-value kwargs, on per-actor `[T, E_a]` blocks.
-
-    `correction="vtrace"` re-evaluates π/V at the stored observations
-    under the LEARNER's params and builds V-trace value targets and
-    policy-gradient advantages from the recorded BEHAVIOR log-probs
-    (`common.corrected_advantages`, the machinery shared with
-    `impala.py`), then reuses the batch through the in-jit
-    epoch/minibatch clipped-surrogate loop — IMPACT-style sample reuse
-    with a clipped-target correction; the recorded behavior value stays
-    the value-clip anchor. `correction="none"` returns
-    `make_host_update_step` itself (identical program to the lockstep
-    driver's — the depth-1 equivalence tests rely on this).
-    """
-    if correction == "none":
-        return make_host_update_step(env_spec, cfg, can_truncate)
+    """The UNJITTED V-trace-corrected update body behind
+    `make_async_update_step`, with an optional mesh `axis_name`: the
+    multi-host learner (`parallel/multihost.py`) shard_maps this over
+    the global dp mesh so the per-minibatch gradient pmean becomes the
+    cross-process all-reduce — exactly how `parallel/dp.py` scales the
+    fused step. Single-host callers leave `axis_name=None` (the pmean
+    degrades to a no-op) and use `make_async_update_step`'s jit."""
     if correction != "vtrace":
         raise ValueError(f"unknown correction: {correction!r}")
     from actor_critic_tpu.algos.common import corrected_advantages
@@ -588,7 +579,6 @@ def make_async_update_step(
     opt = make_optimizer(cfg)
     apply_fn = net.apply
 
-    @jax.jit
     def async_update(
         params, opt_state, obs, action, log_prob, value, reward, done,
         terminated, final_obs, last_obs, key, progress=None,
@@ -629,12 +619,49 @@ def make_async_update_step(
         )
         new_params, new_opt_state, metrics = ppo_update(
             params, opt_state, batch, key, apply_fn, opt, cfg,
-            progress=progress, unroll=should_unroll_update(env_spec, cfg),
+            axis_name, progress=progress,
+            unroll=should_unroll_update(env_spec, cfg),
         )
         metrics = dict(metrics, mean_rho=mean_rho)
+        # Under a mesh axis the per-shard metric means differ (each
+        # shard saw its own minibatches); reduce so the declared
+        # replicated output really is replicated.
+        metrics = pmesh.pmean_tree(metrics, axis_name)
         return new_params, new_opt_state, metrics
 
     return async_update
+
+
+def make_async_update_step(
+    env_spec,
+    cfg: PPOConfig,
+    can_truncate: bool = True,
+    correction: str = "vtrace",
+    rho_bar: float = 1.0,
+    c_bar: float = 1.0,
+):
+    """Staleness-corrected learner update for the async actor–learner
+    path (ISSUE 6): same positional signature as `make_host_update_step`
+    minus the mirror-value kwargs, on per-actor `[T, E_a]` blocks.
+
+    `correction="vtrace"` re-evaluates π/V at the stored observations
+    under the LEARNER's params and builds V-trace value targets and
+    policy-gradient advantages from the recorded BEHAVIOR log-probs
+    (`common.corrected_advantages`, the machinery shared with
+    `impala.py`), then reuses the batch through the in-jit
+    epoch/minibatch clipped-surrogate loop — IMPACT-style sample reuse
+    with a clipped-target correction; the recorded behavior value stays
+    the value-clip anchor. `correction="none"` returns
+    `make_host_update_step` itself (identical program to the lockstep
+    driver's — the depth-1 equivalence tests rely on this).
+    """
+    if correction == "none":
+        return make_host_update_step(env_spec, cfg, can_truncate)
+    return jax.jit(
+        make_async_update_fn(
+            env_spec, cfg, can_truncate, correction, rho_bar, c_bar
+        )
+    )
 
 
 def train_host_async(
@@ -654,6 +681,9 @@ def train_host_async(
     rho_bar: float = 1.0,
     c_bar: float = 1.0,
     strict_lockstep: bool = False,
+    ckpt=None,
+    save_every: int = 0,
+    resume: bool = False,
 ):
     """Async actor–learner PPO on host env pools (ISSUE 6 tentpole).
 
@@ -670,9 +700,14 @@ def train_host_async(
     while queued. `num_iterations` counts blocks consumed.
 
     Requires the numpy mirror (MLP torsos — every host-env PPO config);
-    pixel pools must run the lockstep `train_host`. Checkpointing is
-    not wired for this mode yet (per-actor pools carry independent
-    normalizer state; see ROADMAP). `strict_lockstep` is the test hook:
+    pixel pools must run the lockstep `train_host`. With `ckpt` the run
+    checkpoints on the consumed-block cadence: the save tree carries
+    the device state (params/opt/PRNG) plus ALL A per-actor pools'
+    normalizer states (`host_loop.async_host_ckpt_state` — each actor
+    pool runs independent running stats, so every one must round-trip),
+    and `resume` restores them exactly; actor collection restarts fresh
+    episodes, same contract as `train_host`. `--async-actors` must not
+    change across a resume. `strict_lockstep` is the test hook:
     with one actor, `queue_depth=1`, `updates_per_block=1` and
     `correction="none"` the run is bit-for-bit `train_host`
     (tests/test_async_host.py). Returns (params, opt_state, history).
@@ -683,6 +718,9 @@ def train_host_async(
 
     from actor_critic_tpu.algos.host_loop import (
         MergedEpisodeTracker,
+        async_host_ckpt_state,
+        async_host_maybe_save,
+        async_host_resume,
         host_evaluate,
         maybe_log,
     )
@@ -690,19 +728,12 @@ def train_host_async(
         ActorService,
         PolicyPublisher,
         TrajQueue,
+        consume_block,
+        validate_pools,
     )
     from actor_critic_tpu.models import host_actor
 
-    if not pools:
-        raise ValueError("need at least one actor pool")
-    spec = pools[0].spec
-    E_a = pools[0].num_envs
-    for p in pools[1:]:
-        if p.spec != spec or p.num_envs != E_a:
-            raise ValueError(
-                "actor pools must share one env spec and num_envs (the "
-                "learner compiles ONE [K, E_a] update program)"
-            )
+    spec, E_a = validate_pools(pools)
     if updates_per_block < 1:
         raise ValueError("updates_per_block must be >= 1")
 
@@ -750,12 +781,24 @@ def train_host_async(
                 "bootstrap_value": host_value(actor_params, last_obs),
             }
 
+    start_it = 0
+    if ckpt is not None and resume:
+        template = async_host_ckpt_state(
+            pools, params=params, opt_state=opt_state, key=key
+        )
+        restored, start_it = async_host_resume(ckpt, template, pools)
+        if restored is not None:
+            params = restored["params"]
+            opt_state = restored["opt_state"]
+            key = restored["key"]
+            np_params = jax.device_get(params)
+
     queue = TrajQueue(
         depth=queue_depth,
         max_staleness=None if strict_lockstep else max_staleness,
         policy="block" if strict_lockstep else "drop_oldest",
     )
-    publisher = PolicyPublisher(np_params, version=0)
+    publisher = PolicyPublisher(np_params, version=start_it)
     stop = threading.Event()
     actors = [
         ActorService(
@@ -779,9 +822,12 @@ def train_host_async(
     metrics: dict = {}
     trackers = MergedEpisodeTracker([a.tracker for a in actors])
     try:
-        for a in actors:
-            a.start()
-        for it in range(num_iterations):
+        if start_it < num_iterations:
+            # A resume that finds the run complete starts NO actors:
+            # collection would only churn the restored normalizer stats.
+            for a in actors:
+                a.start()
+        for it in range(start_it, num_iterations):
             telemetry.profiler_tick()
             # Surface a dead actor's exception EVERY iteration, not only
             # once the queue drains — surviving actors would otherwise
@@ -794,20 +840,7 @@ def train_host_async(
             with telemetry.span("iteration", it=it + 1):
                 queue.set_consumer_version(it)
                 with telemetry.span("queue_wait", it=it + 1):
-                    block = None
-                    while block is None:
-                        block = queue.get(timeout=0.5)
-                        if block is None:
-                            for a in actors:
-                                if a.error is not None:
-                                    raise RuntimeError(
-                                        f"actor {a.actor_id} died"
-                                    ) from a.error
-                            if not any(a.alive for a in actors):
-                                raise RuntimeError(
-                                    "every actor thread exited with no "
-                                    "blocks pending"
-                                )
+                    block = consume_block(queue, actors)
                 # Behavior params for the actors' NEXT blocks: this
                 # update's INPUT params (concrete — the previous
                 # dispatched update finished while blocks were being
@@ -872,8 +905,14 @@ def train_host_async(
                 maybe_log(
                     it, log_every, metrics, trackers, history, log_fn,
                     extra=extra, num_iterations=num_iterations,
-                    force="eval_return" in extra or it == 0,
+                    force="eval_return" in extra or it == start_it,
                 )
+                async_host_maybe_save(
+                    ckpt, it + 1, save_every, num_iterations, pools,
+                    metrics, params=params, opt_state=opt_state, key=key,
+                )
+        if ckpt is not None:
+            ckpt.wait()  # the final async save must be durable
     finally:
         stop.set()
         for a in actors:

@@ -458,6 +458,41 @@ def train_host(
     )
 
 
+def train_host_async(
+    pools,
+    cfg: SACConfig,
+    num_iterations: int,
+    seed: int = 0,
+    log_every: int = 10,
+    log_fn: Optional[Callable[[int, dict], None]] = None,
+    eval_every: int = 0,
+    eval_envs: int = 4,
+    eval_steps: int = 1000,
+    queue_depth: int = 4,
+    max_staleness: Optional[int] = None,
+):
+    """SAC with decoupled actor services (ISSUE 9 satellite; mirrors
+    ddpg.train_host_async — replay absorbs behavior staleness, only the
+    ingest hand-off is wired through the queue). Returns
+    (learner, history)."""
+    from actor_critic_tpu.algos.host_loop import off_policy_train_host_async
+    from actor_critic_tpu.models.host_actor import (
+        make_sac_host_explore,
+        make_sac_host_greedy,
+    )
+
+    return off_policy_train_host_async(
+        pools, cfg, num_iterations,
+        init_learner=init_learner,
+        make_ingest_update=make_host_ingest_update,
+        make_host_explore=make_sac_host_explore,
+        make_host_greedy=make_sac_host_greedy,
+        seed=seed, log_every=log_every, log_fn=log_fn,
+        eval_every=eval_every, eval_envs=eval_envs, eval_steps=eval_steps,
+        queue_depth=queue_depth, max_staleness=max_staleness,
+    )
+
+
 # -- AOT warmup registry (utils/compile_cache.py, ISSUE 4) ------------------
 from actor_critic_tpu.utils import compile_cache as _compile_cache  # noqa: E402
 

@@ -276,6 +276,54 @@ class TrajQueue:
             _sampler.unregister_gauge(gauge_key)
 
 
+def validate_pools(pools) -> tuple:
+    """(shared spec, per-actor env count) of an async actor fleet; the
+    shared precondition of every async learner driver — the learner
+    compiles ONE [K, E_a] program, so every pool must present the same
+    env spec and width. One copy (like `consume_block`), so a future
+    tightening of the invariant lands once."""
+    if not pools:
+        raise ValueError("need at least one actor pool")
+    spec = pools[0].spec
+    E_a = pools[0].num_envs
+    for p in pools[1:]:
+        if p.spec != spec or p.num_envs != E_a:
+            raise ValueError(
+                "actor pools must share one env spec and num_envs (the "
+                "learner compiles ONE [K, E_a] program)"
+            )
+    return spec, E_a
+
+
+def consume_block(
+    queue: "TrajQueue",
+    actors: list,
+    timeout: float = 0.5,
+    context: str = "",
+) -> "TrajBlock":
+    """Drain ONE block for a learner loop, surfacing actor failures
+    while waiting: re-raises a dead actor's exception (`context`
+    prefixes the message, e.g. "host 2 "), and a fully-exited fleet
+    with nothing pending raises instead of spinning forever. The
+    shared consume protocol of every async learner driver
+    (ppo.train_host_async, host_loop.off_policy_train_host_async,
+    multihost.train_multihost) — one copy, so a fix to the dead-actor
+    surfacing never has to land three times."""
+    while True:
+        block = queue.get(timeout=timeout)
+        if block is not None:
+            return block
+        for a in actors:
+            if a.error is not None:
+                raise RuntimeError(
+                    f"{context}actor {a.actor_id} died"
+                ) from a.error
+        if not any(a.alive for a in actors):
+            raise RuntimeError(
+                "every actor thread exited with no blocks pending"
+            )
+
+
 def _snapshot_frozen(tree: Any) -> Any:
     """Copy every numpy leaf of a (dict/list/tuple-structured) params
     tree and mark the copies read-only. The publisher stores THESE, so
@@ -425,6 +473,8 @@ class ActorService:
         return self
 
     def join(self, timeout: Optional[float] = None) -> None:
+        if self._thread.ident is None:
+            return  # never started (e.g. a resume that found the run done)
         self._thread.join(timeout)
 
     @property

@@ -336,6 +336,23 @@ def freeze_on_publish(publisher: Any) -> Any:
     return publisher
 
 
+def freeze_on_deposit(mailbox: Any) -> Any:
+    """Wrap `mailbox.deposit` so the DEPOSITOR'S retained view of every
+    deposited params tree is frozen at the deposit boundary — the
+    mailbox-writer mirror of `freeze_on_publish`: an in-place refresh
+    of a tree the learner may still be consuming crashes at the write
+    site. (The hardened `ParamMailbox` additionally snapshots+freezes
+    what it STORES — same contract as `PolicyPublisher.publish`.)"""
+    orig = mailbox.deposit
+
+    def deposit(params: Any, version: int, peer: int) -> bool:
+        freeze_leaves(params)
+        return orig(params, version, peer)
+
+    mailbox.deposit = deposit
+    return mailbox
+
+
 def attach_queue_poisoner(queue: Any, scribble: bool = True) -> Any:
     """Poison a TrajQueue-shaped object (get/release protocol):
 
@@ -541,6 +558,88 @@ def exercise_publisher(
     return report
 
 
+def exercise_mailbox(
+    seed: int,
+    versions: int = 6,
+    consumers: int = 2,
+    shape: tuple[int, ...] = (3, 2),
+    poison: bool = True,
+    buggy_depositor: bool = False,
+    timeout_s: float = 10.0,
+) -> dict:
+    """One seeded schedule over the multihost `ParamMailbox` (ISSUE 9):
+    a writer-role thread deposits uniform-fill peer-param trees with
+    increasing versions; consumer threads `take`/`peek` and verify
+    uniformity (torn storage shows mixed values) and strict version
+    monotonicity across takes (latest-wins must never hand a consumer
+    an older tree than one it already took). `buggy_depositor=True`
+    refreshes the depositor's RETAINED tree in place after depositing —
+    under the poisoner that crashes at the write site on every
+    schedule, the same frozen-snapshot contract
+    `PolicyPublisher.publish` carries. NB: imports the multihost module
+    (which pulls jax transitively); the queue/publisher exercisers stay
+    jax-free."""
+    from actor_critic_tpu.parallel.multihost import ParamMailbox
+
+    sched = CoopScheduler(seed)
+    mailbox = ParamMailbox()
+    sched.trace_locks(mailbox, "_lock")
+    if poison:
+        freeze_on_deposit(mailbox)
+    report = {
+        "seed": seed, "deposits": 0, "takes": 0, "reads": 0,
+        "race_detected": False,
+    }
+
+    def writer() -> None:
+        retained = {"w": np.full(shape, 0.0, np.float32)}
+        for v in range(1, versions + 1):
+            if buggy_depositor:
+                # In-place refresh of the tree deposited last round —
+                # the hazard the freeze turns into a write-site crash.
+                retained["w"][...] = float(v)
+            else:
+                retained = {"w": np.full(shape, float(v), np.float32)}
+            sched.yield_point("pre-deposit")
+            mailbox.deposit(retained, version=v, peer=0)
+            report["deposits"] = v
+            sched.yield_point("deposited")
+
+    def consumer(i: int) -> None:
+        last_taken = -1
+        while True:
+            out = mailbox.take()
+            if out is not None:
+                version, _, params = out
+                w = params["w"]
+                if not bool(np.all(w == w.flat[0])):
+                    report["race_detected"] = True
+                    raise RacesanError(
+                        f"consumer {i} took torn mailbox params at "
+                        f"version {version} under seed {seed}"
+                    )
+                if version <= last_taken:
+                    report["race_detected"] = True
+                    raise RacesanError(
+                        f"mailbox handed consumer {i} version {version} "
+                        f"after {last_taken} under seed {seed} — "
+                        "latest-wins violated"
+                    )
+                last_taken = version
+                report["takes"] += 1
+            peeked = mailbox.peek()
+            report["reads"] += 1
+            if peeked is not None and peeked[0] >= versions:
+                return
+            sched.yield_point("idle")
+
+    sched.spawn("mailbox-writer", writer)
+    for i in range(consumers):
+        sched.spawn(f"consumer-{i}", lambda i=i: consumer(i))
+    sched.run(timeout_s=timeout_s)
+    return report
+
+
 def exercise_sweep(
     seeds: Iterable[int],
     scenario: Callable[[int], dict],
@@ -555,27 +654,35 @@ def exercise_sweep(
         "consumed": sum(r.get("consumed", 0) for r in reports),
         "reads": sum(r.get("reads", 0) for r in reports),
         "published": sum(r.get("published", 0) for r in reports),
+        "deposits": sum(r.get("deposits", 0) for r in reports),
+        "takes": sum(r.get("takes", 0) for r in reports),
         "races": sum(1 for r in reports if r.get("race_detected")),
     }
 
 
 def quick_profile(schedules: int = 100, seed0: int = 0) -> dict:
     """The tier-1 fast profile: `schedules` seeded interleavings split
-    across the queue (snapshot consumer, poisoned) and publisher
-    (correct producer, poisoned) units — every schedule must sweep
-    clean. ~100 schedules run in a few seconds on one CPU core."""
-    half = max(schedules // 2, 1)
+    across the queue (snapshot consumer, poisoned), publisher (correct
+    producer, poisoned), and multihost param-mailbox (correct
+    depositor, poisoned) units — every schedule must sweep clean.
+    ~100 schedules run in a few seconds on one CPU core."""
+    third = max(schedules // 3, 1)
     q = exercise_sweep(
-        range(seed0, seed0 + half),
+        range(seed0, seed0 + third),
         lambda s: exercise_queue(s, poison=True, consumer="snapshot"),
     )
     p = exercise_sweep(
-        range(seed0, seed0 + (schedules - half)),
+        range(seed0, seed0 + third),
         lambda s: exercise_publisher(s, poison=True),
     )
+    m = exercise_sweep(
+        range(seed0, seed0 + (schedules - 2 * third)),
+        lambda s: exercise_mailbox(s, poison=True),
+    )
     return {
-        "schedules": q["schedules"] + p["schedules"],
+        "schedules": q["schedules"] + p["schedules"] + m["schedules"],
         "queue": q,
         "publisher": p,
-        "races": q["races"] + p["races"],
+        "mailbox": m,
+        "races": q["races"] + p["races"] + m["races"],
     }

@@ -646,8 +646,8 @@ def register_offpolicy_warmups(module: str, aliases, *,
     def _act(ctx):
         import numpy as np
 
-        if ctx.fused or ctx.algo not in aliases:
-            return None
+        if ctx.fused or ctx.algo not in aliases or ctx.async_actors:
+            return None  # async actors always act through the mirror
         actor_abs = _learner_abs(ctx).actor_params
         if mirror_active(ctx, actor_abs):
             return None  # the numpy mirror explores; never dispatched
@@ -666,7 +666,11 @@ def register_offpolicy_warmups(module: str, aliases, *,
         from actor_critic_tpu.algos.common import OffPolicyTransition
 
         cfg = ctx.cfg
-        K, E = cfg.steps_per_iter, cfg.num_envs
+        # Async actor fleets feed per-actor [K, E/A] blocks (ISSUE 9
+        # satellite: off-policy through ActorService); the lockstep
+        # loop ingests the full [K, E] block.
+        K = cfg.steps_per_iter
+        E = cfg.num_envs // ctx.async_actors if ctx.async_actors else cfg.num_envs
         learner_abs = _learner_abs(ctx)
         traj = OffPolicyTransition(
             obs=host_obs_struct(ctx, (K, E)),

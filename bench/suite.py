@@ -597,6 +597,36 @@ def bench_scenario_fleet():
     }
 
 
+def bench_multihost_scaling():
+    """Multi-host distributed learner scaling (ISSUE 9 acceptance row):
+    the `scripts/launch_multihost.py --bench` grid — aggregate consumed
+    env-steps/s of a CPU local cluster at 1/2/4 processes (sync
+    all-reduce over the global mesh), the gossip/ring variant, and the
+    straggler A/B in which the synchronous fleet stalls at the barrier
+    while gossip degrades only the slow host. Wall-bounded runs on the
+    sleep-padded CartPole testbed; headline value = sync aggregate
+    speedup at 4 processes vs 1 (target >= 1.5x), with
+    straggler.gossip_over_sync carrying the straggler-does-not-stall
+    evidence. BENCH_MULTIHOST_DURATION overrides the per-run window
+    (seconds; default 6 keeps the 6-run grid inside the cpu_metrics
+    per-metric timeout)."""
+    import subprocess
+
+    launcher = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts", "launch_multihost.py",
+    )
+    duration = os.environ.get("BENCH_MULTIHOST_DURATION", "6")
+    proc = subprocess.run(
+        [sys.executable, launcher, "--bench", "--duration-s", duration],
+        capture_output=True, text=True, check=True,
+    )
+    lines = [
+        ln for ln in proc.stdout.strip().splitlines() if ln.startswith("{")
+    ]
+    return json.loads(lines[-1])
+
+
 def bench_mujoco_host():
     """Raw MuJoCo host-stepping rate through HostEnvPool (E=8,
     HalfCheetah-v5) — the 1-core host bound that caps every host-env
@@ -708,6 +738,7 @@ BENCHES = {
     "async_decoupling": bench_async_decoupling,
     "update_wall": bench_update_wall,
     "replay_sample_throughput": bench_replay_sample_throughput,
+    "multihost_scaling": bench_multihost_scaling,
     "scenario_fleet": bench_scenario_fleet,
     "mujoco": bench_mujoco_host,
     "pallas": bench_pallas_ops,

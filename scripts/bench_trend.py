@@ -7,8 +7,11 @@ even though PR 6 started attaching a CPU-measured `cpu_metrics` block to
 EVERY record. This script is the second half of ROADMAP's "Bench
 resilience" item: it trends the WHOLE block across rounds, so
 regressions in host_pool_scaling / startup_to_first_step /
-async_decoupling / update_wall / replay_sample_throughput are visible
-even across rounds whose TPU headline never ran.
+async_decoupling / update_wall / replay_sample_throughput /
+multihost_scaling are visible even across rounds whose TPU headline
+never ran. The multihost record additionally expands into
+per-process-count sub-rows (its sync scaling curve) and the straggler
+gossip-over-sync ratio.
 
 Usage:
     python scripts/bench_trend.py            # repo-root BENCH_r*.json
@@ -128,6 +131,70 @@ def cpu_cell(rec: dict | None, name: str) -> str:
     return _fmt(entry.get("value"))
 
 
+def _multihost_entry(rec: dict | None):
+    """(entry, None) when the round carries a well-formed
+    multihost_scaling dict, else (None, sentinel cell) — the shared
+    presence/malformed ladder of every multihost sub-row: `?` for an
+    unparseable round, `-` before the metric existed, `err` for a
+    failed subprocess, `?` for a present-but-malformed entry."""
+    if rec is None:
+        return None, "?"
+    block = rec.get("cpu_metrics")
+    if not isinstance(block, dict) or "multihost_scaling" not in block:
+        return None, "-"
+    entry = block["multihost_scaling"]
+    if not isinstance(entry, dict):
+        return None, "?"
+    if "error" in entry:
+        return None, "err"
+    return entry, None
+
+
+def _numeric_cell(value) -> str:
+    return _fmt(value) if isinstance(value, (int, float)) else "?"
+
+
+def multihost_proc_counts(recs: list[dict | None]) -> list[int]:
+    """Union of sync-curve process counts across rounds (the ISSUE 9
+    record nests per-process-count runs under `sync`)."""
+    counts: set[int] = set()
+    for rec in recs:
+        entry, _ = _multihost_entry(rec)
+        sync = entry.get("sync") if entry else None
+        if isinstance(sync, dict):
+            for k in sync:
+                if str(k).isdigit():
+                    counts.add(int(k))
+    return sorted(counts)
+
+
+def multihost_proc_cell(rec: dict | None, n: int) -> str:
+    """Aggregate consumed env-steps/s of the n-process sync run."""
+    entry, cell = _multihost_entry(rec)
+    if entry is None:
+        return cell
+    sync = entry.get("sync")
+    if not isinstance(sync, dict):
+        return "?"
+    sub = sync.get(str(n))
+    if sub is None:
+        return "-"
+    if not isinstance(sub, dict):
+        return "?"
+    return _numeric_cell(sub.get("aggregate_steps_per_s"))
+
+
+def multihost_straggler_cell(rec: dict | None) -> str:
+    """The straggler A/B ratio (gossip over sync fleet throughput)."""
+    entry, cell = _multihost_entry(rec)
+    if entry is None:
+        return cell
+    straggler = entry.get("straggler")
+    if not isinstance(straggler, dict):
+        return "?"
+    return _numeric_cell(straggler.get("gossip_over_sync"))
+
+
 def trend_rows(root: str) -> tuple[list[int], list[tuple[str, list[str]]]]:
     """(round numbers, [(row label, cells per round)]) — the table body.
 
@@ -146,6 +213,20 @@ def trend_rows(root: str) -> tuple[list[int], list[tuple[str, list[str]]]]:
     rows = [("tpu_headline", [headline_cell(r) for r in recs])]
     for name in names:
         rows.append((name, [cpu_cell(r, name) for r in recs]))
+        if name == "multihost_scaling":
+            # Per-process-count sub-rows (ISSUE 9): the sync scaling
+            # curve, one row per process count ever benchmarked, plus
+            # the straggler A/B ratio — so a scaling regression at one
+            # fleet size is visible even when the headline ratio holds.
+            for n in multihost_proc_counts(recs):
+                rows.append((
+                    f"multihost_scaling.p{n}",
+                    [multihost_proc_cell(r, n) for r in recs],
+                ))
+            rows.append((
+                "multihost_scaling.straggler_gossip_x",
+                [multihost_straggler_cell(r) for r in recs],
+            ))
     return rounds, rows
 
 

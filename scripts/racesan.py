@@ -43,8 +43,9 @@ def main(argv=None) -> int:
         "tier-1 deterministic)",
     )
     p.add_argument(
-        "--scenario", choices=("all", "queue", "publisher"), default="all",
-        help="which unit to exercise (default: both, split evenly)",
+        "--scenario", choices=("all", "queue", "publisher", "mailbox"),
+        default="all",
+        help="which unit to exercise (default: all three, split evenly)",
     )
     p.add_argument(
         "--consumer", choices=("snapshot", "alias"), default="snapshot",
@@ -73,6 +74,11 @@ def main(argv=None) -> int:
                 lambda s: racesan.exercise_queue(
                     s, poison=poison, consumer=args.consumer
                 ),
+            )
+        elif args.scenario == "mailbox":
+            out = racesan.exercise_sweep(
+                range(args.seed0, args.seed0 + args.schedules),
+                lambda s: racesan.exercise_mailbox(s, poison=poison),
             )
         else:
             out = racesan.exercise_sweep(

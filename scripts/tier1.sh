@@ -9,8 +9,15 @@ cd "$(dirname "$0")/.." || exit 1
 # distinct so CI logs tell them apart).
 env JAX_PLATFORMS=cpu python scripts/jaxlint.py actor_critic_tpu train.py bench --error-on-new || exit $?
 # Race sanitizer quick profile (ISSUE 7): 100 fixed-seed cooperative
-# schedules over the queue/publisher units, under its OWN timeout so a
-# schedule hang (exit 124) cannot eat the pytest budget below
-# (exit 1 = race detected, 2 = exerciser crash).
+# schedules over the queue/publisher/mailbox units, under its OWN
+# timeout so a schedule hang (exit 124) cannot eat the pytest budget
+# below (exit 1 = race detected, 2 = exerciser crash).
 timeout -k 5 120 env JAX_PLATFORMS=cpu python scripts/racesan.py --schedules 100 || exit $?
+# Multi-process CPU smoke (ISSUE 9): a 2-process jax.distributed local
+# cluster must come up against a localhost coordinator, train a few
+# blocks through the global-mesh learner, and agree bit-exactly on the
+# broadcast version counter + replicated-params fingerprint. Its OWN
+# timeout, like the racesan step: a hung coordinator (wedged port,
+# dead worker) must exit 124 here, not eat the pytest budget.
+timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/launch_multihost.py --smoke || exit $?
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

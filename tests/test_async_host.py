@@ -291,6 +291,70 @@ def test_sleep_pad_cartpole_is_real_cartpole():
     ref.close()
 
 
+# ------------------------------------------- off-policy actor services
+
+def test_offpolicy_async_ddpg_trains_and_accounts_steps():
+    """ISSUE 9 satellite: --async-actors is no longer PPO-only — the
+    DDPG/TD3 host loop drives collection through ActorService threads
+    and the learner ingests queued blocks into the replay ring (replay
+    absorbs behavior staleness; no correction knob)."""
+    from actor_critic_tpu.algos import ddpg
+
+    cfg = ddpg.DDPGConfig(
+        num_envs=2, steps_per_iter=4, updates_per_iter=1,
+        buffer_capacity=256, batch_size=8, warmup_steps=16, hidden=(16,),
+    )
+    pools = [
+        HostEnvPool(
+            "Pendulum-v1", 1, seed=0,
+            normalize_obs=False, normalize_reward=False,
+        ),
+        HostEnvPool(
+            "Pendulum-v1", 1, seed=100003,
+            normalize_obs=False, normalize_reward=False,
+        ),
+    ]
+    try:
+        learner, hist = ddpg.train_host_async(
+            pools, cfg, 12, seed=0, log_every=1,
+            eval_every=6, eval_steps=50,
+        )
+    finally:
+        for p in pools:
+            p.close()
+    rows = dict(hist)
+    assert sorted(rows) == list(range(1, 13))
+    last = rows[12]
+    assert np.isfinite(last["critic_loss"]) and np.isfinite(last["q_mean"])
+    # The fleet collected at least what the learner consumed, and the
+    # ring really ingested the consumed blocks.
+    assert last["env_steps"] >= last["consumed_env_steps"]
+    assert int(learner.replay.size) > 0
+    assert "eval_return" in rows[6] and np.isfinite(rows[6]["eval_return"])
+
+
+def test_offpolicy_async_sac_smoke():
+    from actor_critic_tpu.algos import sac
+
+    cfg = sac.SACConfig(
+        num_envs=1, steps_per_iter=4, updates_per_iter=1,
+        buffer_capacity=128, batch_size=8, warmup_steps=8, hidden=(16,),
+    )
+    pool = HostEnvPool(
+        "Pendulum-v1", 1, seed=0,
+        normalize_obs=False, normalize_reward=False,
+    )
+    try:
+        learner, hist = sac.train_host_async(
+            [pool], cfg, 6, seed=0, log_every=1,
+        )
+    finally:
+        pool.close()
+    assert len(hist) == 6
+    assert np.isfinite(hist[-1][1]["critic_loss"])
+    assert int(learner.replay.size) > 0
+
+
 # --------------------------------------------- compile-count regression
 
 def test_async_learner_steady_state_zero_recompiles(tmp_path):

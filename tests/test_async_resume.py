@@ -1,0 +1,119 @@
+"""Checkpoint/resume under --async-actors (ISSUE 9 satellite; mirrors
+tests/test_host_resume.py for the async actor–learner driver).
+
+Async resume contract: the device state (params/opt/PRNG) restores
+EXACTLY, and the save tree carries ALL A per-actor pools' normalizer
+states (`host_loop.async_host_ckpt_state`) — each actor pool runs
+independent running stats, so every one must round-trip; actor
+collection restarts fresh episodes, same as the lockstep contract.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from actor_critic_tpu.algos import ppo
+from actor_critic_tpu.envs.host_pool import HostEnvPool
+from actor_critic_tpu.utils.checkpoint import Checkpointer
+
+
+def _tiny_cfg():
+    return ppo.PPOConfig(
+        num_envs=4, rollout_steps=8, epochs=1, num_minibatches=1,
+        hidden=(16,),
+    )
+
+
+def _pools():
+    # Two actors, disjoint seed strides (the build_actor_pools layout).
+    return [
+        HostEnvPool("CartPole-v1", 2, seed=0),
+        HostEnvPool("CartPole-v1", 2, seed=100003),
+    ]
+
+
+def _trees_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_async_resume_restores_exact_state(tmp_path):
+    cfg = _tiny_cfg()
+    pools = _pools()
+    with Checkpointer(tmp_path / "ck") as ck:
+        p1, o1, _ = ppo.train_host_async(
+            pools, cfg, 3, seed=0, log_every=0, ckpt=ck, save_every=2,
+        )
+        ck.wait()
+        assert ck.latest_step() == 3
+    for p in pools:
+        p.close()
+
+    # "New process": fresh pools, resume finds the run complete — no
+    # actors start (restored normalizer stats stay untouched), history
+    # is empty, device state is bit-equal.
+    pools2 = _pools()
+    with Checkpointer(tmp_path / "ck") as ck:
+        p2, o2, history = ppo.train_host_async(
+            pools2, cfg, 3, seed=0, log_every=0, ckpt=ck, resume=True,
+        )
+    _trees_equal(p1, p2)
+    _trees_equal(o1, o2)
+    assert history == []
+    # EVERY actor pool's normalizer state came back through set_state
+    # (count > the single reset batch a fresh pool would carry).
+    for pool in pools2:
+        assert float(pool.obs_rms.count) > 100.0, float(pool.obs_rms.count)
+    for p in pools2:
+        p.close()
+
+
+def test_async_resume_continues_training(tmp_path):
+    cfg = _tiny_cfg()
+    pools = _pools()
+    with Checkpointer(tmp_path / "ck") as ck:
+        ppo.train_host_async(
+            pools, cfg, 2, seed=0, log_every=0, ckpt=ck, save_every=1,
+        )
+        ck.wait()
+    for p in pools:
+        p.close()
+
+    pools2 = _pools()
+    with Checkpointer(tmp_path / "ck") as ck:
+        _, _, history = ppo.train_host_async(
+            pools2, cfg, 4, seed=0, log_every=1, ckpt=ck, save_every=1,
+            resume=True,
+        )
+        assert ck.latest_step() == 4
+    # Only blocks 3..4 were consumed (1-based iteration ids).
+    assert [it for it, _ in history] == [3, 4]
+    for p in pools2:
+        p.close()
+
+
+def test_async_resume_rejects_changed_actor_count(tmp_path):
+    """The save tree carries one normalizer state per actor pool;
+    resuming with a different --async-actors silently misassigns env
+    shards' statistics — refuse loudly instead."""
+    cfg = _tiny_cfg()
+    pools = _pools()
+    with Checkpointer(tmp_path / "ck") as ck:
+        ppo.train_host_async(
+            pools, cfg, 2, seed=0, log_every=0, ckpt=ck, save_every=1,
+        )
+        ck.wait()
+    for p in pools:
+        p.close()
+
+    one_pool = [HostEnvPool("CartPole-v1", 4, seed=0)]
+    with Checkpointer(tmp_path / "ck") as ck:
+        with pytest.raises(ValueError, match="original --async-actors"):
+            ppo.train_host_async(
+                one_pool, cfg, 4, seed=0, log_every=0, ckpt=ck,
+                resume=True,
+            )
+    for p in one_pool:
+        p.close()

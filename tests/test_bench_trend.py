@@ -65,6 +65,64 @@ def test_trend_rows_union_and_cells(tmp_path):
     assert table["replay_sample_throughput"][1] != "-"
 
 
+def _write_multihost_rounds(root: Path):
+    """r01 without the metric, r02 a full multihost record, r03 a
+    malformed one (sync curve not a dict), r04 an unparseable file."""
+    (root / "BENCH_r01.json").write_text(json.dumps({
+        "metric": "a2c", "value": 1.0,
+        "cpu_metrics": {"host_pool_scaling": {"value": 3.0}},
+    }) + "\n")
+    (root / "BENCH_r02.json").write_text(json.dumps({
+        "metric": "a2c", "value": 1.0,
+        "cpu_metrics": {
+            "multihost_scaling": {
+                "value": 1.95,
+                "sync": {
+                    "1": {"aggregate_steps_per_s": 94.2},
+                    "2": {"aggregate_steps_per_s": 162.6},
+                    "4": {"aggregate_steps_per_s": 184.0},
+                },
+                "straggler": {"gossip_over_sync": 2.01},
+            },
+        },
+    }) + "\n")
+    (root / "BENCH_r03.json").write_text(json.dumps({
+        "metric": "a2c", "value": 1.0,
+        "cpu_metrics": {
+            "multihost_scaling": {
+                "value": 0.5, "sync": "oops",
+                "straggler": {"gossip_over_sync": None},
+            },
+        },
+    }) + "\n")
+    (root / "BENCH_r04.json").write_text("{not json")
+
+
+def test_multihost_per_process_rows(tmp_path):
+    """ISSUE 9 satellite: the multihost_scaling record expands into one
+    sub-row per sync process count plus the straggler ratio; '-' before
+    the metric existed, '?' for malformed sub-records."""
+    mod = _load()
+    _write_multihost_rounds(tmp_path)
+    rounds, rows = mod.trend_rows(str(tmp_path))
+    assert rounds == [1, 2, 3, 4]
+    table = dict(rows)
+    assert table["multihost_scaling"] == ["-", "1.95", "0.5", "?"]
+    assert table["multihost_scaling.p1"] == ["-", "94.2", "?", "?"]
+    assert table["multihost_scaling.p2"] == ["-", "162.6", "?", "?"]
+    assert table["multihost_scaling.p4"] == ["-", "184", "?", "?"]
+    assert table["multihost_scaling.straggler_gossip_x"] == [
+        "-", "2.01", "?", "?",
+    ]
+    # Sub-rows sit directly under the main multihost row.
+    labels = [label for label, _ in rows]
+    main = labels.index("multihost_scaling")
+    assert labels[main + 1 : main + 4] == [
+        "multihost_scaling.p1", "multihost_scaling.p2",
+        "multihost_scaling.p4",
+    ]
+
+
 def test_render_and_cli(tmp_path, capsys):
     mod = _load()
     _write_rounds(tmp_path)

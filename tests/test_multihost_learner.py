@@ -1,0 +1,269 @@
+"""Multi-host distributed learner (ISSUE 9, parallel/multihost.py).
+
+Fast units run un-marked: the param mailbox's latest-wins frozen-
+snapshot contract, the gossip ring schedule's full-fleet coverage, the
+mixing step, the filesystem mailbox transport (atomic publish + torn-
+read tolerance), the FileMailboxWriter thread (the `mailbox` role the
+thread model learns), and the launcher's fleet-trace merge.
+
+The multi-process cluster exercises are `slow` (each spawns fresh
+interpreters against a localhost coordinator); tier-1 covers the
+2-process sync path through `scripts/tier1.sh`'s own smoke step
+(`launch_multihost.py --smoke`, under its own timeout), and the
+`multihost_scaling` bench record carries the 1/2/4-process evidence.
+"""
+
+import importlib.util
+import json
+import os
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from actor_critic_tpu.parallel import multihost
+
+REPO = Path(__file__).parent.parent
+
+
+def _load_launcher():
+    spec = importlib.util.spec_from_file_location(
+        "launch_multihost", REPO / "scripts" / "launch_multihost.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ------------------------------------------------------------- ParamMailbox
+
+def test_mailbox_latest_wins_and_take_once():
+    mb = multihost.ParamMailbox()
+    assert mb.take() is None and mb.peek() is None
+    mb.deposit({"w": np.ones(2, np.float32)}, version=1, peer=2)
+    mb.deposit({"w": np.full(2, 2.0, np.float32)}, version=3, peer=1)
+    version, peer, params = mb.take()
+    assert (version, peer) == (3, 1)
+    assert float(params["w"][0]) == 2.0
+    assert mb.take() is None          # consumed; nothing newer yet
+    assert mb.peek()[0] == 3          # peek never consumes
+    # Same-peer regression is dropped — the learner must never mix one
+    # peer backwards.
+    assert not mb.deposit({"w": np.zeros(2, np.float32)}, version=1, peer=1)
+    assert mb.take() is None
+    # But versions are PER-PEER clocks: a lower-numbered snapshot from a
+    # DIFFERENT peer (the ring rotated onto a slower host) still lands —
+    # a slow peer must keep diffusing, not be muted by the fastest
+    # version ever seen.
+    assert mb.deposit({"w": np.full(2, 5.0, np.float32)}, version=2, peer=0)
+    version, peer, params = mb.take()
+    assert (version, peer) == (2, 0)
+    assert float(params["w"][0]) == 5.0
+    assert mb.stats()["deposits"] == 3
+
+
+def test_mailbox_frozen_snapshot_contract():
+    """Same contract as PolicyPublisher.publish (ISSUE 7): the stored
+    tree is a read-only COPY — the depositor keeps no writable alias of
+    what the learner consumes, and consumer-side mutation crashes."""
+    mb = multihost.ParamMailbox()
+    tree = {"w": np.ones(2, np.float32)}
+    mb.deposit(tree, version=1, peer=0)
+    tree["w"][0] = 9.0                # depositor's own tree: writable
+    _, _, stored = mb.take()
+    assert float(stored["w"][0]) == 1.0  # snapshot taken before the 9.0
+    with pytest.raises(ValueError, match="read-only"):
+        stored["w"][0] = 3.0
+
+
+# ------------------------------------------------------- gossip ring + mix
+
+def test_gossip_peer_rotates_through_whole_fleet():
+    for world in (2, 3, 4, 8):
+        for rank in range(world):
+            peers = {
+                multihost.gossip_peer(rank, world, r)
+                for r in range(world - 1)
+            }
+            assert peers == set(range(world)) - {rank}, (rank, world)
+
+
+def test_gossip_peer_rejects_singleton_fleet():
+    with pytest.raises(ValueError, match="at least 2"):
+        multihost.gossip_peer(0, 1, 0)
+
+
+def test_mix_params_convex_and_dtype_preserving():
+    own = {"w": np.full((2,), 2.0, np.float32), "b": np.zeros((1,), np.float32)}
+    peer = {"w": np.full((2,), 4.0, np.float32), "b": np.ones((1,), np.float32)}
+    mixed = multihost.mix_params(own, peer, 0.25)
+    np.testing.assert_allclose(mixed["w"], 2.5)
+    np.testing.assert_allclose(mixed["b"], 0.25)
+    assert mixed["w"].dtype == np.float32
+    # weight 0 = own, weight 1 = peer
+    np.testing.assert_allclose(multihost.mix_params(own, peer, 0.0)["w"], 2.0)
+    np.testing.assert_allclose(multihost.mix_params(own, peer, 1.0)["w"], 4.0)
+
+
+# ----------------------------------------------------- filesystem transport
+
+def test_write_read_params_roundtrip(tmp_path):
+    params = {
+        "a": np.arange(6, dtype=np.float32).reshape(2, 3),
+        "nested": {"b": np.full((4,), 7.0, np.float32)},
+    }
+    multihost.write_params(str(tmp_path), 3, 11, params)
+    out = multihost.read_params(str(tmp_path), 3, params)
+    assert out is not None
+    version, tree = out
+    assert version == 11
+    np.testing.assert_array_equal(tree["a"], params["a"])
+    np.testing.assert_array_equal(tree["nested"]["b"], params["nested"]["b"])
+    # Unpublished peer: None, not an exception.
+    assert multihost.read_params(str(tmp_path), 9, params) is None
+    # Overwrite is latest-wins (one file per host).
+    multihost.write_params(str(tmp_path), 3, 12, params)
+    assert multihost.read_params(str(tmp_path), 3, params)[0] == 12
+    # No .tmp litter after the atomic replace.
+    host_dir = tmp_path / "host3"
+    assert [p.name for p in host_dir.iterdir()] == ["params.npz"]
+
+
+def test_read_params_tolerates_garbage_file(tmp_path):
+    path = Path(multihost.params_file(str(tmp_path), 0))
+    path.parent.mkdir(parents=True)
+    path.write_bytes(b"definitely not an npz")
+    assert multihost.read_params(str(tmp_path), 0, {"w": np.ones(1)}) is None
+
+
+def test_file_mailbox_writer_deposits_scheduled_peer(tmp_path):
+    """The mailbox-writer thread (role `mailbox` in the thread model)
+    polls the ring-scheduled peer's snapshot into the in-memory
+    mailbox; fresh versions land, the learner's `set_round` redirects
+    it."""
+    template = {"w": np.zeros((2,), np.float32)}
+    # world=3, rank=0: round 0 reads peer 1, round 1 reads peer 2.
+    multihost.write_params(str(tmp_path), 1, 5, {"w": np.full((2,), 1.0, np.float32)})
+    multihost.write_params(str(tmp_path), 2, 9, {"w": np.full((2,), 2.0, np.float32)})
+    mailbox = multihost.ParamMailbox()
+    stop = threading.Event()
+    writer = multihost.FileMailboxWriter(
+        str(tmp_path), 0, 3, template=template, mailbox=mailbox,
+        stop=stop, poll_s=0.01,
+    ).start()
+    try:
+        deadline = time.monotonic() + 5.0
+        out = None
+        while out is None and time.monotonic() < deadline:
+            out = mailbox.take()
+            time.sleep(0.01)
+        assert out is not None, "writer never deposited"
+        version, peer, params = out
+        assert (version, peer) == (5, 1)
+        assert float(params["w"][0]) == 1.0
+        writer.set_round(1)  # ring advances to peer 2
+        out = None
+        while out is None and time.monotonic() < deadline:
+            out = mailbox.take()
+            time.sleep(0.01)
+        assert out is not None
+        assert (out[0], out[1]) == (9, 2)
+    finally:
+        stop.set()
+        writer.join(timeout=5.0)
+    assert writer.error is None
+
+
+def test_thread_model_learns_mailbox_writer_role():
+    """ISSUE 9 satellite: the concurrency passes' whole-repo thread
+    model must resolve the FileMailboxWriter spawn to the `mailbox`
+    role (its shared round counter carries the audited thread-owned
+    annotation the passes rely on)."""
+    from actor_critic_tpu.analysis.core import load_modules
+    from actor_critic_tpu.analysis.thread_model import ThreadModel
+
+    path = str(REPO / "actor_critic_tpu" / "parallel" / "multihost.py")
+    model = ThreadModel(load_modules([path], str(REPO)))
+    spawns = [
+        s for s in model.spawns
+        if s.target_class == "FileMailboxWriter"
+    ]
+    assert spawns and spawns[0].role == "mailbox", model.spawns
+    cls = model.classes[
+        ("actor_critic_tpu/parallel/multihost.py", "FileMailboxWriter")
+    ]
+    assert "_run" in cls.thread_methods["mailbox"]
+    assert cls.owned_attrs.get("_round") == "caller"
+
+
+# -------------------------------------------------------- launcher helpers
+
+def test_merge_host_traces_aligns_clocks(tmp_path):
+    launcher = _load_launcher()
+    for rank, epoch0 in ((0, 100.0), (1, 102.5)):
+        host_dir = tmp_path / f"host{rank}"
+        host_dir.mkdir()
+        events = [
+            {"name": "process_name", "ph": "M", "pid": 1000 + rank,
+             "tid": 0, "args": {"name": f"host{rank}"}},
+            {"name": "clock_sync", "ph": "M", "pid": 1000 + rank,
+             "tid": 0, "args": {"unix_epoch_at_ts0": epoch0}},
+            {"name": "iteration", "ph": "X", "ts": 10.0, "dur": 5.0,
+             "pid": 1000 + rank, "tid": 1, "cat": "phase"},
+        ]
+        (host_dir / "spans.jsonl").write_text(
+            "\n".join(json.dumps(e) for e in events) + "\n"
+        )
+    out = launcher.merge_host_traces(str(tmp_path), 2)
+    assert out and os.path.exists(out)
+    merged = [json.loads(ln) for ln in open(out)]
+    spans = {e["pid"]: e for e in merged if e.get("ph") == "X"}
+    # host0 anchors the axis; host1's events shift by the epoch delta.
+    assert spans[1000]["ts"] == 10.0
+    assert spans[1001]["ts"] == pytest.approx(10.0 + 2.5e6)
+    # Per-host process_name lanes survive the merge.
+    names = {
+        e["pid"]: e["args"]["name"]
+        for e in merged if e.get("name") == "process_name"
+    }
+    assert names == {1000: "host0", 1001: "host1"}
+
+
+def test_block_spec_shards_env_axis():
+    from jax.sharding import PartitionSpec as P
+
+    from actor_critic_tpu.parallel.mesh import DP_AXIS
+
+    assert multihost._block_spec(2) == P(None, DP_AXIS)
+    assert multihost._block_spec(3) == P(None, DP_AXIS, None)
+
+
+# ------------------------------------------------- multi-process clusters
+
+@pytest.mark.slow
+def test_two_process_sync_cluster_bit_consistent():
+    """The acceptance row's 2-process leg: a localhost jax.distributed
+    cluster trains real blocks through the global-mesh update and every
+    iteration's all-reduced version counter and params fingerprint
+    match `world x local` bit-exactly."""
+    launcher = _load_launcher()
+    rec = launcher.run_cluster(
+        2, "sync", iterations=4, rollout_steps=8, num_envs=2, actors=1,
+        sleep_s=0.0, timeout_s=300.0,
+    )
+    assert rec["version_consistent"], rec
+    assert rec["fingerprint_consistent"], rec
+    assert rec["consumed_env_steps"] == 2 * 4 * 8 * 2
+
+
+@pytest.mark.slow
+def test_two_process_gossip_cluster_mixes_without_barrier():
+    launcher = _load_launcher()
+    rec = launcher.run_cluster(
+        2, "gossip", iterations=8, rollout_steps=8, num_envs=2,
+        actors=1, sleep_s=0.0, timeout_s=300.0,
+    )
+    assert rec["gossip_mixes"] > 0, rec
+    assert rec["consumed_env_steps"] == 2 * 8 * 8 * 2

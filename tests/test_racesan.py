@@ -17,7 +17,10 @@ Four layers:
    runs (scripts/tier1.sh invokes the same profile via
    scripts/racesan.py) comes back clean on the real queue/publisher.
 
-Everything runs on plain numpy + threads: no jax import, no device.
+The queue/publisher layers run on plain numpy + threads (no jax
+import, no device); the param-mailbox layer (ISSUE 9) imports
+`parallel.multihost`, which pulls jax transitively — import only,
+still no device work.
 """
 
 import numpy as np
@@ -216,7 +219,46 @@ def test_quick_profile_sweeps_clean():
     out = racesan.quick_profile(schedules=100)
     assert out["schedules"] == 100
     assert out["races"] == 0
-    # the sweep actually exercised both units
+    # the sweep actually exercised all three units
     assert out["queue"]["consumed"] > 0
     assert out["publisher"]["reads"] > 0
     assert out["publisher"]["published"] > 0
+    assert out["mailbox"]["deposits"] > 0
+    assert out["mailbox"]["takes"] > 0
+
+
+# ---------------------------------------------------------------------------
+# the multihost param mailbox (ISSUE 9 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_mailbox_exerciser_sweeps_clean_with_poison():
+    out = racesan.exercise_sweep(
+        range(10), lambda s: racesan.exercise_mailbox(s, poison=True)
+    )
+    assert out["races"] == 0
+    assert out["deposits"] > 0 and out["takes"] > 0
+
+
+def test_buggy_depositor_is_detected_at_the_write_site():
+    """A mailbox writer refreshing its RETAINED tree in place after
+    depositing — the write-after-publish class — crashes at the write
+    under the poisoner on every schedule (frozen-snapshot contract,
+    same as PolicyPublisher.publish)."""
+    for seed in range(3):
+        with pytest.raises(ValueError, match="read-only"):
+            racesan.exercise_mailbox(seed, buggy_depositor=True)
+
+
+def test_hardened_mailbox_freezes_consumer_view_and_spares_depositor():
+    from actor_critic_tpu.parallel.multihost import ParamMailbox
+
+    mb = ParamMailbox()
+    tree = {"w": np.ones((2,), np.float32)}
+    mb.deposit(tree, version=1, peer=0)
+    tree["w"][0] = 9.0  # depositor's own tree: still writable
+    version, peer, stored = mb.take()
+    assert version == 1
+    assert float(stored["w"][0]) == 1.0  # snapshot taken BEFORE the 9.0
+    with pytest.raises(ValueError, match="read-only"):
+        stored["w"][0] = 3.0

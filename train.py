@@ -367,11 +367,15 @@ def build_actor_pools(preset, args, actors: int) -> list:
             "jax:* envs fuse rollouts into the update program and have "
             "nothing to decouple"
         )
-    if preset.algo != "ppo":
+    if preset.algo not in ("ppo", "ddpg", "td3", "sac"):
         raise SystemExit(
-            "--async-actors currently drives the PPO host trainer "
-            "(ppo.train_host_async); other host algos run lockstep"
+            f"--async-actors drives the host trainers (ppo/ddpg/td3/"
+            f"sac); {preset.algo} has no host loop to decouple"
         )
+    # Same normalization policy as the lockstep pools (build_env): PPO
+    # wants running obs/reward normalization; the off-policy algos must
+    # store RAW transitions (drifting stats re-scale replayed frames).
+    on_policy = preset.algo == "ppo"
     cfg = preset.config
     if actors > cfg.num_envs or cfg.num_envs % actors != 0:
         raise SystemExit(
@@ -380,6 +384,12 @@ def build_actor_pools(preset, args, actors: int) -> list:
             "keeps the learner on a single compiled program)"
         )
     workers_each = max(1, args.workers // actors)
+    # Under --distributed every HOST builds its own fleet from the same
+    # --seed: without a rank stride the fleets would replay identical
+    # env reset streams and the global sync batch would carry
+    # cross-host duplicate trajectories (launch_multihost.py uses the
+    # same (rank·A + i) stride).
+    rank = args.process_id if args.distributed else 0
     return [
         HostEnvPool(
             name,
@@ -387,9 +397,9 @@ def build_actor_pools(preset, args, actors: int) -> list:
             # Large per-actor seed stride: pools seed their envs
             # [seed .. seed+E), so adjacent offsets would duplicate
             # trajectories across actors.
-            seed=args.seed + i * 100003,
-            normalize_obs=True,
-            normalize_reward=True,
+            seed=args.seed + (rank * actors + i) * 100003,
+            normalize_obs=on_policy,
+            normalize_reward=on_policy,
             backend="gym" if kind == "host" else "native",
             scale_actions=bool(args.scale_actions),
             env_kwargs=preset.env_kwargs,
@@ -399,8 +409,57 @@ def build_actor_pools(preset, args, actors: int) -> list:
     ]
 
 
+def run_multihost(pools, preset, args, logger) -> dict:
+    """One process of the distributed actor–learner fleet (ISSUE 9):
+    local actor services feed the local queue; the learner either joins
+    the global all-reduce (sync) or gossips params peer-to-peer
+    (--gossip). Launch one such process per host — or use
+    scripts/launch_multihost.py for a CPU local cluster."""
+    import jax
+
+    from actor_critic_tpu.parallel import multihost
+
+    rank = jax.process_index() if args.coordinator else args.process_id
+    world = args.num_processes
+    multihost.host_lane(rank)
+    last: dict = {}
+
+    def log_fn(it, m):
+        telemetry.observe(it, m)
+        last.clear()
+        last.update(m)
+        logger.log(it, m)
+
+    _, _, summary = multihost.train_multihost(
+        pools, preset.config, args.iterations,
+        rank=rank, world=world,
+        mode="gossip" if args.gossip else "sync",
+        seed=args.seed, log_every=args.log_every, log_fn=log_fn,
+        queue_depth=args.queue_depth,
+        max_staleness=resolve_staleness(args, "ppo"),
+        updates_per_block=args.updates_per_block,
+        correction=args.async_correction,
+        gossip=multihost.GossipConfig(
+            every=args.gossip_every, weight=args.gossip_weight,
+        ),
+        mailbox_dir=args.mailbox_dir or None,
+    )
+    last.update({f"multihost_{k}": v for k, v in summary.items()
+                 if isinstance(v, (int, float, bool))})
+    return last
+
+
+def resolve_staleness(args, algo: str):
+    """--max-staleness tri-state: explicit S >= 0 is a bound, -1 is
+    unbounded, absent picks the per-algo default (8 for PPO, unbounded
+    for the off-policy algos — replay absorbs staleness)."""
+    if args.max_staleness is None:
+        return 8 if algo == "ppo" else None
+    return args.max_staleness if args.max_staleness >= 0 else None
+
+
 def run_host_async(pools, preset, args, logger) -> dict:
-    from actor_critic_tpu.algos import ppo
+    from actor_critic_tpu.algos import ddpg, ppo, sac
 
     last: dict = {}
 
@@ -410,16 +469,41 @@ def run_host_async(pools, preset, args, logger) -> dict:
         last.update(m)
         logger.log(it, m)
 
-    ppo.train_host_async(
-        pools, preset.config, num_iterations=args.iterations,
-        seed=args.seed, log_every=args.log_every, log_fn=log_fn,
-        eval_every=args.eval_every, eval_envs=args.eval_envs,
-        eval_steps=args.eval_steps,
-        updates_per_block=args.updates_per_block,
-        queue_depth=args.queue_depth,
-        max_staleness=args.max_staleness if args.max_staleness >= 0 else None,
-        correction=args.async_correction,
-    )
+    from actor_critic_tpu.utils.checkpoint import Checkpointer
+
+    ckpt = Checkpointer(args.ckpt_dir) if args.ckpt_dir else None
+    if ckpt is not None and args.resume and ckpt.latest_step() is not None:
+        print(f"resuming from block {ckpt.latest_step()}", flush=True)
+    try:
+        if preset.algo == "ppo":
+            ppo.train_host_async(
+                pools, preset.config, num_iterations=args.iterations,
+                seed=args.seed, log_every=args.log_every, log_fn=log_fn,
+                eval_every=args.eval_every, eval_envs=args.eval_envs,
+                eval_steps=args.eval_steps,
+                updates_per_block=args.updates_per_block,
+                queue_depth=args.queue_depth,
+                max_staleness=resolve_staleness(args, "ppo"),
+                correction=args.async_correction,
+                ckpt=ckpt, save_every=args.save_every, resume=args.resume,
+            )
+        else:
+            # Off-policy (ddpg/td3/sac): replay absorbs behavior
+            # staleness, so there is no correction knob and the
+            # staleness bound defaults OFF (-1 keeps it off; >= 0 sets
+            # a bound anyway).
+            mod = ddpg if preset.algo in ("ddpg", "td3") else sac
+            mod.train_host_async(
+                pools, preset.config, num_iterations=args.iterations,
+                seed=args.seed, log_every=args.log_every, log_fn=log_fn,
+                eval_every=args.eval_every, eval_envs=args.eval_envs,
+                eval_steps=args.eval_steps,
+                queue_depth=args.queue_depth,
+                max_staleness=resolve_staleness(args, preset.algo),
+            )
+    finally:
+        if ckpt is not None:
+            ckpt.close()
     return last
 
 
@@ -574,11 +658,13 @@ def main(argv=None) -> int:
         "clipped surrogate + V-trace targets keep reuse sound)",
     )
     p.add_argument(
-        "--max-staleness", type=int, default=8, metavar="S",
+        "--max-staleness", type=int, default=None, metavar="S",
         help="async mode: drop blocks whose behavior-policy version "
         "lags the learner by more than S at consumption (back-pressure "
         "drops the OLDEST data rather than blocking actors); -1 = "
-        "unbounded",
+        "unbounded. Default: 8 for PPO (on-policy freshness matters), "
+        "unbounded for ddpg/td3/sac (replay absorbs staleness — a "
+        "stale block is still valid off-policy experience)",
     )
     p.add_argument(
         "--queue-depth", type=int, default=4, metavar="D",
@@ -592,6 +678,42 @@ def main(argv=None) -> int:
         "default) or 'none' (plain GAE under the recorded behavior "
         "values; tolerates small staleness, A3C-style)",
     )
+    p.add_argument(
+        "--distributed", action="store_true",
+        help="multi-host learner (parallel/multihost.py): this process "
+        "is one host of a jax.distributed fleet — its actor fleet "
+        "(--async-actors, host PPO only) feeds a local queue and the "
+        "learner data-shards update batches across the global device "
+        "mesh (or gossips params with --gossip). Requires --coordinator "
+        "+ --num-processes + --process-id (or --gossip with a shared "
+        "--mailbox-dir). For a CPU local cluster use "
+        "scripts/launch_multihost.py instead.",
+    )
+    p.add_argument(
+        "--coordinator", metavar="HOST:PORT", default="",
+        help="jax.distributed coordinator address (rank 0's host). "
+        "Needed for the sync all-reduce mode; optional under --gossip "
+        "(peer-to-peer exchange never enters a collective).",
+    )
+    p.add_argument("--num-processes", type=int, default=1,
+                   help="fleet size under --distributed")
+    p.add_argument("--process-id", type=int, default=0,
+                   help="this host's rank under --distributed")
+    p.add_argument(
+        "--gossip", action="store_true",
+        help="distributed mode: exchange parameters peer-to-peer on a "
+        "rotating ring schedule (no global barrier — a straggler host "
+        "degrades fleet throughput instead of stalling it) instead of "
+        "the synchronous all-reduce learner",
+    )
+    p.add_argument("--gossip-every", type=int, default=1, metavar="N",
+                   help="consumed blocks between gossip exchanges")
+    p.add_argument("--gossip-weight", type=float, default=0.5, metavar="W",
+                   help="peer mixing weight in [0, 1]: params <- "
+                   "(1-W) own + W peer")
+    p.add_argument("--mailbox-dir", default="",
+                   help="shared directory for the gossip param mailbox "
+                   "(required for --gossip with more than one host)")
     p.add_argument(
         "--replay-dtype", choices=("fp32", "mixed", "int8"), default=None,
         help="off-policy algos (ddpg/td3/sac): replay-ring storage codec "
@@ -693,6 +815,52 @@ def main(argv=None) -> int:
     if args.iterations is None:
         args.iterations = preset.iterations
 
+    if args.distributed:
+        # Every doomed flag combination exits HERE, before the blocking
+        # coordinator handshake below (a misconfigured fleet member
+        # hanging at jax.distributed.initialize is far worse than a
+        # SystemExit). Resolving the preset first costs only module
+        # imports — the XLA backend stays uninitialized until pools /
+        # params / warmup touch it, which all happen after.
+        if args.async_actors <= 0:
+            raise SystemExit(
+                "--distributed drives the async actor–learner stack: "
+                "each host runs its own actor fleet — pass "
+                "--async-actors N (host PPO)"
+            )
+        if preset.algo != "ppo":
+            raise SystemExit(
+                "--distributed drives the PPO multi-host learner "
+                "(parallel/multihost.py); the off-policy async drivers "
+                "are single-host — drop --distributed or use --algo ppo"
+            )
+        if not args.gossip and not args.coordinator:
+            raise SystemExit(
+                "--distributed sync mode needs --coordinator HOST:PORT "
+                "(+ --num-processes/--process-id); or pass --gossip for "
+                "the peer-to-peer mode"
+            )
+        if not args.gossip and args.async_correction != "vtrace":
+            raise SystemExit(
+                "--distributed sync mode shard_maps the V-trace-"
+                "corrected update; --async-correction none is not "
+                "supported there (gossip mode and single-host async "
+                "accept it)"
+            )
+        if args.gossip and args.num_processes > 1 and not args.mailbox_dir:
+            raise SystemExit(
+                "--gossip with more than one host needs a shared "
+                "--mailbox-dir"
+            )
+        if args.coordinator:
+            # BEFORE anything initializes the XLA backend (the warmup
+            # thread, pool construction, param init all would).
+            from actor_critic_tpu.parallel.multihost import distributed_init
+
+            distributed_init(
+                args.coordinator, args.num_processes, args.process_id
+            )
+
     print(
         f"algo={preset.algo} env={preset.env} iterations={args.iterations} "
         f"config={dataclasses.asdict(preset.config)} "
@@ -712,11 +880,15 @@ def main(argv=None) -> int:
         print(f"compile cache: {cache_dir}", flush=True)
     pools = None
     if args.async_actors > 0:
-        if args.ckpt_dir or args.resume:
+        if (args.ckpt_dir or args.resume) and (
+            preset.algo != "ppo" or args.distributed
+        ):
             raise SystemExit(
-                "--async-actors does not support checkpointing yet (each "
-                "actor pool carries independent normalizer state; see "
-                "ROADMAP) — drop --ckpt-dir/--resume or run lockstep"
+                "--async-actors checkpointing is wired for single-host "
+                "PPO only (the save tree carries every actor pool's "
+                "normalizer state — ppo.train_host_async); off-policy "
+                "async and --distributed runs don't support "
+                "--ckpt-dir/--resume yet"
             )
         if args.no_overlap:
             print(
@@ -830,7 +1002,9 @@ def main(argv=None) -> int:
                 if getattr(args, "chunk", 1) > 1:
                     print("--chunk applies to fused (jax:*) envs only; "
                           "ignored for host pools", flush=True)
-                if pools is not None:
+                if pools is not None and args.distributed:
+                    final = run_multihost(pools, preset, args, logger)
+                elif pools is not None:
                     final = run_host_async(pools, preset, args, logger)
                 else:
                     final = run_host(env, preset, args, logger)
