@@ -640,6 +640,191 @@ def exercise_mailbox(
     return report
 
 
+def attach_batcher_poisoner(batcher: Any) -> Any:
+    """Freeze every enqueued payload at the submit boundary (the
+    serving MicroBatcher's handoff, ISSUE 10): with the correct
+    copy-on-submit the frozen array is the batcher's OWN copy — nobody
+    may write an enqueued payload — while with `copy=False` (the
+    aliasing submit `exercise_batcher(alias_submit=True)` drives) the
+    frozen array IS the client's buffer, so the client's next in-place
+    refill crashes at the write site on every schedule. One poisoner,
+    both contracts — the queue-slot freeze logic pointed at the
+    serving handoff."""
+    orig = batcher.submit
+
+    def submit(obs, policy_id=None, copy=True):
+        req = orig(obs, policy_id=policy_id, copy=copy)
+        freeze_leaves(req.obs)
+        return req
+
+    batcher.submit = submit
+    return batcher
+
+
+def freeze_on_swap(store: Any) -> Any:
+    """Wrap `store.swap` so the SWAPPER'S retained view of every
+    installed params tree is frozen at the swap boundary — the
+    policy-store mirror of `freeze_on_publish`: an in-place refresh of
+    a tree whose copy a flush may still be serving crashes at the write
+    site. (The store's install path additionally snapshots what it
+    STORES via the engine's prepare_params.)"""
+    orig = store.swap
+
+    def swap(policy_id, params, version=None, prepare=True):
+        freeze_leaves(params)
+        return orig(policy_id, params, version=version, prepare=prepare)
+
+    store.swap = swap
+    return store
+
+
+class _StubServingEngine:
+    """jax-free engine stand-in for the batcher exerciser: action =
+    obs[:, 0] * params['scale'][0], so every response is checkable
+    against the version it claims (scale == version + 1). Carries the
+    frozen-snapshot install contract the real engine's prepare_params
+    (checkpoint.uncommit) provides on device."""
+
+    max_rows = 8
+
+    def prepare_params(self, params: Any) -> Any:
+        return freeze_leaves({k: np.array(v) for k, v in params.items()})
+
+    def act(self, params: Any, obs: np.ndarray) -> np.ndarray:
+        return np.asarray(obs)[:, 0] * params["scale"][0]
+
+
+def exercise_batcher(
+    seed: int,
+    clients: int = 2,
+    requests_per_client: int = 4,
+    swaps: int = 3,
+    poison: bool = True,
+    alias_submit: bool = False,
+    buggy_swapper: bool = False,
+    timeout_s: float = 10.0,
+) -> dict:
+    """One seeded schedule over the serving MicroBatcher + PolicyStore
+    (ISSUE 10): client threads submit uniform-fill obs batches of mixed
+    row counts, a swapper thread hot-swaps the resident policy between
+    flushes, and the dispatcher runs as an explicit participant
+    (`start=False` + `_flush_once(block=False)`). Every response must
+    equal fill * (version + 1) for the VERSION IT CLAIMS (a flush that
+    mixes params across a swap, or tears a payload, breaks this), and
+    per-client versions must be non-decreasing (FIFO flush order).
+
+    `alias_submit=True` reproduces the payload-aliasing submit
+    (`copy=False` + client buffer reuse) — under the poisoner the
+    client's refill crashes at the write site on every schedule.
+    `buggy_swapper=True` mutates the swapper's RETAINED params tree in
+    place after installing it — `freeze_on_swap` turns that into a
+    ValueError at the mutation site."""
+    from actor_critic_tpu.serving.batcher import MicroBatcher
+    from actor_critic_tpu.serving.policy_store import PolicyStore
+
+    obs_dim = 2
+    sched = CoopScheduler(seed)
+    store = PolicyStore()
+    engine = _StubServingEngine()
+    store.register("default", engine, {"scale": np.ones(1, np.float32)})
+    batcher = MicroBatcher(
+        store, max_wait_us=0.0, queue_limit=64, start=False
+    )
+    sched.trace_locks(batcher, "_cv")
+    sched.trace_locks(store, "_lock")
+    if poison:
+        attach_batcher_poisoner(batcher)
+        freeze_on_swap(store)
+    report = {
+        "seed": seed, "responses": 0, "swaps": 0, "race_detected": False,
+        "alias_submit": alias_submit,
+    }
+    progress = {"clients_done": 0, "swapper_done": False}
+
+    def _fill(c: int, i: int) -> float:
+        return float(100 * c + i + 1)
+
+    def client(c: int) -> None:
+        rows = (c % 3) + 1
+        buf = np.zeros((rows, obs_dim), np.float32)
+        reqs = []
+        for i in range(requests_per_client):
+            if alias_submit:
+                # Refill the SAME buffer the previous submit aliased —
+                # under the poisoner's freeze this write (i > 0) is the
+                # crash site; without it, value checks catch the tear
+                # on schedules that flush after the refill.
+                buf.fill(_fill(c, i))
+                req = batcher.submit(buf, copy=False)
+            else:
+                buf = np.full((rows, obs_dim), _fill(c, i), np.float32)
+                req = batcher.submit(buf, copy=True)
+            reqs.append((i, req))
+            sched.yield_point("submitted")
+        last_version = -1
+        for i, req in reqs:
+            while not req.done.is_set():
+                sched.yield_point("awaiting")
+            if req.error is not None:
+                raise req.error
+            actions, version = req.result
+            expect = _fill(c, i) * (version + 1.0)
+            ok = actions.shape == (rows,) and bool(
+                np.all(actions == expect)
+            )
+            if not ok or version < last_version:
+                report["race_detected"] = True
+                raise RacesanError(
+                    f"client {c} request {i}: got {actions!r} under "
+                    f"version {version} (after {last_version}), expected "
+                    f"uniform {expect} under seed {seed} — torn payload "
+                    "or cross-version flush"
+                )
+            last_version = version
+            report["responses"] += 1
+        # Serialized by the scheduler; no lock needed (exercise_queue's
+        # progress-dict convention).
+        progress["clients_done"] += 1
+
+    def swapper() -> None:
+        retained = {"scale": np.ones(1, np.float32)}
+        for v in range(1, swaps + 1):
+            if buggy_swapper:
+                # In-place refresh of the tree installed last round —
+                # the frozen-snapshot install crashes this write.
+                retained["scale"][...] = float(v + 1)
+            else:
+                retained = {"scale": np.full(1, float(v + 1), np.float32)}
+            sched.yield_point("pre-swap")
+            store.swap("default", retained, version=v)
+            report["swaps"] = v
+            sched.yield_point("swapped")
+        progress["swapper_done"] = True
+
+    def dispatcher() -> None:
+        while True:
+            drained = (
+                progress["clients_done"] >= clients
+                and progress["swapper_done"]
+                and batcher.queue_depth() == 0
+            )
+            if drained:
+                return
+            batcher._flush_once(block=False)
+            sched.yield_point("flushed")
+
+    for c in range(clients):
+        sched.spawn(f"client-{c}", lambda c=c: client(c))
+    sched.spawn("swapper", swapper)
+    sched.spawn("dispatcher", dispatcher)
+    try:
+        sched.run(timeout_s=timeout_s)
+    finally:
+        report["queue_depth"] = batcher.queue_depth()
+        batcher.close(timeout=0.1)
+    return report
+
+
 def exercise_sweep(
     seeds: Iterable[int],
     scenario: Callable[[int], dict],
@@ -656,6 +841,8 @@ def exercise_sweep(
         "published": sum(r.get("published", 0) for r in reports),
         "deposits": sum(r.get("deposits", 0) for r in reports),
         "takes": sum(r.get("takes", 0) for r in reports),
+        "responses": sum(r.get("responses", 0) for r in reports),
+        "swaps": sum(r.get("swaps", 0) for r in reports),
         "races": sum(1 for r in reports if r.get("race_detected")),
     }
 
@@ -663,26 +850,36 @@ def exercise_sweep(
 def quick_profile(schedules: int = 100, seed0: int = 0) -> dict:
     """The tier-1 fast profile: `schedules` seeded interleavings split
     across the queue (snapshot consumer, poisoned), publisher (correct
-    producer, poisoned), and multihost param-mailbox (correct
-    depositor, poisoned) units — every schedule must sweep clean.
-    ~100 schedules run in a few seconds on one CPU core."""
-    third = max(schedules // 3, 1)
+    producer, poisoned), multihost param-mailbox (correct depositor,
+    poisoned), and serving micro-batcher (copy-on-submit, poisoned,
+    request/flush/hot-swap interleavings — ISSUE 10) units — every
+    schedule must sweep clean. ~100 schedules run in a few seconds on
+    one CPU core."""
+    quarter = max(schedules // 4, 1)
     q = exercise_sweep(
-        range(seed0, seed0 + third),
+        range(seed0, seed0 + quarter),
         lambda s: exercise_queue(s, poison=True, consumer="snapshot"),
     )
     p = exercise_sweep(
-        range(seed0, seed0 + third),
+        range(seed0, seed0 + quarter),
         lambda s: exercise_publisher(s, poison=True),
     )
     m = exercise_sweep(
-        range(seed0, seed0 + (schedules - 2 * third)),
+        range(seed0, seed0 + quarter),
         lambda s: exercise_mailbox(s, poison=True),
     )
+    b = exercise_sweep(
+        range(seed0, seed0 + (schedules - 3 * quarter)),
+        lambda s: exercise_batcher(s, poison=True),
+    )
     return {
-        "schedules": q["schedules"] + p["schedules"] + m["schedules"],
+        "schedules": (
+            q["schedules"] + p["schedules"] + m["schedules"]
+            + b["schedules"]
+        ),
         "queue": q,
         "publisher": p,
         "mailbox": m,
-        "races": q["races"] + p["races"] + m["races"],
+        "batcher": b,
+        "races": q["races"] + p["races"] + m["races"] + b["races"],
     }

@@ -1,7 +1,10 @@
-"""warmup-registry: every `jax.jit` entry point in `algos/`/`models/`
-must have an AOT warmup planner (compile_cache.register_warmup) or an
-exemption with a reason (compile_cache.EXEMPT) — ISSUE 4's lint, folded
-into the jaxlint framework as a registered pass (ISSUE 5).
+"""warmup-registry: every `jax.jit` entry point in
+`algos/`/`models/`/`serving/` must have an AOT warmup planner
+(compile_cache.register_warmup) or an exemption with a reason
+(compile_cache.EXEMPT) — ISSUE 4's lint, folded into the jaxlint
+framework as a registered pass (ISSUE 5); ISSUE 10 added the serving
+scan dir (the gateway's bucketed act programs register serving-side
+planners).
 `scripts/check_warmup_registry.py` is now a thin shim over this module.
 
 This is the ONE pass that imports project code: it validates the scan
@@ -28,7 +31,11 @@ from actor_critic_tpu.analysis.core import (
 
 CHECK = "warmup-registry"
 
-SCAN_DIRS = ("actor_critic_tpu/algos", "actor_critic_tpu/models")
+SCAN_DIRS = (
+    "actor_critic_tpu/algos",
+    "actor_critic_tpu/models",
+    "actor_critic_tpu/serving",  # gateway act programs (ISSUE 10)
+)
 _EXEMPT_HOME = "actor_critic_tpu/utils/compile_cache.py"
 
 
@@ -77,6 +84,7 @@ def load_registry() -> tuple[set[str], dict[str, str]]:
     actor_critic_tpu.config pulls in every algo module, whose
     register_warmup calls run as import side effects."""
     import actor_critic_tpu.config  # noqa: F401 — registration side effect
+    import actor_critic_tpu.serving  # noqa: F401 — serving-side planners
     from actor_critic_tpu.utils import compile_cache
 
     return set(compile_cache.registered_warmups()), dict(compile_cache.EXEMPT)
@@ -150,14 +158,15 @@ def sites_from_modules(
 
 @register_check(
     CHECK,
-    "jax.jit entry points in algos//models/ lacking an AOT warmup "
-    "registration or EXEMPT reason (first-dispatch compile returns)",
+    "jax.jit entry points in algos//models//serving/ lacking an AOT "
+    "warmup registration or EXEMPT reason (first-dispatch compile "
+    "returns)",
     scope="repo",
 )
 def check_warmup_registry(modules: list[ModuleInfo]) -> list[Finding]:
     sites = sites_from_modules(modules)
     if not sites:
-        # The scan didn't cover algos//models/ (fixture runs, partial
+        # The scan didn't cover the SCAN_DIRS (fixture runs, partial
         # paths): nothing to validate, and importing the registry would
         # be pure overhead.
         return []
@@ -261,7 +270,7 @@ def main(argv=None) -> int:
         return 1
     print(
         f"check_warmup_registry: OK — {len(sites)} jax.jit site(s) in "
-        f"algos//models/ all covered ({len(registered)} registered "
-        f"warmups, {len(exempt)} exemptions)."
+        f"algos//models//serving/ all covered ({len(registered)} "
+        f"registered warmups, {len(exempt)} exemptions)."
     )
     return 0

@@ -232,3 +232,31 @@ def make_sac_host_greedy(env_spec, cfg):
         return _tanh(mean).astype(np.float32)
 
     return act
+
+
+# -- serving dispatch (ISSUE 10) -----------------------------------------
+
+_GREEDY_MIRRORS = {
+    "ppo": make_ppo_host_greedy,
+    "ddpg": make_ddpg_host_greedy,
+    "td3": make_ddpg_host_greedy,
+    "sac": make_sac_host_greedy,
+}
+
+
+def greedy_mirror_for(env_spec, cfg, algo: str):
+    """The greedy host mirror `(np_params, obs) -> action` for `algo`'s
+    policy params, or ValueError when no mirror exists — the serving
+    engine's `backend="mirror"` acting path (serving/engine.py): on a
+    CPU-only serving host these few numpy matmuls beat a batch-1 XLA
+    dispatch, exactly the trade the training loops already make.
+    Callers must still gate on `supports_mirror(params)` (conv torsos
+    keep the device path)."""
+    try:
+        maker = _GREEDY_MIRRORS[algo]
+    except KeyError:
+        raise ValueError(
+            f"no greedy host mirror for algo {algo!r}; "
+            f"mirrored: {sorted(_GREEDY_MIRRORS)}"
+        ) from None
+    return maker(env_spec, cfg)

@@ -1,0 +1,386 @@
+"""GA3C-style micro-batcher for the serving gateway (ISSUE 10
+tentpole; arxiv 1611.06256).
+
+Concurrent `POST /v1/act` handler threads enqueue requests into ONE
+bounded queue; a single dispatcher thread drains it, groups rows by
+policy id, and flushes each group through the policy's bucketed act
+program — so N concurrent batch-1 requests cost one accelerator
+dispatch at bucket(N), not N dispatches. The `max_wait_us` knob is the
+p99/occupancy trade: the dispatcher holds the first request of a flush
+at most that long while more rows accumulate.
+
+Threading model (the jaxlint concurrency passes sweep this module):
+
+- client (HTTP handler) threads: `submit` appends under `_cv`, then
+  poll/block on the request's own `done` event;
+- the single `serve-dispatcher` thread: drains `_pending` under `_cv`,
+  dispatches OUTSIDE the lock (an XLA dispatch must not block
+  enqueues), completes requests, and is the only writer of the
+  flush-progress fields;
+- metrics threads (sampler/exporter scrapes): read through
+  `ServingMetrics.snapshot()` / `health()`, which lock or read
+  GIL-atomic snapshots only.
+
+Requests are COPIED at submit (`np.array`) so the batcher owns every
+payload: a client reusing its obs buffer after submit() must not be
+able to tear a flush (the PR 6 zero-copy class — racesan's
+`exercise_batcher` drives the aliasing variant to prove detection).
+
+Import-light by design (numpy/threading): racesan and the unit tests
+exercise request/flush/hot-swap interleavings with a stub engine and
+never pull jax.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from collections import deque
+from typing import Any, Optional
+
+import numpy as np
+
+from actor_critic_tpu.serving.policy_store import PolicyStore
+
+# jaxlint: hot-module
+
+
+class QueueFull(RuntimeError):
+    """The bounded request queue is at capacity (gateway: HTTP 503)."""
+
+
+class DispatcherDown(RuntimeError):
+    """The dispatcher thread is not running (gateway: HTTP 503)."""
+
+
+def _percentile(sorted_vals: list, p: float) -> float:
+    """Nearest-rank percentile of an already-sorted list (0 if empty)."""
+    if not sorted_vals:
+        return 0.0
+    k = max(0, min(len(sorted_vals) - 1,
+                   math.ceil(p / 100.0 * len(sorted_vals)) - 1))
+    return float(sorted_vals[k])
+
+
+class ServingMetrics:
+    """Lock-guarded serving counters + windowed latency/throughput view
+    (the `/metrics` serving gauge)."""
+
+    def __init__(self, latency_window: int = 2048):
+        self._lock = threading.Lock()
+        self._lat_ms: deque = deque(maxlen=latency_window)
+        self._recent: deque = deque(maxlen=latency_window)  # (t_done, rows)
+        self._occupancy: deque = deque(maxlen=256)
+        self._requests = 0
+        self._actions = 0
+        self._flushes = 0
+        self._rejected = 0
+        self._errors = 0
+        self._per_policy: dict[str, int] = {}
+
+    def record_flush(
+        self,
+        policy_id: str,
+        rows: int,
+        requests: int,
+        latencies_ms: list,
+        occupancy: float,
+    ) -> None:
+        now = time.monotonic()
+        with self._lock:
+            self._requests += requests
+            self._actions += rows
+            self._flushes += 1
+            self._per_policy[policy_id] = (
+                self._per_policy.get(policy_id, 0) + requests
+            )
+            self._lat_ms.extend(latencies_ms)
+            self._recent.append((now, rows))
+            self._occupancy.append(occupancy)
+
+    def record_reject(self) -> None:
+        with self._lock:
+            self._rejected += 1
+
+    def record_errors(self, n: int) -> None:
+        with self._lock:
+            self._errors += n
+
+    def snapshot(self) -> dict:
+        """Flat numeric dict for the sampler gauge registry (the
+        exporter flattens one level; per-policy request counters ride as
+        `requests_<policy>` keys)."""
+        with self._lock:
+            lat = sorted(self._lat_ms)
+            recent = list(self._recent)
+            occ = list(self._occupancy)
+            out = {
+                "requests_total": self._requests,
+                "actions_total": self._actions,
+                "flushes_total": self._flushes,
+                "rejected_total": self._rejected,
+                "errors_total": self._errors,
+            }
+            per_policy = dict(self._per_policy)
+        out["latency_p50_ms"] = round(_percentile(lat, 50), 3)
+        out["latency_p99_ms"] = round(_percentile(lat, 99), 3)
+        if occ:
+            out["batch_occupancy"] = round(sum(occ) / len(occ), 4)
+        if len(recent) >= 2:
+            dt = recent[-1][0] - recent[0][0]
+            if dt > 0:
+                # Rows completed strictly after the window's first flush
+                # (that flush timestamps the window start; counting its
+                # rows would overstate the rate).
+                out["actions_per_s"] = round(
+                    sum(r for _, r in recent[1:]) / dt, 2
+                )
+        for pid, n in sorted(per_policy.items()):
+            out[f"requests_{pid}"] = n
+        return out
+
+
+class _PendingRequest:
+    """One enqueued act request; completed by the dispatcher."""
+
+    __slots__ = ("policy_id", "obs", "rows", "result", "error", "done",
+                 "t_enq")
+
+    def __init__(self, policy_id: str, obs: np.ndarray):
+        self.policy_id = policy_id
+        self.obs = obs
+        self.rows = int(obs.shape[0])
+        self.result = None  # (actions ndarray, policy version)
+        self.error: Optional[BaseException] = None
+        self.done = threading.Event()
+        self.t_enq = time.monotonic()
+
+
+class MicroBatcher:
+    """Bounded request queue + single dispatcher thread (module
+    docstring). `start=False` leaves the dispatcher unstarted so a
+    cooperative scheduler (racesan) can drive `_flush_once(block=False)`
+    as an explicit participant."""
+
+    def __init__(
+        self,
+        store: PolicyStore,
+        max_wait_us: float = 2000.0,
+        max_batch_rows: Optional[int] = None,
+        queue_limit: int = 256,
+        metrics: Optional[ServingMetrics] = None,
+        start: bool = True,
+    ):
+        if queue_limit < 1:
+            raise ValueError(f"queue_limit must be >= 1, got {queue_limit}")
+        self._store = store
+        self.max_wait_s = float(max_wait_us) / 1e6
+        self._max_batch_rows = max_batch_rows
+        self.queue_limit = int(queue_limit)
+        self.metrics = metrics or ServingMetrics()
+        self._cv = threading.Condition()
+        # Guarded by _cv: the request queue and the closed flag.
+        self._pending: deque = deque()
+        self._closed = False
+        # jaxlint: thread-owned=dispatcher (single writer: only the
+        # dispatcher thread stamps flush progress; health() reads the
+        # plain float GIL-atomically and tolerates one-flush staleness)
+        self._last_flush_t = time.monotonic()
+        self._thread: Optional[threading.Thread] = None
+        if start:
+            self.start()
+
+    def start(self) -> "MicroBatcher":
+        self._thread = threading.Thread(
+            target=self._run, name="serve-dispatcher", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    # -- client side --------------------------------------------------------
+
+    def submit(
+        self, obs, policy_id: Optional[str] = None, copy: bool = True
+    ) -> _PendingRequest:
+        """Enqueue one act request of [n, *obs_shape] rows. Raises
+        UnknownPolicy (404), ValueError (400: too many rows for the
+        policy's largest bucket), QueueFull / DispatcherDown (503).
+        `copy=False` exists ONLY for racesan's aliasing exerciser — the
+        gateway always copies so the batcher owns the payload."""
+        handle = self._store.get(policy_id)
+        obs = np.asarray(obs)
+        if copy:
+            obs = np.array(obs)
+        limit = self._row_limit(handle)
+        if obs.shape[0] > limit:
+            raise ValueError(
+                f"request of {obs.shape[0]} rows exceeds the largest "
+                f"serving bucket ({limit}) — split it client-side"
+            )
+        req = _PendingRequest(handle.policy_id, obs)
+        with self._cv:
+            if self._closed or (
+                self._thread is not None and not self._thread.is_alive()
+            ):
+                raise DispatcherDown("serving dispatcher is not running")
+            if len(self._pending) >= self.queue_limit:
+                self.metrics.record_reject()
+                raise QueueFull(
+                    f"request queue at capacity ({self.queue_limit})"
+                )
+            self._pending.append(req)
+            self._cv.notify_all()
+        return req
+
+    def wait(self, req: _PendingRequest, timeout: Optional[float] = None):
+        """Block for a submitted request; returns (actions, version)."""
+        if not req.done.wait(timeout):
+            raise TimeoutError(
+                f"request not served within {timeout}s (queue depth "
+                f"{self.queue_depth()})"
+            )
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    # -- dispatcher side ----------------------------------------------------
+
+    def _row_limit(self, handle) -> int:
+        # Clamp to the engine's largest bucket: a max_batch_rows above
+        # it would let the dispatcher pack a flush no bucket can hold,
+        # failing every (individually valid) request in it.
+        limit = int(getattr(handle.engine, "max_rows", 64))
+        if self._max_batch_rows is not None:
+            limit = min(limit, int(self._max_batch_rows))
+        return limit
+
+    def _run(self) -> None:
+        while self._flush_once(block=True):
+            pass
+
+    def _flush_once(self, block: bool = True) -> bool:
+        """Collect one micro-batch and dispatch it. Returns False once
+        the batcher is closed AND drained (the dispatcher loop's exit),
+        True otherwise — including empty non-blocking polls."""
+        with self._cv:
+            if block:
+                while not self._pending and not self._closed:
+                    self._cv.wait(0.05)
+            if not self._pending:
+                return not self._closed
+            first = self._pending[0]
+            policy_id = first.policy_id
+        # Resolve the route OUTSIDE the queue lock: store.get takes the
+        # store's lock, and nesting it under _cv would couple the
+        # enqueue path to swap()'s critical section (racesan's batcher
+        # exerciser deadlocks on exactly that nesting). Only this
+        # thread pops, so `first` cannot vanish in between.
+        limit = self._row_limit(self._store.get(policy_id))
+        with self._cv:
+            if block:
+                # GA3C window: hold the flush up to max_wait past the
+                # FIRST request's enqueue while same-policy rows
+                # accumulate toward the row budget.
+                deadline = first.t_enq + self.max_wait_s
+                while not self._closed:
+                    rows = sum(
+                        r.rows for r in self._pending
+                        if r.policy_id == policy_id
+                    )
+                    if rows >= limit:
+                        break
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cv.wait(remaining)
+            batch: list[_PendingRequest] = []
+            rest: deque = deque()
+            rows = 0
+            while self._pending:
+                r = self._pending.popleft()
+                if r.policy_id == policy_id and (
+                    not batch or rows + r.rows <= limit
+                ):
+                    batch.append(r)
+                    rows += r.rows
+                else:
+                    rest.append(r)
+            self._pending.extend(rest)
+        try:
+            # Re-resolve the handle at flush time: a hot-swap that
+            # landed while this flush waited serves the NEW version;
+            # the handle is immutable, so params/version stay
+            # consistent through the dispatch either way. Resolution
+            # and concatenation stay INSIDE the try — once requests are
+            # popped, any failure must complete them with the error,
+            # never kill the dispatcher with callers left hanging.
+            handle = self._store.get(policy_id)
+            obs = (
+                batch[0].obs
+                if len(batch) == 1
+                else np.concatenate([r.obs for r in batch], axis=0)
+            )
+            actions = handle.engine.act(handle.params, obs)
+        except Exception as e:  # noqa: BLE001 — failures go to callers
+            for r in batch:
+                r.error = e
+                r.done.set()
+            self.metrics.record_errors(len(batch))
+        else:
+            now = time.monotonic()
+            offset = 0
+            latencies = []
+            for r in batch:
+                r.result = (actions[offset:offset + r.rows], handle.version)
+                offset += r.rows
+                latencies.append((now - r.t_enq) * 1e3)
+                r.done.set()
+            self.metrics.record_flush(
+                handle.policy_id, rows, len(batch), latencies,
+                occupancy=rows / max(limit, 1),
+            )
+        self._last_flush_t = time.monotonic()
+        return True
+
+    # -- introspection / lifecycle ------------------------------------------
+
+    def queue_depth(self) -> int:
+        with self._cv:
+            return len(self._pending)
+
+    def health(self) -> dict:
+        """Dispatcher liveness for /healthz: alive flag, queue depth,
+        seconds since the last completed flush."""
+        alive = self._thread is not None and self._thread.is_alive()
+        with self._cv:
+            depth = len(self._pending)
+            closed = self._closed
+        return {
+            "alive": bool(alive and not closed),
+            "queue_depth": depth,
+            "last_flush_age_s": round(
+                time.monotonic() - self._last_flush_t, 3
+            ),
+        }
+
+    def gauge(self) -> dict:
+        """The sampler-registry serving gauge: metrics + live queue."""
+        out = self.metrics.snapshot()
+        out["queue_depth"] = self.queue_depth()
+        return out
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Stop accepting requests, drain in-flight flushes, fail any
+        stragglers (idempotent)."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+        with self._cv:
+            stranded = list(self._pending)
+            self._pending.clear()
+        for r in stranded:
+            r.error = DispatcherDown("batcher closed before dispatch")
+            r.done.set()

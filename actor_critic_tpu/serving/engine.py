@@ -1,0 +1,310 @@
+"""Bucketed, AOT-warm act programs for the policy-serving gateway
+(ISSUE 10 tentpole).
+
+A serving process dispatches ONE jitted act program per bucket size:
+incoming micro-batches are padded to the smallest fitting bucket
+(`compile_cache.pad_to_bucket`), so the distinct compiled programs are
+bounded by `len(buckets)` no matter how request sizes mix — the same
+shape-stabilization discipline the chunked trainer uses (ISSUE 4), now
+pointed at traffic. Every bucket is compiled at startup, two ways:
+
+- `register_warmup("engine.make_act_program", serving=True)`: the
+  registry planner AOT-compiles each bucket from ABSTRACT params on the
+  background warmup thread (persistent-cache prewarm, overlapping
+  checkpoint restore), keyed off `WarmupContext.serving_buckets`;
+- `PolicyEngine.warm(params)`: one concrete dispatch per bucket through
+  the live jit, so the dispatch cache itself is hot before the gateway
+  accepts traffic — steady-state serving is 0-recompile even with no
+  persistent cache configured.
+
+Param trees installed into the store are normalized by
+`prepare_params`: `checkpoint.uncommit` re-places restored leaves as
+uncommitted XLA-owned buffers, because committed (orbax-restored)
+arrays lower byte-different HLO that would miss both the warmup's cache
+entries and the live dispatch cache — a hot-swap would otherwise pay a
+recompile on its first flush (the exact PR 4 failure mode, resurfacing
+as a p99 spike).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Optional
+
+import numpy as np
+
+from actor_critic_tpu.utils import compile_cache
+
+# Serving act programs are tiny (one policy forward); a fine-grained
+# ladder keeps padding waste low at small occupancy while the top end
+# bounds rows-per-flush.
+DEFAULT_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
+
+SUPPORTED_ALGOS = ("ppo", "ddpg", "td3", "sac")
+
+
+def make_act_program(spec, cfg, algo: str = "ppo", sample: bool = False):
+    """The jitted serving act program for one policy architecture:
+    `(params, obs) -> actions` (greedy), or `(params, obs, key) ->
+    actions` with `sample=True` (PPO only — the off-policy actors are
+    deterministic and serve their greedy action). Built from the SAME
+    network factories the trainers use, so a served action is bitwise
+    the trainer's eval action for the same params/obs."""
+    import jax
+
+    if algo == "ppo":
+        from actor_critic_tpu.algos import ppo
+
+        if sample:
+            net = ppo.make_network(spec, cfg)
+
+            def act(params, obs, key):
+                dist, _ = net.apply(params, obs)
+                return dist.sample(key)
+
+            return jax.jit(act)
+        return jax.jit(ppo.make_greedy_act(spec, cfg))
+    if sample:
+        raise ValueError(
+            f"sample-mode serving is PPO-only ({algo!r} serves a "
+            "deterministic actor — its greedy action IS its policy)"
+        )
+    if algo in ("ddpg", "td3"):
+        from actor_critic_tpu.algos import ddpg
+
+        return jax.jit(ddpg.make_greedy_act(spec.action_dim, cfg))
+    if algo == "sac":
+        from actor_critic_tpu.algos import sac
+
+        return jax.jit(sac.make_greedy_act(spec.action_dim, cfg))
+    raise ValueError(
+        f"unsupported serving algo {algo!r}; supported: {SUPPORTED_ALGOS}"
+    )
+
+
+def init_params(spec, cfg, algo: str = "ppo", seed: int = 0):
+    """Freshly initialized params for this architecture (the tree the
+    act program consumes — actor params only for the off-policy algos).
+    Serves as the restore TEMPLATE for params-only checkpoints and as
+    the --random-init policy for benches/demos."""
+    import jax
+
+    key = jax.random.key(seed)
+    if algo == "ppo":
+        from actor_critic_tpu.algos import ppo
+
+        return ppo.init_host_params(spec, cfg, key)[0]
+    if algo in ("ddpg", "td3"):
+        from actor_critic_tpu.algos import ddpg
+
+        return ddpg.init_learner(
+            tuple(spec.obs_shape), spec.action_dim, cfg, key
+        ).actor_params
+    if algo == "sac":
+        from actor_critic_tpu.algos import sac
+
+        return sac.init_learner(
+            tuple(spec.obs_shape), spec.action_dim, cfg, key
+        ).actor_params
+    raise ValueError(
+        f"unsupported serving algo {algo!r}; supported: {SUPPORTED_ALGOS}"
+    )
+
+
+def abstract_params(spec, cfg, algo: str = "ppo"):
+    """The act program's param tree as ShapeDtypeStructs (eval_shape —
+    no device allocation), for AOT-compiling buckets before any
+    checkpoint has been restored."""
+    import jax
+
+    return jax.eval_shape(lambda: init_params(spec, cfg, algo, 0))
+
+
+class PolicyEngine:
+    """Bucket-stabilized act dispatch for ONE policy architecture
+    (spec + config + algo). Multiple resident policies of the same
+    architecture share one engine — and therefore one set of compiled
+    programs; hot-swapping params never changes the program.
+
+    `act` is called from the micro-batcher's single dispatcher thread
+    only (the sample-mode flush counter below is unsynchronized by that
+    contract); construction/warmup happen on the owning thread before
+    the dispatcher starts.
+    """
+
+    def __init__(
+        self,
+        spec,
+        cfg,
+        algo: str = "ppo",
+        buckets: tuple[int, ...] = DEFAULT_BUCKETS,
+        sample: bool = False,
+        seed: int = 0,
+        dispatch_pad_s: float = 0.0,
+        backend: str = "xla",
+    ):
+        buckets = tuple(sorted({int(b) for b in buckets}))
+        if not buckets or buckets[0] < 1:
+            raise ValueError(f"buckets must be positive ints, got {buckets!r}")
+        if backend not in ("xla", "mirror"):
+            raise ValueError(
+                f"backend must be 'xla' or 'mirror', got {backend!r}"
+            )
+        self.spec = spec
+        self.cfg = cfg
+        self.algo = algo
+        self.sample = bool(sample)
+        self.buckets = buckets
+        self.backend = backend
+        if backend == "mirror":
+            # CPU-only serving hosts: the numpy greedy mirror
+            # (models/host_actor) beats a batch-1 XLA dispatch on
+            # MLP-torso policies — the same trade the training loops
+            # make. No compiled programs, so buckets only bound the
+            # per-flush row budget (ragged batches dispatch as-is).
+            if self.sample:
+                raise ValueError(
+                    "backend='mirror' serves greedy actions only"
+                )
+            from actor_critic_tpu.models import host_actor
+
+            self._program = None
+            self._mirror = host_actor.greedy_mirror_for(spec, cfg, algo)
+        else:
+            self._mirror = None
+            self._program = make_act_program(
+                spec, cfg, algo, sample=self.sample
+            )
+        # Testbed knob (sleep_pad.py's discipline, pointed at serving):
+        # a fixed wall pad per DISPATCH models the host<->accelerator
+        # round trip of a real serving deployment — the axon TPU tunnel
+        # measures ~26 ms per act() round trip (models/host_actor.py) —
+        # which a CPU-local jit dispatch (~0.3 ms) cannot exhibit. The
+        # pad is per-dispatch, not per-row: exactly the fixed cost
+        # GA3C-style micro-batching amortizes. Default 0 — real serving
+        # never pads; `bench/suite.py serving_latency` sets it.
+        self.dispatch_pad_s = float(dispatch_pad_s)
+        self._seed = int(seed)
+        self._base_key = None  # lazy: jax.random.key allocates on-device
+        # jaxlint: thread-owned=dispatcher (single writer: only the
+        # micro-batcher's dispatcher thread calls act(); the counter
+        # exists to give each sampled flush a fresh fold_in key)
+        self._flush_counter = itertools.count()
+
+    @property
+    def max_rows(self) -> int:
+        """Largest bucket — the micro-batcher's per-flush row budget."""
+        return self.buckets[-1]
+
+    def prepare_params(self, params):
+        """Install-normalize a param tree for serving. XLA backend:
+        every leaf becomes an uncommitted, XLA-owned device buffer
+        (`checkpoint.uncommit`), so a hot-swapped checkpoint lowers the
+        same HLO as the warmed programs and steady-state stays
+        0-recompile (numpy trees — e.g. a learner's published snapshot
+        — are placed on device by the same path). Mirror backend: a
+        frozen numpy snapshot (PolicyPublisher's contract) after a
+        `supports_mirror` structure check."""
+        if self.backend == "mirror":
+            import jax
+
+            from actor_critic_tpu.models import host_actor
+
+            # np.array COPIES (device_get of numpy input is a no-copy
+            # alias): freezing must land on our snapshot, never the
+            # caller's buffers — PolicyPublisher's contract verbatim.
+            np_params = jax.tree.map(np.array, jax.device_get(params))
+            if not host_actor.supports_mirror(np_params):
+                raise ValueError(
+                    "backend='mirror' needs an MLP-torso param tree "
+                    "(conv torsos keep the XLA acting path)"
+                )
+            for leaf in jax.tree.leaves(np_params):
+                leaf.flags.writeable = False
+            return np_params
+        from actor_critic_tpu.utils import checkpoint
+
+        return checkpoint.uncommit(params)
+
+    def _key_for_flush(self):
+        import jax
+
+        if self._base_key is None:
+            self._base_key = jax.random.key(self._seed)
+        return jax.random.fold_in(self._base_key, next(self._flush_counter))
+
+    def act(self, params, obs: np.ndarray) -> np.ndarray:
+        """Dispatch one micro-batch: pad [n, *obs_shape] to its bucket,
+        run the jitted program, return the first n actions as numpy."""
+        obs = np.asarray(obs, dtype=np.dtype(self.spec.obs_dtype))
+        n = obs.shape[0]
+        if self.backend == "mirror":
+            out = self._mirror(params, obs)
+        else:
+            padded, _ = compile_cache.pad_to_bucket(obs, self.buckets)
+            if self.sample:
+                out = self._program(
+                    params, padded, self._key_for_flush()
+                )
+            else:
+                out = self._program(params, padded)
+        if self.dispatch_pad_s > 0.0:
+            import time
+
+            time.sleep(self.dispatch_pad_s)  # modeled tunnel round trip
+        return np.asarray(out)[:n]
+
+    def warm(self, params) -> int:
+        """Dispatch every bucket once with concrete params so the live
+        jit cache is hot before traffic arrives (with the persistent
+        cache enabled these re-traces HIT what the registry planner
+        AOT-compiled). Returns the number of programs dispatched (0 for
+        the mirror backend — nothing compiles)."""
+        if self.backend == "mirror":
+            return 0
+        for b in self.buckets:
+            self.act(params, np.zeros((b, *self.spec.obs_shape), np.float32))
+        return len(self.buckets)
+
+    def warmup_thunk(self, params_abs=None):
+        """AOT-compile thunk over ABSTRACT params for the warmup
+        registry: `.lower(...).compile()` of every bucket (plus the
+        sample-mode key arg), feeding the persistent cache on the
+        background warmup thread."""
+
+        if self.backend == "mirror":
+            return lambda: None  # nothing compiles on the mirror path
+
+        def thunk():
+            p_abs = params_abs
+            if p_abs is None:
+                p_abs = abstract_params(self.spec, self.cfg, self.algo)
+            for b in self.buckets:
+                obs = compile_cache.array_struct(
+                    (b, *self.spec.obs_shape), self.spec.obs_dtype
+                )
+                if self.sample:
+                    compile_cache.aot_compile(
+                        self._program, p_abs, obs, compile_cache.key_struct()
+                    )
+                else:
+                    compile_cache.aot_compile(self._program, p_abs, obs)
+
+        return thunk
+
+
+@compile_cache.register_warmup("engine.make_act_program", serving=True)
+def _warmup_act_buckets(ctx) -> Optional[Any]:
+    """Serving-side planner: AOT-compile every act bucket for the
+    gateway's architecture. Runs only for serving contexts
+    (ctx.serving_buckets non-empty — plan_warmup's registry gate)."""
+    if not ctx.serving_buckets:
+        return None
+    engine = PolicyEngine(
+        ctx.spec,
+        ctx.cfg,
+        algo=ctx.algo,
+        buckets=ctx.serving_buckets,
+        sample=ctx.serving_sample,
+    )
+    return engine.warmup_thunk()

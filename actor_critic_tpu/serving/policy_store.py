@@ -1,0 +1,159 @@
+"""Multi-policy residency and hot-swap for the serving gateway
+(ISSUE 10).
+
+Several checkpoints stay resident keyed by policy id; each is held as
+an immutable `PolicyHandle` (id, version, prepared params, engine).
+Hot-swap follows `PolicyPublisher`'s versioned frozen-snapshot handoff
+(ISSUE 7): `swap` builds a NEW handle and atomically replaces the dict
+entry — in-flight requests that already resolved the old handle keep
+acting on the old params until their flush completes, so a swap never
+drops or torn-reads a request. Params are normalized at install time by
+the engine (`prepare_params` → `checkpoint.uncommit`'s safe-restore
+path), which is what keeps a swap from recompiling (engine.py
+docstring).
+
+This module is import-light (numpy/threading only): the race sanitizer
+exercises the store + batcher with a stub engine and never pulls jax.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Optional
+
+
+class UnknownPolicy(KeyError):
+    """Request named a policy id that is not resident."""
+
+
+@dataclasses.dataclass(frozen=True)
+class PolicyHandle:
+    """One resident policy version. Immutable: a swap installs a new
+    handle; holders of the old one keep a consistent (params, version)
+    pair for as long as they need it."""
+
+    policy_id: str
+    version: int
+    params: Any
+    engine: Any  # PolicyEngine (or a duck-typed stub in tests/racesan)
+
+
+class PolicyStore:
+    """Thread-safe policy_id -> PolicyHandle map with a default route."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._handles: dict[str, PolicyHandle] = {}
+        self._default: Optional[str] = None
+
+    def register(
+        self,
+        policy_id: str,
+        engine,
+        params,
+        version: int = 0,
+        default: bool = False,
+        prepare: bool = True,
+    ) -> PolicyHandle:
+        """Install a new resident policy. The FIRST registration becomes
+        the default route unless a later one claims `default=True`."""
+        prepared = engine.prepare_params(params) if prepare else params
+        handle = PolicyHandle(str(policy_id), int(version), prepared, engine)
+        with self._lock:
+            if handle.policy_id in self._handles:
+                raise ValueError(
+                    f"policy {handle.policy_id!r} already registered — "
+                    "use swap() to replace its params"
+                )
+            self._handles[handle.policy_id] = handle
+            if default or self._default is None:
+                self._default = handle.policy_id
+        return handle
+
+    def swap(
+        self,
+        policy_id: str,
+        params,
+        version: Optional[int] = None,
+        prepare: bool = True,
+    ) -> PolicyHandle:
+        """Hot-swap a resident policy's params (default: bump its
+        version by one). Preparation (device placement + uncommit) runs
+        OUTSIDE the lock — a multi-MB restore must not block the
+        dispatcher's get() — then the handle is replaced atomically."""
+        old = self.get(policy_id)
+        prepared = old.engine.prepare_params(params) if prepare else params
+        with self._lock:
+            # Re-read under the lock: concurrent swaps must version off
+            # the latest install, not this caller's possibly-stale read.
+            cur = self._handles[old.policy_id]
+            new_version = cur.version + 1 if version is None else int(version)
+            handle = PolicyHandle(
+                cur.policy_id, new_version, prepared, cur.engine
+            )
+            self._handles[cur.policy_id] = handle
+        return handle
+
+    def swap_from_checkpoint(
+        self, policy_id: str, ckpt_dir: str, step: Optional[int] = None
+    ) -> PolicyHandle:
+        """Restore a params-only checkpoint and hot-swap it in, using
+        the CURRENT resident params as the restore template (same
+        architecture by construction)."""
+        cur = self.get(policy_id)
+        params = restore_policy_params(ckpt_dir, cur.params, step)
+        return self.swap(policy_id, params)
+
+    def get(self, policy_id: Optional[str] = None) -> PolicyHandle:
+        """Resolve a handle (None -> the default route)."""
+        with self._lock:
+            pid = self._default if policy_id is None else str(policy_id)
+            if pid is None or pid not in self._handles:
+                raise UnknownPolicy(
+                    f"no resident policy {policy_id!r} "
+                    f"(resident: {sorted(self._handles)})"
+                )
+            return self._handles[pid]
+
+    @property
+    def default_id(self) -> Optional[str]:
+        with self._lock:
+            return self._default
+
+    def ids(self) -> dict[str, int]:
+        """{policy_id: current version} of every resident policy."""
+        with self._lock:
+            return {pid: h.version for pid, h in self._handles.items()}
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._handles)
+
+
+# -- params-only checkpoint helpers (lazy jax/orbax imports) ----------------
+
+
+def export_policy_params(ckpt_dir: str, params, step: int = 0) -> None:
+    """Write a params-only checkpoint a serving process can load
+    (`scripts/serve.py --policy id=DIR`, or the gateway's /v1/swap)."""
+    from actor_critic_tpu.utils.checkpoint import Checkpointer
+
+    ckpt = Checkpointer(ckpt_dir, max_to_keep=2)
+    ckpt.save(step, params, force=True)
+    ckpt.close()
+
+
+def restore_policy_params(ckpt_dir: str, template, step: Optional[int] = None):
+    """Restore a params-only checkpoint into `template`'s structure.
+    The Checkpointer already routes through `checkpoint.uncommit` when
+    the persistent compile cache is live; `PolicyEngine.prepare_params`
+    re-applies it unconditionally at install, so serving gets the
+    uncommitted-restore path with or without a cache dir."""
+    from actor_critic_tpu.utils.checkpoint import Checkpointer
+
+    ckpt = Checkpointer(ckpt_dir)
+    try:
+        return ckpt.restore(template, step)
+    finally:
+        ckpt.close()

@@ -270,6 +270,14 @@ class TelemetrySession:
             self.exporter = TelemetryExporter(self, port=serve_port)
             self.event("exporter_start", port=self.exporter.port)
 
+    @property
+    def exporter_port(self):
+        """The exporter's ACTUAL bound port (with serve_port=0 the
+        OS-assigned ephemeral one — ISSUE 10 satellite: scripts and CI
+        read it here instead of racing for a fixed port), or None when
+        no exporter is serving."""
+        return None if self.exporter is None else self.exporter.port
+
     def _open(self, name: str) -> IO[str]:
         return open(os.path.join(self.directory, name), "a", buffering=1)
 

@@ -324,6 +324,15 @@ class WarmupContext:
     # (E_a = num_envs // async_actors).
     async_actors: int = 0
     async_correction: str = "vtrace"
+    # Policy-serving gateway (ISSUE 10): non-empty bucket sizes put the
+    # context in SERVING mode — plan_warmup then runs only the planners
+    # registered with `register_warmup(..., serving=True)` (the serving
+    # act programs), and none of the training planners: a gateway
+    # process must not spend startup compiling update programs it will
+    # never dispatch. serving_sample picks the stochastic act program
+    # over the greedy one.
+    serving_buckets: tuple[int, ...] = ()
+    serving_sample: bool = False
 
 
 # name -> planner(ctx) -> Optional[() -> None].  A planner returns None
@@ -333,6 +342,13 @@ class WarmupContext:
 # decorators running at module-import time under the import lock; the
 # warmup thread and the registry lint only read it afterwards)
 _REGISTRY: dict[str, Callable[[WarmupContext], Optional[Callable]]] = {}
+
+# Planners that belong to the SERVING side of the registry (registered
+# with `register_warmup(..., serving=True)`): plan_warmup runs exactly
+# one side per context — serving planners for a gateway context
+# (ctx.serving_buckets non-empty), training planners otherwise.
+# jaxlint: thread-owned=import (same import-time population as _REGISTRY)
+_SERVING_PLANNERS: set[str] = set()
 
 # jax.jit sites in algos//models/ that the lint must NOT require a
 # registration for, with the reason a reviewer needs. Keys are
@@ -356,12 +372,16 @@ EXEMPT: dict[str, str] = {
 }
 
 
-def register_warmup(name: str):
+def register_warmup(name: str, serving: bool = False):
     """Decorator: register `planner(ctx) -> thunk | None` under `name`
-    ("<module>.<factory>", the key the registry lint checks)."""
+    ("<module>.<factory>", the key the registry lint checks).
+    `serving=True` marks the planner as belonging to the serving side
+    of the registry (see _SERVING_PLANNERS)."""
 
     def deco(planner):
         _REGISTRY[name] = planner
+        if serving:
+            _SERVING_PLANNERS.add(name)
         return planner
 
     return deco
@@ -383,8 +403,14 @@ def plan_warmup(ctx: WarmupContext) -> list[tuple[str, Callable]]:
 
     from actor_critic_tpu.telemetry import session as _session
 
+    serving_ctx = bool(ctx.serving_buckets)
     out: list[tuple[str, Callable]] = []
     for name in sorted(_REGISTRY):
+        # One registry side per context: a serving context runs only the
+        # serving planners (training planners would compile update/eval
+        # programs the gateway never dispatches), and vice versa.
+        if (name in _SERVING_PLANNERS) != serving_ctx:
+            continue
         try:
             thunk = _REGISTRY[name](ctx)
         except Exception as e:

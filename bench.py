@@ -159,15 +159,15 @@ def _error_line(msg: str, root: str | None = None) -> str:
 # the TPU headline stays on top when the tunnel is alive, but a dead
 # tunnel no longer means an evidence-free round — host_pool_scaling,
 # startup_to_first_step, async_decoupling, update_wall,
-# replay_sample_throughput and multihost_scaling are measured on the
-# CPU backend regardless. BENCH_CPU_METRICS overrides the set (comma
+# replay_sample_throughput, multihost_scaling and serving_latency
+# (ISSUE 10) are measured on the CPU backend regardless. BENCH_CPU_METRICS overrides the set (comma
 # list of bench/suite.py names); "0"/"none"/"off" disables. Trend the
 # block across rounds with scripts/bench_trend.py. Budget note: the
 # multihost grid adds ~2 minutes of multi-process cluster runs on top
 # of the 2-3 minutes the rest of the block costs on this host.
 DEFAULT_CPU_METRICS = (
     "host_pool_scaling,startup_to_first_step,async_decoupling,update_wall,"
-    "replay_sample_throughput,multihost_scaling"
+    "replay_sample_throughput,multihost_scaling,serving_latency"
 )
 
 

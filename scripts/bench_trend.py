@@ -131,23 +131,27 @@ def cpu_cell(rec: dict | None, name: str) -> str:
     return _fmt(entry.get("value"))
 
 
-def _multihost_entry(rec: dict | None):
-    """(entry, None) when the round carries a well-formed
-    multihost_scaling dict, else (None, sentinel cell) — the shared
-    presence/malformed ladder of every multihost sub-row: `?` for an
-    unparseable round, `-` before the metric existed, `err` for a
-    failed subprocess, `?` for a present-but-malformed entry."""
+def _metric_entry(rec: dict | None, name: str):
+    """(entry, None) when the round carries a well-formed cpu_metrics
+    dict for `name`, else (None, sentinel cell) — the shared
+    presence/malformed ladder of every sub-row: `?` for an unparseable
+    round, `-` before the metric existed, `err` for a failed
+    subprocess, `?` for a present-but-malformed entry."""
     if rec is None:
         return None, "?"
     block = rec.get("cpu_metrics")
-    if not isinstance(block, dict) or "multihost_scaling" not in block:
+    if not isinstance(block, dict) or name not in block:
         return None, "-"
-    entry = block["multihost_scaling"]
+    entry = block[name]
     if not isinstance(entry, dict):
         return None, "?"
     if "error" in entry:
         return None, "err"
     return entry, None
+
+
+def _multihost_entry(rec: dict | None):
+    return _metric_entry(rec, "multihost_scaling")
 
 
 def _numeric_cell(value) -> str:
@@ -182,6 +186,18 @@ def multihost_proc_cell(rec: dict | None, n: int) -> str:
     if not isinstance(sub, dict):
         return "?"
     return _numeric_cell(sub.get("aggregate_steps_per_s"))
+
+
+def serving_cell(rec: dict | None, field: str) -> str:
+    """One micro-batched sub-metric of the serving SLO record (ISSUE 10
+    satellite: the p50/p99/actions-per-s curve trends per round)."""
+    entry, cell = _metric_entry(rec, "serving_latency")
+    if entry is None:
+        return cell
+    sub = entry.get("micro_batched")
+    if not isinstance(sub, dict):
+        return "?"
+    return _numeric_cell(sub.get(field))
 
 
 def multihost_straggler_cell(rec: dict | None) -> str:
@@ -227,6 +243,16 @@ def trend_rows(root: str) -> tuple[list[int], list[tuple[str, list[str]]]]:
                 "multihost_scaling.straggler_gossip_x",
                 [multihost_straggler_cell(r) for r in recs],
             ))
+        if name == "serving_latency":
+            # Micro-batched gateway sub-rows (ISSUE 10): the SLO curve
+            # (p50/p99 at saturating closed-loop concurrency) and the
+            # absolute actions/s, so a latency regression is visible
+            # even when the headline speedup ratio holds.
+            for field in ("actions_per_s", "p50_ms", "p99_ms"):
+                rows.append((
+                    f"serving_latency.{field}",
+                    [serving_cell(r, field) for r in recs],
+                ))
     return rounds, rows
 
 

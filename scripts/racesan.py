@@ -43,14 +43,20 @@ def main(argv=None) -> int:
         "tier-1 deterministic)",
     )
     p.add_argument(
-        "--scenario", choices=("all", "queue", "publisher", "mailbox"),
+        "--scenario",
+        choices=("all", "queue", "publisher", "mailbox", "batcher"),
         default="all",
-        help="which unit to exercise (default: all three, split evenly)",
+        help="which unit to exercise (default: all four, split evenly)",
     )
     p.add_argument(
         "--consumer", choices=("snapshot", "alias"), default="snapshot",
         help="queue consumer mode: 'alias' reproduces the reverted "
         "PR 6 copy-on-transfer consumer (expected exit 1)",
+    )
+    p.add_argument(
+        "--submit", choices=("copy", "alias"), default="copy",
+        help="batcher submit mode: 'alias' reproduces a zero-copy "
+        "payload submit under client buffer reuse (expected exit 1)",
     )
     p.add_argument(
         "--no-poison", action="store_true",
@@ -79,6 +85,13 @@ def main(argv=None) -> int:
             out = racesan.exercise_sweep(
                 range(args.seed0, args.seed0 + args.schedules),
                 lambda s: racesan.exercise_mailbox(s, poison=poison),
+            )
+        elif args.scenario == "batcher":
+            out = racesan.exercise_sweep(
+                range(args.seed0, args.seed0 + args.schedules),
+                lambda s: racesan.exercise_batcher(
+                    s, poison=poison, alias_submit=(args.submit == "alias")
+                ),
             )
         else:
             out = racesan.exercise_sweep(

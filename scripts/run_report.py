@@ -271,6 +271,28 @@ def resource_summary(rows: list[dict]) -> list[str]:
             f"{rp.get('capacity_multiplier', 1.0)}x transitions/byte; "
             f"codecs {rp.get('codec_mix', '?')}"
         )
+    # Policy-serving gateway (serving/batcher.py gauge, ISSUE 10):
+    # latency percentiles and occupancy say whether the micro-batch
+    # window is tuned right; rejected counts are the 503 back-pressure
+    # record. Counters are cumulative, so the LAST row is the tally
+    # (recompile convention above); queue depth trends across rows.
+    sv_rows = [
+        r["serving"] for r in rows if isinstance(r.get("serving"), dict)
+    ]
+    if sv_rows:
+        depths = [s.get("queue_depth", 0) for s in sv_rows]
+        last_s = sv_rows[-1]
+        out.append(
+            f"- **serving**: {last_s.get('requests_total', 0)} requests / "
+            f"{last_s.get('actions_total', 0)} actions "
+            f"({last_s.get('flushes_total', 0)} flushes, occupancy "
+            f"{last_s.get('batch_occupancy', 0.0):.2f}); latency p50 "
+            f"{last_s.get('latency_p50_ms', 0.0)} ms / p99 "
+            f"{last_s.get('latency_p99_ms', 0.0)} ms; queue depth mean "
+            f"{np_mean(depths):.1f} / max {max(depths)}; rejected "
+            f"{last_s.get('rejected_total', 0)}, errors "
+            f"{last_s.get('errors_total', 0)}"
+        )
     # Per-device peaks across the run (devices without allocator stats,
     # e.g. CPU, appear with no byte fields and are reported as such).
     dev_peak: dict[int, dict] = {}

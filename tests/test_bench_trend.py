@@ -151,3 +151,52 @@ def test_parses_committed_rounds():
     assert rounds, "no committed rounds found"
     headline = dict(rows)["tpu_headline"]
     assert any(c != "?" for c in headline), headline
+
+
+def test_serving_latency_sub_rows(tmp_path):
+    """ISSUE 10 satellite: serving_latency expands into micro-batched
+    actions/s + p50/p99 sub-rows; '-' before the metric existed, '?'
+    for malformed sub-records, 'err' for failed subprocesses."""
+    mod = _load()
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps({
+        "metric": "a2c", "value": 1.0,
+        "cpu_metrics": {"host_pool_scaling": {"value": 3.0}},
+    }) + "\n")
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps({
+        "metric": "a2c", "value": 1.0,
+        "cpu_metrics": {
+            "serving_latency": {
+                "value": 6.6,
+                "micro_batched": {
+                    "actions_per_s": 445.6, "p50_ms": 66.2,
+                    "p99_ms": 182.4,
+                },
+            },
+        },
+    }) + "\n")
+    (tmp_path / "BENCH_r03.json").write_text(json.dumps({
+        "metric": "a2c", "value": 1.0,
+        "cpu_metrics": {
+            "serving_latency": {"value": 1.0, "micro_batched": "oops"},
+        },
+    }) + "\n")
+    (tmp_path / "BENCH_r04.json").write_text(json.dumps({
+        "metric": "a2c", "value": 1.0,
+        "cpu_metrics": {"serving_latency": {"error": "rc=1"}},
+    }) + "\n")
+    rounds, rows = mod.trend_rows(str(tmp_path))
+    assert rounds == [1, 2, 3, 4]
+    table = dict(rows)
+    assert table["serving_latency"] == ["-", "6.6", "1", "err"]
+    assert table["serving_latency.actions_per_s"] == [
+        "-", "445.6", "?", "err",
+    ]
+    assert table["serving_latency.p50_ms"] == ["-", "66.2", "?", "err"]
+    assert table["serving_latency.p99_ms"] == ["-", "182.4", "?", "err"]
+    labels = [label for label, _ in rows]
+    i = labels.index("serving_latency")
+    assert labels[i + 1:i + 4] == [
+        "serving_latency.actions_per_s",
+        "serving_latency.p50_ms",
+        "serving_latency.p99_ms",
+    ]

@@ -319,3 +319,22 @@ def test_cli_live_introspection_end_to_end(tmp_path):
         if e["kind"] == "compile"
     ]
     assert comps, "no compile events from a fresh jit process"
+
+
+def test_ephemeral_port_reported_on_session_object(tmp_path):
+    """ISSUE 10 satellite: serve_port=0 binds an OS-assigned port, and
+    the ACTUAL bound port is readable off the session (exporter_port)
+    and recorded in the exporter_start event — scripts and CI read it
+    instead of racing for a fixed port."""
+    with _session(tmp_path) as s:
+        port = s.exporter_port
+        assert port not in (None, 0)
+        assert s.exporter.url.endswith(f":{port}")
+        status, _ = _get(s.exporter.url + "/healthz")
+        assert status == 200
+    events = _read_jsonl(os.path.join(tmp_path, "events.jsonl"))
+    starts = [e for e in events if e.get("kind") == "exporter_start"]
+    assert starts and starts[0]["port"] == port
+    # No exporter -> None, not an attribute error.
+    with _session(tmp_path, serve_port=None) as s2:
+        assert s2.exporter_port is None

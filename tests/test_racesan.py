@@ -219,12 +219,64 @@ def test_quick_profile_sweeps_clean():
     out = racesan.quick_profile(schedules=100)
     assert out["schedules"] == 100
     assert out["races"] == 0
-    # the sweep actually exercised all three units
+    # the sweep actually exercised all four units
     assert out["queue"]["consumed"] > 0
     assert out["publisher"]["reads"] > 0
     assert out["publisher"]["published"] > 0
     assert out["mailbox"]["deposits"] > 0
     assert out["mailbox"]["takes"] > 0
+    assert out["batcher"]["responses"] > 0
+    assert out["batcher"]["swaps"] > 0
+
+
+# ---------------------------------------------------------------------------
+# serving micro-batcher (ISSUE 10)
+# ---------------------------------------------------------------------------
+
+
+def test_batcher_exerciser_sweeps_clean_with_poison():
+    """Request/flush/hot-swap interleavings over the serving
+    MicroBatcher + PolicyStore: every response exact for the version it
+    claims, per-client versions monotone, under the submit-freeze and
+    swap-freeze poisoners."""
+    out = racesan.exercise_sweep(
+        range(12), lambda s: racesan.exercise_batcher(s, poison=True)
+    )
+    assert out["races"] == 0
+    assert out["responses"] > 0 and out["swaps"] > 0
+
+
+def test_batcher_exerciser_replays_bit_identically():
+    a = racesan.exercise_batcher(3, poison=True)
+    b = racesan.exercise_batcher(3, poison=True)
+    assert a == b
+
+
+def test_aliasing_submit_is_detected_at_the_write_site():
+    """A zero-copy submit under client buffer reuse (the PR 6 class at
+    the serving handoff): the poisoner freezes the enqueued payload —
+    which IS the client's buffer — so the client's next refill crashes
+    at the write on every schedule."""
+    for seed in range(3):
+        with pytest.raises(ValueError, match="read-only"):
+            racesan.exercise_batcher(seed, alias_submit=True, poison=True)
+
+
+def test_copying_submit_tolerates_client_buffer_reuse():
+    """The correct copy-on-submit under the SAME poisoner: the freeze
+    lands on the batcher's own copy, the client's buffer stays
+    writable, and the sweep is clean — reuse is the client contract."""
+    out = racesan.exercise_batcher(0, poison=True)
+    assert out["race_detected"] is False
+
+
+def test_buggy_swapper_is_detected_at_the_write_site():
+    """A swapper refreshing its RETAINED params tree in place after
+    installing it — the write-after-publish class at the policy store —
+    crashes at the write under freeze_on_swap on every schedule."""
+    for seed in range(3):
+        with pytest.raises(ValueError, match="read-only"):
+            racesan.exercise_batcher(seed, buggy_swapper=True, poison=True)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,556 @@
+"""Policy-serving gateway (ISSUE 10): end-to-end loopback-HTTP tests —
+served actions match direct act(), micro-batch equivalence at mixed
+request sizes, hot-swap under in-flight load, 503 on dispatcher stall —
+plus store/batcher/engine units."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+from actor_critic_tpu import serving
+from actor_critic_tpu.algos import ppo
+from actor_critic_tpu.envs import make_cartpole
+
+
+# ---------------------------------------------------------------- helpers
+
+
+class StubEngine:
+    """jax-free engine: action = obs[:, 0] * params['scale'][0]."""
+
+    max_rows = 8
+
+    def __init__(self, pad_s: float = 0.0):
+        self.pad_s = pad_s
+        self.flush_rows: list[int] = []
+
+    def prepare_params(self, params):
+        return {k: np.array(v) for k, v in params.items()}
+
+    def act(self, params, obs):
+        if self.pad_s:
+            time.sleep(self.pad_s)
+        obs = np.asarray(obs)
+        self.flush_rows.append(obs.shape[0])
+        return obs[:, 0] * params["scale"][0]
+
+
+def _post(url: str, body: dict, timeout: float = 30.0):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(url: str, timeout: float = 10.0):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+@pytest.fixture
+def ppo_serving():
+    """A real PPO CartPole engine + params + a warmed gateway on an
+    ephemeral port; yields (gateway, engine, raw params, spec, cfg)."""
+    spec = make_cartpole().spec
+    cfg = ppo.PPOConfig(hidden=(16, 16))
+    engine = serving.PolicyEngine(
+        spec, cfg, algo="ppo", buckets=(1, 2, 4, 8)
+    )
+    params = serving.init_params(spec, cfg, "ppo", seed=0)
+    store = serving.PolicyStore()
+    store.register("default", engine, params)
+    engine.warm(store.get().params)
+    gw = serving.ServeGateway(store, port=0, max_wait_us=500.0)
+    yield gw, engine, params, spec, cfg
+    gw.close()
+
+
+# ---------------------------------------------------------------- units
+
+
+def test_policy_store_register_swap_and_routes():
+    store = serving.PolicyStore()
+    eng = StubEngine()
+    store.register("a", eng, {"scale": np.ones(1, np.float32)})
+    store.register("b", eng, {"scale": np.full(1, 2.0, np.float32)})
+    assert store.default_id == "a"  # first registration wins
+    assert store.ids() == {"a": 0, "b": 0}
+    assert store.get().policy_id == "a"
+    assert store.get("b").version == 0
+    with pytest.raises(serving.UnknownPolicy):
+        store.get("nope")
+    with pytest.raises(ValueError):
+        store.register("a", eng, {"scale": np.ones(1)})
+    old = store.get("a")
+    new = store.swap("a", {"scale": np.full(1, 5.0, np.float32)})
+    assert new.version == 1 and store.get("a").version == 1
+    # Handles are immutable snapshots: the pre-swap handle still serves
+    # its original params (in-flight requests never see a torn swap).
+    assert float(old.params["scale"][0]) == 1.0
+    assert float(new.params["scale"][0]) == 5.0
+
+
+def test_batcher_groups_mixed_sizes_and_preserves_order():
+    store = serving.PolicyStore()
+    eng = StubEngine()
+    store.register("default", eng, {"scale": np.ones(1, np.float32)})
+    batcher = serving.MicroBatcher(store, start=False, max_wait_us=0.0)
+    reqs = [
+        batcher.submit(np.full((n, 3), float(i + 1), np.float32))
+        for i, n in enumerate((1, 3, 2, 8, 1))
+    ]
+    while batcher.queue_depth():
+        batcher._flush_once(block=False)
+    for i, (req, n) in enumerate(zip(reqs, (1, 3, 2, 8, 1))):
+        actions, version = req.result
+        assert version == 0
+        np.testing.assert_array_equal(
+            actions, np.full(n, float(i + 1), np.float32)
+        )
+    # 1+3+2 fit the 8-row budget, the 8-row request does not (requests
+    # are never split), and the trailing 1 backfills the remaining
+    # slack of the FIRST flush — standby-style packing; the 8 flushes
+    # alone after.
+    assert eng.flush_rows == [7, 8]
+
+
+def test_batcher_owns_the_payload():
+    """submit() copies: a client reusing its buffer after submit must
+    not tear an already-enqueued request (PR 6 zero-copy class)."""
+    store = serving.PolicyStore()
+    store.register("default", StubEngine(), {"scale": np.ones(1, np.float32)})
+    batcher = serving.MicroBatcher(store, start=False)
+    buf = np.full((2, 3), 7.0, np.float32)
+    req = batcher.submit(buf)
+    buf.fill(-1.0)  # client-side reuse before the flush
+    batcher._flush_once(block=False)
+    np.testing.assert_array_equal(req.result[0], [7.0, 7.0])
+
+
+def test_batcher_rejects_oversized_and_overflow():
+    store = serving.PolicyStore()
+    store.register("default", StubEngine(), {"scale": np.ones(1, np.float32)})
+    batcher = serving.MicroBatcher(store, start=False, queue_limit=2)
+    with pytest.raises(ValueError):
+        batcher.submit(np.zeros((9, 3), np.float32))  # > max_rows=8
+    batcher.submit(np.zeros((1, 3), np.float32))
+    batcher.submit(np.zeros((1, 3), np.float32))
+    with pytest.raises(serving.QueueFull):
+        batcher.submit(np.zeros((1, 3), np.float32))
+    assert batcher.metrics.snapshot()["rejected_total"] == 1
+
+
+def test_engine_rejects_bad_config():
+    spec = make_cartpole().spec
+    cfg = ppo.PPOConfig(hidden=(8,))
+    with pytest.raises(ValueError):
+        serving.PolicyEngine(spec, cfg, buckets=())
+    with pytest.raises(ValueError):
+        serving.PolicyEngine(spec, cfg, buckets=(0, 4))
+    with pytest.raises(ValueError):
+        serving.make_act_program(spec, cfg, algo="ddpg", sample=True)
+    with pytest.raises(ValueError):
+        serving.make_act_program(spec, cfg, algo="impala")
+
+
+# ---------------------------------------------------------------- e2e HTTP
+
+
+def test_served_actions_match_direct_act(ppo_serving):
+    """POST /v1/act == the greedy program applied directly: the gateway
+    adds batching/padding, never different actions."""
+    gw, engine, params, spec, cfg = ppo_serving
+    rng = np.random.default_rng(0)
+    obs = rng.normal(size=(5, *spec.obs_shape)).astype(np.float32)
+    direct = np.asarray(
+        jax.jit(ppo.make_greedy_act(spec, cfg))(params, obs)
+    )
+    status, body = _post(gw.url + "/v1/act", {"obs": obs.tolist()})
+    assert status == 200
+    assert body["policy"] == "default" and body["version"] == 0
+    np.testing.assert_array_equal(np.asarray(body["actions"]), direct)
+    # Single-obs auto-batching: same action, unwrapped payload.
+    status, body = _post(gw.url + "/v1/act", {"obs": obs[0].tolist()})
+    assert status == 200
+    assert np.asarray(body["actions"]).shape == direct[0].shape
+    assert np.asarray(body["actions"]) == direct[0]
+
+
+def test_micro_batch_equivalence_at_mixed_request_sizes(ppo_serving):
+    """Concurrent requests of mixed sizes, flushed together through the
+    bucketed program, answer exactly what each would get alone."""
+    gw, engine, params, spec, cfg = ppo_serving
+    rng = np.random.default_rng(1)
+    sizes = (1, 3, 2, 1, 4)
+    payloads = [
+        rng.normal(size=(n, *spec.obs_shape)).astype(np.float32)
+        for n in sizes
+    ]
+    direct = jax.jit(ppo.make_greedy_act(spec, cfg))
+    results: list = [None] * len(sizes)
+
+    def worker(i: int) -> None:
+        results[i] = _post(
+            gw.url + "/v1/act", {"obs": payloads[i].tolist()}
+        )
+
+    threads = [
+        threading.Thread(target=worker, args=(i,))
+        for i in range(len(sizes))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    for i, n in enumerate(sizes):
+        status, body = results[i]
+        assert status == 200, body
+        np.testing.assert_array_equal(
+            np.asarray(body["actions"]),
+            np.asarray(direct(params, payloads[i])),
+        )
+
+
+def test_unknown_policy_and_bad_payloads(ppo_serving):
+    gw, *_ = ppo_serving
+    status, body = _post(gw.url + "/v1/act", {"obs": [0.0] * 4,
+                                              "policy": "ghost"})
+    assert status == 404 and "ghost" in body["error"]
+    status, body = _post(gw.url + "/v1/act", {})
+    assert status == 400
+    status, body = _post(gw.url + "/v1/act", {"obs": [[0.0, 1.0]]})
+    assert status == 400 and "obs must be shaped" in body["error"]
+    status, body = _post(gw.url + "/v1/act", {"obs": "garbage"})
+    assert status == 400
+
+
+def test_hot_swap_under_in_flight_load():
+    """Swaps land mid-traffic without dropping requests: every response
+    is exact for the version it claims, versions only move forward."""
+    store = serving.PolicyStore()
+    eng = StubEngine(pad_s=0.002)  # keep flushes slow enough to overlap
+    store.register("default", eng, {"scale": np.ones(1, np.float32)})
+    gw = serving.ServeGateway(store, port=0, max_wait_us=500.0)
+    try:
+        stop = threading.Event()
+        failures: list = []
+
+        def client(c: int) -> None:
+            last_version = -1
+            i = 0
+            while not stop.is_set():
+                fill = float(100 * c + i + 1)
+                status, body = _post(
+                    gw.url + "/v1/act",
+                    {"obs": [[fill, 0.0], [fill, 0.0]]},
+                )
+                if status != 200:
+                    failures.append((c, i, status, body))
+                    return
+                v = body["version"]
+                expect = fill * (v + 1.0)
+                if body["actions"] != [expect, expect] or v < last_version:
+                    failures.append((c, i, body))
+                    return
+                last_version = v
+                i += 1
+
+        threads = [
+            threading.Thread(target=client, args=(c,)) for c in range(3)
+        ]
+        for t in threads:
+            t.start()
+        for v in range(1, 5):
+            time.sleep(0.05)
+            # scale == version + 1, the invariant clients verify
+            store.swap(
+                "default",
+                {"scale": np.full(1, v + 1.0, np.float32)},
+                version=v,
+            )
+        time.sleep(0.05)
+        stop.set()
+        for t in threads:
+            t.join(10)
+        assert not failures, failures[:3]
+        assert store.get("default").version == 4
+    finally:
+        gw.close()
+
+
+def test_swap_endpoint_roundtrip(tmp_path):
+    """POST /v1/swap restores a params-only checkpoint and bumps the
+    served version without dropping the route."""
+    spec = make_cartpole().spec
+    cfg = ppo.PPOConfig(hidden=(8, 8))
+    engine = serving.PolicyEngine(spec, cfg, algo="ppo", buckets=(1, 4))
+    p0 = serving.init_params(spec, cfg, "ppo", seed=0)
+    p1 = serving.init_params(spec, cfg, "ppo", seed=1)
+    serving.export_policy_params(str(tmp_path / "ck"), p1)
+    store = serving.PolicyStore()
+    store.register("default", engine, p0)
+    gw = serving.ServeGateway(store, port=0)
+    try:
+        status, body = _post(
+            gw.url + "/v1/swap",
+            {"policy": "default", "checkpoint": str(tmp_path / "ck")},
+        )
+        assert status == 200 and body["version"] == 1
+        swapped = store.get("default").params
+        np.testing.assert_allclose(
+            np.asarray(swapped["params"]["torso"]["dense_0"]["kernel"]),
+            np.asarray(p1["params"]["torso"]["dense_0"]["kernel"]),
+            rtol=1e-6,
+        )
+        status, body = _post(
+            gw.url + "/v1/swap", {"policy": "default"}
+        )
+        assert status == 400
+        status, body = _post(
+            gw.url + "/v1/swap",
+            {"policy": "ghost", "checkpoint": str(tmp_path / "ck")},
+        )
+        assert status == 404
+    finally:
+        gw.close()
+
+
+def test_503_on_dispatcher_stall():
+    """A dead dispatcher or a full queue must answer 503 (load shed),
+    and /healthz must flip to 503 'stalled'."""
+    store = serving.PolicyStore()
+    store.register("default", StubEngine(), {"scale": np.ones(1, np.float32)})
+    batcher = serving.MicroBatcher(store, queue_limit=4, start=True)
+    gw = serving.ServeGateway(
+        store, port=0, batcher=batcher, request_timeout_s=2.0,
+        stall_after_s=0.2,
+    )
+    try:
+        # Stall the dispatcher: close() joins the thread but we keep
+        # the server up — submissions now see DispatcherDown.
+        batcher.close()
+        status, body = _post(gw.url + "/v1/act", {"obs": [[1.0, 2.0]]})
+        assert status == 503, body
+        status, raw = _get(gw.url + "/healthz")
+        assert status == 503
+        assert json.loads(raw)["status"] == "stalled"
+    finally:
+        gw.close()
+
+
+def test_queue_overflow_returns_503():
+    store = serving.PolicyStore()
+    store.register("default", StubEngine(), {"scale": np.ones(1, np.float32)})
+    # Unstarted dispatcher with a tiny queue: requests pile up.
+    batcher = serving.MicroBatcher(store, queue_limit=2, start=False)
+    # submit() refuses only when a started thread died; fill directly.
+    batcher.submit(np.zeros((1, 2), np.float32))
+    batcher.submit(np.zeros((1, 2), np.float32))
+    gw = serving.ServeGateway(store, port=0, batcher=batcher)
+    try:
+        status, body = _post(gw.url + "/v1/act", {"obs": [[1.0, 2.0]]})
+        assert status == 503 and "capacity" in body["error"]
+    finally:
+        gw.close()
+
+
+def test_metrics_and_healthz_surface_serving_gauges(ppo_serving):
+    gw, *_ = ppo_serving
+    _post(gw.url + "/v1/act", {"obs": [[0.0, 0.0, 0.0, 0.0]]})
+    status, text = _get(gw.url + "/metrics")
+    assert status == 200
+    assert "actor_critic_serving_requests_total" in text
+    assert "actor_critic_serving_latency_p99_ms" in text
+    assert "actor_critic_serving_requests_default" in text
+    status, raw = _get(gw.url + "/healthz")
+    assert status == 200
+    health = json.loads(raw)
+    assert health["dispatcher"]["alive"] is True
+    assert health["policies"] == {"default": 0}
+    status, raw = _get(gw.url + "/v1/policies")
+    assert status == 200
+    assert json.loads(raw)["default"] == "default"
+
+
+def test_ephemeral_port_is_reported():
+    """port=0 binds an OS-assigned port, reported on the gateway object
+    (the ISSUE 10 satellite contract the loadgen/CI rely on)."""
+    store = serving.PolicyStore()
+    store.register("default", StubEngine(), {"scale": np.ones(1, np.float32)})
+    a = serving.ServeGateway(store, port=0)
+    b = serving.ServeGateway(store, port=0)
+    try:
+        assert a.port != 0 and b.port != 0 and a.port != b.port
+        assert str(a.port) in a.url
+        status, _ = _get(a.url + "/healthz")
+        assert status == 200
+    finally:
+        a.close()
+        b.close()
+
+
+def test_multi_policy_routing_over_http():
+    """Two resident policies answer under their own ids; default routes
+    unnamed requests; per-policy counters split on /metrics."""
+    store = serving.PolicyStore()
+    eng = StubEngine()
+    store.register("champ", eng, {"scale": np.ones(1, np.float32)})
+    store.register("canary", eng, {"scale": np.full(1, 3.0, np.float32)})
+    gw = serving.ServeGateway(store, port=0, max_wait_us=0.0)
+    try:
+        status, body = _post(
+            gw.url + "/v1/act", {"obs": [[2.0, 0.0]], "policy": "canary"}
+        )
+        assert status == 200 and body["actions"] == [6.0]
+        status, body = _post(gw.url + "/v1/act", {"obs": [[2.0, 0.0]]})
+        assert status == 200 and body["actions"] == [2.0]
+        assert body["policy"] == "champ"
+        _, text = _get(gw.url + "/metrics")
+        assert "actor_critic_serving_requests_champ 1" in text
+        assert "actor_critic_serving_requests_canary 1" in text
+    finally:
+        gw.close()
+
+
+def test_run_report_resources_serving_row():
+    """run_report's Resources section renders the serving gauge row
+    when serving metrics are present (ISSUE 10 docs satellite)."""
+    import importlib.util
+    from pathlib import Path
+
+    spec = importlib.util.spec_from_file_location(
+        "run_report",
+        Path(__file__).parent.parent / "scripts" / "run_report.py",
+    )
+    run_report = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(run_report)
+
+    rows = [
+        {"ts": 1.0, "recompiles": 0, "serving": {
+            "requests_total": 10, "actions_total": 40, "flushes_total": 4,
+            "batch_occupancy": 0.62, "latency_p50_ms": 3.1,
+            "latency_p99_ms": 9.9, "queue_depth": 2,
+            "rejected_total": 1, "errors_total": 0}},
+        {"ts": 2.0, "recompiles": 0, "serving": {
+            "requests_total": 30, "actions_total": 120, "flushes_total": 11,
+            "batch_occupancy": 0.7, "latency_p50_ms": 3.0,
+            "latency_p99_ms": 8.5, "queue_depth": 4,
+            "rejected_total": 1, "errors_total": 0}},
+    ]
+    text = "\n".join(run_report.resource_summary(rows))
+    assert "**serving**" in text
+    assert "30 requests / 120 actions" in text
+    assert "p50 3.0 ms / p99 8.5 ms" in text
+    assert "queue depth mean 3.0 / max 4" in text
+    assert "rejected 1" in text
+    # No serving samples -> no serving row.
+    assert "serving" not in "\n".join(
+        run_report.resource_summary([{"ts": 1.0, "recompiles": 0}])
+    )
+
+
+def test_sampled_session_writes_serving_gauge(tmp_path):
+    """A gateway under a sampling TelemetrySession lands `serving` rows
+    in resources.jsonl — the run_report Resources row's source."""
+    from actor_critic_tpu import telemetry
+
+    store = serving.PolicyStore()
+    store.register("default", StubEngine(), {"scale": np.ones(1, np.float32)})
+    session = telemetry.TelemetrySession(
+        tmp_path, resource_interval_s=0.05, serve_port=None
+    )
+    gw = serving.ServeGateway(store, port=0, session=session)
+    try:
+        _post(gw.url + "/v1/act", {"obs": [[1.0, 2.0]]})
+        time.sleep(0.3)
+        # The session-rendered /metrics rides the sampler registry.
+        status, text = _get(gw.url + "/metrics")
+        assert status == 200
+        assert "actor_critic_serving_requests_total" in text
+        assert "actor_critic_up 1" in text  # full exporter exposition
+    finally:
+        gw.close()
+        session.close()
+    rows = [
+        json.loads(line)
+        for line in (tmp_path / "resources.jsonl").read_text().splitlines()
+    ]
+    assert any(isinstance(r.get("serving"), dict) for r in rows)
+
+
+def test_mirror_backend_matches_xla_backend():
+    """backend='mirror' (numpy host mirror, no XLA dispatch) serves the
+    same greedy actions as the jitted program — continuous-control PPO,
+    where greedy == the policy mean (discrete argmax could flip on
+    float32-vs-numpy near-ties)."""
+    from actor_critic_tpu.envs import make_pendulum
+
+    spec = make_pendulum().spec
+    cfg = ppo.PPOConfig(hidden=(16, 16))
+    params = serving.init_params(spec, cfg, "ppo", seed=0)
+    xla = serving.PolicyEngine(spec, cfg, algo="ppo", buckets=(1, 4, 8))
+    mirror = serving.PolicyEngine(
+        spec, cfg, algo="ppo", buckets=(1, 4, 8), backend="mirror"
+    )
+    assert mirror.warm(mirror.prepare_params(params)) == 0
+    rng = np.random.default_rng(0)
+    obs = rng.normal(size=(5, *spec.obs_shape)).astype(np.float32)
+    np.testing.assert_allclose(
+        mirror.act(mirror.prepare_params(params), obs),
+        xla.act(xla.prepare_params(params), obs),
+        rtol=1e-5, atol=1e-6,
+    )
+    # Mirror params install as frozen numpy snapshots (publisher
+    # contract) and reject conv torsos / sampling.
+    frozen = mirror.prepare_params(params)
+    leaf = frozen["params"]["pi_torso"]["dense_0"]["kernel"]
+    with pytest.raises(ValueError):
+        leaf[0, 0] = 1.0
+    with pytest.raises(ValueError):
+        serving.PolicyEngine(
+            spec, cfg, algo="ppo", backend="mirror", sample=True
+        )
+    with pytest.raises(ValueError):
+        serving.PolicyEngine(spec, cfg, algo="ppo", backend="tpu")
+
+
+def test_mirror_backend_serves_over_http():
+    """A mirror-backend gateway answers /v1/act with no compiled
+    programs at all (CPU-only serving host shape)."""
+    from actor_critic_tpu.envs import make_pendulum
+
+    spec = make_pendulum().spec
+    cfg = ppo.PPOConfig(hidden=(8, 8))
+    engine = serving.PolicyEngine(
+        spec, cfg, algo="ppo", buckets=(1, 4), backend="mirror"
+    )
+    params = serving.init_params(spec, cfg, "ppo", seed=0)
+    store = serving.PolicyStore()
+    store.register("default", engine, params)
+    gw = serving.ServeGateway(store, port=0, max_wait_us=200.0)
+    try:
+        status, body = _post(
+            gw.url + "/v1/act", {"obs": [[0.1, 0.2, 0.3]]}
+        )
+        assert status == 200
+        assert np.asarray(body["actions"]).shape == (1, spec.action_dim)
+    finally:
+        gw.close()
