@@ -271,18 +271,21 @@ def train(
     state: Optional[TrainState] = None,
     log_every: int = 0,
     log_fn: Optional[Callable[[int, dict], None]] = None,
+    state_hook: Optional[Callable] = None,
 ) -> tuple[TrainState, dict[str, jax.Array]]:
     """Simple host loop around the fused step (single device).
 
     For N iterations without host logging, the loop body is itself scanned
     on-device (`log_every=0`) so the host dispatches O(1) programs.
+    `state_hook` is the between-dispatch state rewrite seam (curriculum
+    weight installs on mixture fleets — host_loop.fused_train_loop).
     """
     from actor_critic_tpu.algos.host_loop import fused_train_loop
 
     return fused_train_loop(
         make_train_step, init_state, env, cfg, num_iterations,
         seed=seed, state=state, log_every=log_every, log_fn=log_fn,
-        scan_when_silent=True,
+        scan_when_silent=True, state_hook=state_hook,
     )
 
 

@@ -272,6 +272,7 @@ def evaluate(
     key: jax.Array,
     num_envs: int = 32,
     num_steps: int = 256,
+    reset_fn: Optional[Callable] = None,
 ) -> jax.Array:
     """Greedy eval: mean return of each env's FIRST episode (SURVEY §3.4).
 
@@ -282,10 +283,12 @@ def evaluate(
     if no env finishes within the horizon, the mean of the partial
     returns is reported instead — a lower bound, and the only number
     available. One jittable program; used by trainers' periodic eval
-    and the learning tests.
+    and the learning tests. `reset_fn` overrides `env.reset` for
+    partitioned eval fleets (the mixture's type-pinned per-type eval
+    matrix, envs/mixture.py) — the episode loop itself is shared.
     """
     keys = jax.random.split(key, num_envs)
-    env_state, obs = jax.vmap(env.reset)(keys)
+    env_state, obs = jax.vmap(reset_fn or env.reset)(keys)
     init = (env_state, obs, jnp.zeros(num_envs), jnp.ones(num_envs))
 
     def step(carry, _):

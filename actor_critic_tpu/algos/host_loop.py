@@ -917,6 +917,7 @@ def fused_train_loop(
     log_every: int = 0,
     log_fn: Optional[Callable[[int, dict], None]] = None,
     scan_when_silent: bool = False,
+    state_hook: Optional[Callable[[int, object], object]] = None,
 ):
     """Shared host loop around a fused (single-device) train step — the
     single body behind a2c/impala/ddpg/sac `.train`.
@@ -925,6 +926,15 @@ def fused_train_loop(
     scanned on-device so the host dispatches O(1) programs (the a2c/
     impala fast path); otherwise each iteration is one donated jit call
     with optional periodic logging.
+
+    `state_hook(it, state) -> state` runs on the HOST before each
+    dispatch (it = 0-based upcoming iteration) — the between-dispatch
+    rewrite seam the scenario-mixture curriculum uses to install new
+    type-draw weights into the fleet state (envs/mixture.py
+    `set_fleet_weights`; train.py's checkpointed path has the same seam
+    in run_fused). Hooks must preserve every leaf's shape/dtype so the
+    jitted step never retraces; setting one disables the scanned fast
+    path (a host callback cannot run inside `lax.scan`).
     """
     import jax
 
@@ -932,7 +942,7 @@ def fused_train_loop(
         state = init_state(env, cfg, jax.random.key(seed))
     step = make_train_step(env, cfg)
 
-    if scan_when_silent and log_every <= 0:
+    if scan_when_silent and log_every <= 0 and state_hook is None:
         if num_iterations < 1:
             raise ValueError("num_iterations must be >= 1")
 
@@ -963,6 +973,8 @@ def fused_train_loop(
     jit_step = jax.jit(step, donate_argnums=0)
     metrics: dict = {}
     for it in range(num_iterations):
+        if state_hook is not None:
+            state = state_hook(it, state)
         state, metrics = jit_step(state)
         if log_fn is not None and should_log(it + 1, log_every, num_iterations):
             # jaxlint: disable=host-sync (deliberate: the log-cadence

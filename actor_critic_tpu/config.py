@@ -155,6 +155,26 @@ PRESETS: dict[str, Preset] = {
         "(opp_skill=0.5, frame_skip=4, 36px — BASELINE.json:11)",
         env_kwargs={"opp_skill": 0.5, "frame_skip": 4, "size": 36},
     ),
+    # ISSUE 11 — the scenario universe: a heterogeneous fleet of four
+    # env TYPES (domain-randomized per instance AND per episode)
+    # stepping inside one fused XLA program behind the padded shared
+    # obs/action interface (envs/mixture.py). Pair with
+    # `--curriculum "200:1,2,2,2;400:0,1,2,4" --eval-every 25` to shift
+    # the type draw toward the harder members as CartPole-dominated
+    # progress crosses the thresholds.
+    "a2c_mixture": Preset(
+        algo="a2c",
+        env="mixture:cartpole,pendulum,acrobot,maze",
+        config=a2c.A2CConfig(
+            num_envs=1024, rollout_steps=32, lr=1e-3,
+            anneal_iters=400, lr_final=0.0,
+            entropy_coef=0.01, entropy_coef_final=0.0,
+        ),
+        iterations=400,
+        description="A2C on the 4-type scenario-mixture fleet, fused "
+        "(ISSUE 11 scenario universe)",
+        env_kwargs={"randomize": 0.2},
+    ),
     "a3c_pong": Preset(
         algo="a3c",
         env="jax:pong",

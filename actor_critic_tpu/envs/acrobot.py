@@ -1,0 +1,196 @@
+"""Pure-JAX Acrobot-v1 with exact gymnasium dynamics + scenario fleet.
+
+Third classic-control member of the scenario universe (ISSUE 11): the
+underactuated double pendulum, RK4-integrated exactly as gymnasium
+1.2.2's `AcrobotEnv` does (the "book" dynamics variant, one RK4 step of
+`_dsdt` over dt=0.2, angle wrap to [-pi, pi], velocity clips at 4pi/9pi)
+— verified numerically in tests/test_envs.py against the installed
+gymnasium. Reward is -1 per step (0 on the terminating step), episodes
+terminate when -cos(t1) - cos(t1 + t2) > 1 and truncate at 500 steps.
+
+Scenario fleet: `make_acrobot(randomize=0.3)` (or per-param ranges /
+`--env-set link_mass_2=0.5,2.0` strings) draws per-instance gravity,
+link masses, link lengths, and a torque scale in `reset`, stored in
+`AcrobotState.scenario`, so a vmapped fleet of thousands of different
+acrobots steps inside one fused XLA program and `auto_reset` re-draws
+per episode (envs/jax_env.py scenario docstring). Center-of-mass
+positions track the drawn lengths as lc_i = l_i / 2 (gymnasium's
+constants satisfy this at the defaults, so the unrandomized env
+reproduces gymnasium bit-for-bit semantics); link inertia stays at the
+gymnasium constant 1.0.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from actor_critic_tpu.envs.jax_env import (
+    EnvSpec, JaxEnv, auto_reset, draw_scenario, scenario_ranges,
+)
+
+GRAVITY = 9.8
+LINK_MASS_1 = 1.0
+LINK_MASS_2 = 1.0
+LINK_LENGTH_1 = 1.0
+LINK_LENGTH_2 = 1.0
+LINK_MOI = 1.0
+TORQUE = 1.0  # |torque| of actions 0/2; action 1 is zero torque
+DT = 0.2
+MAX_VEL_1 = 4.0 * jnp.pi
+MAX_VEL_2 = 9.0 * jnp.pi
+MAX_STEPS = 500
+
+SCENARIO_DEFAULTS = {
+    "gravity": GRAVITY,
+    "link_mass_1": LINK_MASS_1,
+    "link_mass_2": LINK_MASS_2,
+    "link_length_1": LINK_LENGTH_1,
+    "link_length_2": LINK_LENGTH_2,
+    "torque": TORQUE,
+}
+
+
+class AcrobotScenario(NamedTuple):
+    """Per-instance physics (f32 scalars riding the env state)."""
+
+    gravity: jax.Array
+    link_mass_1: jax.Array
+    link_mass_2: jax.Array
+    link_length_1: jax.Array
+    link_length_2: jax.Array
+    torque: jax.Array
+
+
+class AcrobotState(NamedTuple):
+    theta1: jax.Array
+    theta2: jax.Array
+    dtheta1: jax.Array
+    dtheta2: jax.Array
+    t: jax.Array
+    key: jax.Array
+    scenario: AcrobotScenario
+
+
+def _obs(s: AcrobotState) -> jax.Array:
+    return jnp.stack([
+        jnp.cos(s.theta1), jnp.sin(s.theta1),
+        jnp.cos(s.theta2), jnp.sin(s.theta2),
+        s.dtheta1, s.dtheta2,
+    ]).astype(jnp.float32)
+
+
+def _wrap(x: jax.Array) -> jax.Array:
+    """Wrap an angle to [-pi, pi] (gymnasium's `wrap(x, -pi, pi)`)."""
+    return ((x + jnp.pi) % (2.0 * jnp.pi)) - jnp.pi
+
+
+def _dsdt(y: jax.Array, torque: jax.Array, sc: AcrobotScenario) -> jax.Array:
+    """Time derivative of [theta1, theta2, dtheta1, dtheta2] under the
+    gymnasium "book" dynamics, with the COM positions tied to half the
+    link lengths (equal to gymnasium's constants at the defaults)."""
+    m1, m2 = sc.link_mass_1, sc.link_mass_2
+    l1 = sc.link_length_1
+    lc1 = 0.5 * sc.link_length_1
+    lc2 = 0.5 * sc.link_length_2
+    i1 = i2 = jnp.float32(LINK_MOI)
+    g = sc.gravity
+    theta1, theta2, dtheta1, dtheta2 = y[0], y[1], y[2], y[3]
+    d1 = (
+        m1 * lc1**2
+        + m2 * (l1**2 + lc2**2 + 2.0 * l1 * lc2 * jnp.cos(theta2))
+        + i1 + i2
+    )
+    d2 = m2 * (lc2**2 + l1 * lc2 * jnp.cos(theta2)) + i2
+    phi2 = m2 * lc2 * g * jnp.cos(theta1 + theta2 - jnp.pi / 2.0)
+    phi1 = (
+        -m2 * l1 * lc2 * dtheta2**2 * jnp.sin(theta2)
+        - 2.0 * m2 * l1 * lc2 * dtheta2 * dtheta1 * jnp.sin(theta2)
+        + (m1 * lc1 + m2 * l1) * g * jnp.cos(theta1 - jnp.pi / 2.0)
+        + phi2
+    )
+    ddtheta2 = (
+        torque + d2 / d1 * phi1
+        - m2 * l1 * lc2 * dtheta1**2 * jnp.sin(theta2) - phi2
+    ) / (m2 * lc2**2 + i2 - d2**2 / d1)
+    ddtheta1 = -(d2 * ddtheta2 + phi1) / d1
+    return jnp.stack([dtheta1, dtheta2, ddtheta1, ddtheta2])
+
+
+def _rk4_step(y: jax.Array, torque: jax.Array, sc: AcrobotScenario) -> jax.Array:
+    """One classical RK4 step over [0, DT] — gymnasium's `rk4` with a
+    two-point time grid, which is exactly one RK4 update."""
+    dt, dt2 = jnp.float32(DT), jnp.float32(DT / 2.0)
+    k1 = _dsdt(y, torque, sc)
+    k2 = _dsdt(y + dt2 * k1, torque, sc)
+    k3 = _dsdt(y + dt2 * k2, torque, sc)
+    k4 = _dsdt(y + dt * k3, torque, sc)
+    return y + dt / 6.0 * (k1 + 2.0 * k2 + 2.0 * k3 + k4)
+
+
+def _raw_step(state: AcrobotState, action: jax.Array):
+    sc = state.scenario
+    # AVAIL_TORQUE = [-1, 0, +1] scaled by the per-instance torque.
+    torque = (action.astype(jnp.float32) - 1.0) * sc.torque
+    y = jnp.stack([state.theta1, state.theta2, state.dtheta1, state.dtheta2])
+    ns = _rk4_step(y, torque, sc)
+    theta1 = _wrap(ns[0])
+    theta2 = _wrap(ns[1])
+    dtheta1 = jnp.clip(ns[2], -MAX_VEL_1, MAX_VEL_1)
+    dtheta2 = jnp.clip(ns[3], -MAX_VEL_2, MAX_VEL_2)
+    t = state.t + 1
+
+    nstate = AcrobotState(
+        theta1, theta2, dtheta1, dtheta2, t, state.key, sc
+    )
+    terminated = (
+        -jnp.cos(theta1) - jnp.cos(theta2 + theta1) > 1.0
+    ).astype(jnp.float32)
+    truncated = (t >= MAX_STEPS).astype(jnp.float32) * (1.0 - terminated)
+    # -1 per step until the terminating step, which earns 0 (gymnasium).
+    reward = -(1.0 - terminated)
+    return nstate, _obs(nstate), reward, terminated, truncated
+
+
+def make_acrobot(
+    randomize: float = 0.0,
+    gravity=None,
+    link_mass_1=None,
+    link_mass_2=None,
+    link_length_1=None,
+    link_length_2=None,
+    torque=None,
+) -> JaxEnv:
+    """Acrobot-v1, optionally as a domain-randomized scenario fleet.
+
+    `randomize=r` draws each physics parameter per instance/episode in
+    [default·(1−r), default·(1+r)]; the per-param kwargs pin ranges
+    explicitly (a (lo, hi) pair, a "lo,hi" string via --env-set, or a
+    bare number to fix the value). Defaults reproduce gymnasium exactly.
+    """
+    ranges = scenario_ranges(
+        SCENARIO_DEFAULTS, randomize,
+        {"gravity": gravity, "link_mass_1": link_mass_1,
+         "link_mass_2": link_mass_2, "link_length_1": link_length_1,
+         "link_length_2": link_length_2, "torque": torque},
+    )
+
+    def _reset(key: jax.Array) -> tuple[AcrobotState, jax.Array]:
+        key, sub, skey = jax.random.split(key, 3)
+        scenario = AcrobotScenario(**draw_scenario(skey, ranges))
+        vals = jax.random.uniform(sub, (4,), jnp.float32, -0.1, 0.1)
+        state = AcrobotState(
+            theta1=vals[0], theta2=vals[1],
+            dtheta1=vals[2], dtheta2=vals[3],
+            t=jnp.zeros((), jnp.int32), key=key, scenario=scenario,
+        )
+        return state, _obs(state)
+
+    spec = EnvSpec(
+        obs_shape=(6,), action_dim=3, discrete=True,
+        episode_horizon=MAX_STEPS,
+    )
+    step = auto_reset(_reset, _raw_step, key_of_state=lambda s: s.key)
+    return JaxEnv(spec=spec, reset=_reset, step=step)

@@ -1,0 +1,160 @@
+"""Procedurally generated obstacle-maze family, pure-JAX (ISSUE 11).
+
+The fourth member of the scenario universe: a gridworld whose obstacle
+LAYOUT is itself the scenario — every episode draws a fresh random
+obstacle field, start cell, and goal cell from the instance's own PRNG
+stream, so a vmapped fleet carries thousands of different mazes inside
+one fused XLA program and `auto_reset` re-generates a new maze per
+episode (the procedural-generation regime; envs/jax_env.py scenario
+docstring). There is no host-side level bank: generation is a few
+`jax.random` draws inside `reset`, which is what keeps a million-maze
+fleet device-resident.
+
+Mechanics: an N×N grid (static `size`, default 8) with Bernoulli
+obstacles at per-instance `density`; 4 discrete actions (up/right/down/
+left); moving into a wall or obstacle stays in place; reaching the goal
+terminates with `goal_reward`, every step costs `step_cost`. Episodes
+truncate at 8·N steps. Observations are egocentric and fixed-width
+regardless of grid size: the 3×3 obstacle window around the agent
+(out-of-bounds cells read as walls) plus normalized agent position and
+goal offset — 13 floats.
+
+Scenario parameters (`scenario_ranges`/`draw_scenario` protocol, same
+as cartpole/pendulum/acrobot): `density`, `step_cost`, `goal_reward` —
+`make_maze(randomize=0.3)` or per-param ranges / `--env-set
+density=0.1,0.4` strings re-draw them per episode along with the
+layout.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from actor_critic_tpu.envs.jax_env import (
+    EnvSpec, JaxEnv, auto_reset, draw_scenario, scenario_ranges,
+)
+
+DENSITY = 0.25
+STEP_COST = 0.05
+GOAL_REWARD = 1.0
+
+SCENARIO_DEFAULTS = {
+    "density": DENSITY,
+    "step_cost": STEP_COST,
+    "goal_reward": GOAL_REWARD,
+}
+
+# (row, col) deltas for actions 0..3 = up/right/down/left.
+_DELTAS = ((-1, 0), (0, 1), (1, 0), (0, -1))
+
+
+class MazeScenario(NamedTuple):
+    """Per-instance generation/reward knobs (f32 scalars)."""
+
+    density: jax.Array
+    step_cost: jax.Array
+    goal_reward: jax.Array
+
+
+class MazeState(NamedTuple):
+    grid: jax.Array  # [N, N] f32, 1.0 = obstacle
+    row: jax.Array
+    col: jax.Array
+    goal_row: jax.Array
+    goal_col: jax.Array
+    t: jax.Array
+    key: jax.Array
+    scenario: MazeScenario
+
+
+def _obs(s: MazeState, size: int) -> jax.Array:
+    # 3×3 egocentric obstacle window; out-of-bounds cells read as walls
+    # so the policy sees the arena boundary the same way it sees
+    # obstacles. dynamic_slice start (row-1+1, col-1+1) on the 1-padded
+    # grid is just (row, col).
+    padded = jnp.pad(s.grid, 1, constant_values=1.0)
+    window = jax.lax.dynamic_slice(padded, (s.row, s.col), (3, 3))
+    n = jnp.float32(size)
+    feats = jnp.stack([
+        s.row.astype(jnp.float32) / n,
+        s.col.astype(jnp.float32) / n,
+        (s.goal_row - s.row).astype(jnp.float32) / n,
+        (s.goal_col - s.col).astype(jnp.float32) / n,
+    ])
+    return jnp.concatenate([window.reshape(9), feats]).astype(jnp.float32)
+
+
+def make_maze(
+    size: int = 8,
+    randomize: float = 0.0,
+    density=None,
+    step_cost=None,
+    goal_reward=None,
+) -> JaxEnv:
+    """Procedural obstacle maze, optionally with randomized generation
+    parameters. `size` is static (it sets array shapes); the layout is
+    re-generated every episode regardless of `randomize`."""
+    if size < 3:
+        raise ValueError(f"size must be >= 3, got {size}")
+    max_steps = 8 * size
+    ranges = scenario_ranges(
+        SCENARIO_DEFAULTS, randomize,
+        {"density": density, "step_cost": step_cost,
+         "goal_reward": goal_reward},
+    )
+
+    def _reset(key: jax.Array) -> tuple[MazeState, jax.Array]:
+        key, skey, gkey, pkey, qkey = jax.random.split(key, 5)
+        scenario = MazeScenario(**draw_scenario(skey, ranges))
+        dens = jnp.clip(scenario.density, 0.0, 0.9)
+        grid = (
+            jax.random.uniform(gkey, (size, size), jnp.float32) < dens
+        ).astype(jnp.float32)
+        pos = jax.random.randint(pkey, (2,), 0, size)
+        goal = jax.random.randint(qkey, (2,), 0, size)
+        # Distinct start/goal: shift a colliding goal diagonally (mod N)
+        # instead of rejection-sampling (shape-static, branchless).
+        same = jnp.all(pos == goal)
+        goal = jnp.where(same, (goal + 1) % size, goal)
+        # Start and goal cells are always free.
+        grid = grid.at[pos[0], pos[1]].set(0.0)
+        grid = grid.at[goal[0], goal[1]].set(0.0)
+        state = MazeState(
+            grid=grid, row=pos[0], col=pos[1],
+            goal_row=goal[0], goal_col=goal[1],
+            t=jnp.zeros((), jnp.int32), key=key, scenario=scenario,
+        )
+        return state, _obs(state, size)
+
+    def _raw_step(state: MazeState, action: jax.Array):
+        sc = state.scenario
+        a = action.astype(jnp.int32) % 4
+        deltas = jnp.asarray(_DELTAS, jnp.int32)
+        nr = jnp.clip(state.row + deltas[a, 0], 0, size - 1)
+        nc = jnp.clip(state.col + deltas[a, 1], 0, size - 1)
+        blocked = state.grid[nr, nc] > 0.5
+        row = jnp.where(blocked, state.row, nr)
+        col = jnp.where(blocked, state.col, nc)
+        t = state.t + 1
+        nstate = MazeState(
+            grid=state.grid, row=row, col=col,
+            goal_row=state.goal_row, goal_col=state.goal_col,
+            t=t, key=state.key, scenario=sc,
+        )
+        reached = (
+            (row == state.goal_row) & (col == state.goal_col)
+        ).astype(jnp.float32)
+        reward = sc.goal_reward * reached - sc.step_cost
+        terminated = reached
+        truncated = (t >= max_steps).astype(jnp.float32) * (1.0 - terminated)
+        return nstate, _obs(nstate, size), reward, terminated, truncated
+
+    spec = EnvSpec(
+        obs_shape=(13,), action_dim=4, discrete=True,
+        episode_horizon=max_steps,
+    )
+    step = auto_reset(_reset, _raw_step, key_of_state=lambda s: s.key)
+    return JaxEnv(spec=spec, reset=_reset, step=step)

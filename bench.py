@@ -155,19 +155,24 @@ def _error_line(msg: str, root: str | None = None) -> str:
 
 # CPU-runnable bench/suite.py metrics promoted into every bench.py
 # record (ROADMAP "Bench resilience"; ISSUE 6 satellite, extended by
-# ISSUE 8's replay_sample_throughput and ISSUE 9's multihost_scaling):
+# ISSUE 8's replay_sample_throughput, ISSUE 9's multihost_scaling,
+# ISSUE 10's serving_latency and ISSUE 11's scenario_fleet):
 # the TPU headline stays on top when the tunnel is alive, but a dead
 # tunnel no longer means an evidence-free round — host_pool_scaling,
 # startup_to_first_step, async_decoupling, update_wall,
-# replay_sample_throughput, multihost_scaling and serving_latency
-# (ISSUE 10) are measured on the CPU backend regardless. BENCH_CPU_METRICS overrides the set (comma
+# replay_sample_throughput, multihost_scaling, serving_latency and
+# scenario_fleet (heterogeneous mixture + the steps/s-vs-instance-count
+# sweep) are measured on the CPU backend regardless. BENCH_CPU_METRICS overrides the set (comma
 # list of bench/suite.py names); "0"/"none"/"off" disables. Trend the
 # block across rounds with scripts/bench_trend.py. Budget note: the
-# multihost grid adds ~2 minutes of multi-process cluster runs on top
-# of the 2-3 minutes the rest of the block costs on this host.
+# multihost grid adds ~2 minutes of multi-process cluster runs and the
+# scenario_fleet mixture/sweep adds ~4-5 minutes (bounded by
+# BENCH_FLEET_MAX_E) on top of the 2-3 minutes the rest of the block
+# costs on this host — hence the 480 s default per-metric timeout.
 DEFAULT_CPU_METRICS = (
     "host_pool_scaling,startup_to_first_step,async_decoupling,update_wall,"
-    "replay_sample_throughput,multihost_scaling,serving_latency"
+    "replay_sample_throughput,multihost_scaling,serving_latency,"
+    "scenario_fleet"
 )
 
 
@@ -193,7 +198,7 @@ def collect_cpu_metrics() -> dict:
     suite = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "bench", "suite.py"
     )
-    timeout_s = float(os.environ.get("BENCH_CPU_METRIC_TIMEOUT", 240))
+    timeout_s = float(os.environ.get("BENCH_CPU_METRIC_TIMEOUT", 480))
     env = dict(os.environ)
     disarm_axon(env)
     out: dict = {}

@@ -563,16 +563,29 @@ def bench_replay_sample_throughput():
 
 
 def bench_scenario_fleet():
-    """Domain-randomized on-device env fleet (ISSUE 8 acceptance row):
-    >=1k CartPole instances with per-instance randomized physics
-    (randomize=0.3 over gravity/masses/length/force) step inside ONE
-    fused A2C XLA program — rollout + scenario redraws + update, no host
-    in the loop. Reports env-steps/s of the randomized fleet and the
-    uniform fleet on the same shape, so the randomization overhead is
-    visible (scenario params ride the env state; the dynamics math is
-    identical, just per-instance)."""
+    """Scenario-universe fleet bench (ISSUE 8 + ISSUE 11 acceptance
+    rows), three blocks in one record:
+
+    1. The PR 8 homogeneous rows: >=1k CartPole instances with
+       per-instance randomized physics step inside ONE fused A2C XLA
+       program; uniform fleet on the same shape makes the randomization
+       overhead visible.
+    2. `mixture` (ISSUE 11): a heterogeneous 4-type fleet — CartPole +
+       Pendulum + Acrobot + procedural maze behind the padded shared
+       obs/action interface (envs/mixture.py) — in one program, plus
+       each member as a homogeneous fleet at the same shape, so the
+       per-type cost and the batched-`lax.switch` heterogeneity
+       overhead (every instance pays the summed branch cost under vmap)
+       are separately visible. `per_type_steps_per_s` feeds
+       scripts/bench_trend.py's per-type sub-rows.
+    3. `instance_sweep` (ISSUE 11): the mixture fleet's steps/s at
+       doubling instance counts until throughput rolls over — the
+       published steps/s-vs-instance-count curve. The sweep stops one
+       doubling past the peak (or at BENCH_FLEET_MAX_E, default 8192)
+       so a CPU run stays bounded; `truncated` records which."""
     from actor_critic_tpu.algos import a2c
-    from actor_critic_tpu.envs import make_cartpole
+    from actor_critic_tpu.envs import make_cartpole, make_mixture
+    from actor_critic_tpu.envs import mixture as mixture_mod
 
     E, T = 2048, 32
     cfg = a2c.A2CConfig(num_envs=E, rollout_steps=T, hidden=(64,))
@@ -584,6 +597,62 @@ def bench_scenario_fleet():
         rates[name] = _fused_steps_per_sec(
             a2c, env, cfg, E * T, iters_per_call=10, calls=3
         )
+
+    # --- mixture mode (ISSUE 11) ---
+    members = "cartpole,pendulum,acrobot,maze"
+    member_names = tuple(n for n, _ in mixture_mod.parse_mixture_spec(members))
+    E_m = 1024
+    cfg_m = a2c.A2CConfig(num_envs=E_m, rollout_steps=T, hidden=(64,))
+    mix_env = make_mixture(members, randomize=0.3)
+    mix_sps = _fused_steps_per_sec(
+        a2c, mix_env, cfg_m, E_m * T, iters_per_call=5, calls=3
+    )
+    per_type = {}
+    for name in member_names:
+        env_t = mixture_mod.member_makers()[name](randomize=0.3)
+        per_type[name] = round(_fused_steps_per_sec(
+            a2c, env_t, cfg_m, E_m * T, iters_per_call=5, calls=3
+        ), 1)
+    mixture_block = {
+        "steps_per_s": round(mix_sps, 1),
+        "per_type_steps_per_s": per_type,
+        "n_types": len(member_names),
+        # Batched lax.switch computes every branch and selects, so the
+        # honest overhead reference is the SUM of the members' costs at
+        # this shape (1/sum(1/r_i) is the series rate of stepping each
+        # homogeneous fleet in turn).
+        "overhead_vs_series_x": round(
+            (1.0 / sum(1.0 / r for r in per_type.values())) / mix_sps, 2
+        ),
+    }
+
+    # --- instance-count sweep (ISSUE 11 rollover curve) ---
+    max_e = int(os.environ.get("BENCH_FLEET_MAX_E", "8192"))
+    curve = {}
+    peak_e, peak_sps = 0, 0.0
+    e = 256
+    truncated = True
+    while e <= max_e:
+        cfg_e = a2c.A2CConfig(num_envs=e, rollout_steps=T, hidden=(64,))
+        sps = _fused_steps_per_sec(
+            a2c, mix_env, cfg_e, e * T, iters_per_call=5, calls=2
+        )
+        curve[str(e)] = round(sps, 1)
+        if sps > peak_sps:
+            peak_e, peak_sps = e, sps
+        elif sps < 0.85 * peak_sps:
+            # Rolled over decisively: one more doubling would only
+            # confirm the downslope at real CPU cost.
+            truncated = False
+            break
+        e *= 2
+    sweep = {
+        "curve": curve,
+        "peak_instances": peak_e,
+        "peak_steps_per_s": round(peak_sps, 1),
+        "truncated": truncated and e > max_e,
+    }
+
     return {
         "metric": "scenario_fleet_throughput",
         "value": round(rates["randomized"], 1),
@@ -593,7 +662,11 @@ def bench_scenario_fleet():
         "randomization_overhead_x": round(
             rates["uniform"] / rates["randomized"], 2
         ),
-        "config": {"num_envs": E, "rollout_steps": T, "randomize": 0.3},
+        "mixture": mixture_block,
+        "instance_sweep": sweep,
+        "config": {"num_envs": E, "rollout_steps": T, "randomize": 0.3,
+                   "mixture_members": members,
+                   "mixture_num_envs": E_m},
     }
 
 

@@ -200,6 +200,67 @@ def serving_cell(rec: dict | None, field: str) -> str:
     return _numeric_cell(sub.get(field))
 
 
+def scenario_mixture_types(recs: list[dict | None]) -> list[str]:
+    """Union of mixture member names across rounds (the ISSUE 11 record
+    nests per-type steps/s under `mixture.per_type_steps_per_s`)."""
+    names: list[str] = []
+    for rec in recs:
+        entry, _ = _metric_entry(rec, "scenario_fleet")
+        mix = entry.get("mixture") if entry else None
+        per_type = mix.get("per_type_steps_per_s") if isinstance(mix, dict) else None
+        if isinstance(per_type, dict):
+            for k in per_type:
+                if k not in names:
+                    names.append(k)
+    return names
+
+
+def scenario_type_cell(rec: dict | None, name: str) -> str:
+    """One member type's homogeneous-fleet steps/s (`-` before the
+    mixture block existed, `?` where it is present but malformed)."""
+    entry, cell = _metric_entry(rec, "scenario_fleet")
+    if entry is None:
+        return cell
+    mix = entry.get("mixture")
+    if mix is None:
+        return "-"
+    if not isinstance(mix, dict):
+        return "?"
+    per_type = mix.get("per_type_steps_per_s")
+    if not isinstance(per_type, dict):
+        return "?"
+    if name not in per_type:
+        return "-"
+    return _numeric_cell(per_type[name])
+
+
+def scenario_mixture_cell(rec: dict | None, field: str) -> str:
+    """A scalar field of the heterogeneous-mixture block."""
+    entry, cell = _metric_entry(rec, "scenario_fleet")
+    if entry is None:
+        return cell
+    mix = entry.get("mixture")
+    if mix is None:
+        return "-"
+    if not isinstance(mix, dict):
+        return "?"
+    return _numeric_cell(mix.get(field))
+
+
+def scenario_sweep_cell(rec: dict | None) -> str:
+    """Peak steps/s of the instance-count sweep (the rollover curve's
+    summit; the full curve lives in the round record)."""
+    entry, cell = _metric_entry(rec, "scenario_fleet")
+    if entry is None:
+        return cell
+    sweep = entry.get("instance_sweep")
+    if sweep is None:
+        return "-"
+    if not isinstance(sweep, dict):
+        return "?"
+    return _numeric_cell(sweep.get("peak_steps_per_s"))
+
+
 def multihost_straggler_cell(rec: dict | None) -> str:
     """The straggler A/B ratio (gossip over sync fleet throughput)."""
     entry, cell = _multihost_entry(rec)
@@ -242,6 +303,25 @@ def trend_rows(root: str) -> tuple[list[int], list[tuple[str, list[str]]]]:
             rows.append((
                 "multihost_scaling.straggler_gossip_x",
                 [multihost_straggler_cell(r) for r in recs],
+            ))
+        if name == "scenario_fleet":
+            # Scenario-universe sub-rows (ISSUE 11): the heterogeneous
+            # mixture fleet's steps/s, each member type's homogeneous
+            # steps/s at the same shape, and the instance-sweep peak —
+            # so a per-type regression (one member's step got slow) is
+            # visible even when the homogeneous headline holds.
+            rows.append((
+                "scenario_fleet.mixture",
+                [scenario_mixture_cell(r, "steps_per_s") for r in recs],
+            ))
+            for t in scenario_mixture_types(recs):
+                rows.append((
+                    f"scenario_fleet.{t}",
+                    [scenario_type_cell(r, t) for r in recs],
+                ))
+            rows.append((
+                "scenario_fleet.sweep_peak",
+                [scenario_sweep_cell(r) for r in recs],
             ))
         if name == "serving_latency":
             # Micro-batched gateway sub-rows (ISSUE 10): the SLO curve

@@ -293,6 +293,26 @@ def resource_summary(rows: list[dict]) -> list[str]:
             f"{last_s.get('rejected_total', 0)}, errors "
             f"{last_s.get('errors_total', 0)}"
         )
+    # Scenario-mixture per-type eval gauge (envs/mixture.py, ISSUE 11):
+    # flat `<member>_return` / `<member>_solved` fields; the LAST row is
+    # the latest eval matrix. The metrics section renders the full
+    # per-round matrix; this line keeps it visible on telemetry alone.
+    mx_rows = [
+        r["mixture_eval"] for r in rows
+        if isinstance(r.get("mixture_eval"), dict)
+    ]
+    if mx_rows and mx_rows[-1]:
+        last_m = mx_rows[-1]
+        cells = []
+        for key in sorted(last_m):
+            if not key.endswith("_return"):
+                continue
+            name = key[: -len("_return")]
+            solved = last_m.get(f"{name}_solved")
+            tag = " (solved)" if solved else ""
+            cells.append(f"{name} {last_m[key]:g}{tag}")
+        if cells:
+            out.append("- **mixture eval matrix**: " + ", ".join(cells))
     # Per-device peaks across the run (devices without allocator stats,
     # e.g. CPU, appear with no byte fields and are reported as such).
     dev_peak: dict[int, dict] = {}
@@ -597,6 +617,44 @@ def metrics_summary(rows: list[dict]) -> list[str]:
             f"- eval: best {best['eval_return']:.1f} @ iter {best.get('iter')}, "
             f"final {evals[-1]['eval_return']:.1f} ({len(evals)} evals)"
         )
+    # Per-type eval matrix (scenario-mixture runs, ISSUE 11): rows carry
+    # `eval_return_<member>` per eval — render best/final per type, plus
+    # the curriculum stage trace when the run scheduled one.
+    prefix = "eval_return_"
+    types: list[str] = []
+    for r in rows:
+        for k in r:
+            if (
+                k.startswith(prefix)
+                and isinstance(r[k], (int, float))
+                and k[len(prefix):] not in types
+            ):
+                types.append(k[len(prefix):])
+    if types:
+        out.append("")
+        out.append("Per-type eval matrix (scenario mixture):")
+        out.append("")
+        out.append("| type | final | best | evals |")
+        out.append("|---|---:|---:|---:|")
+        for name in types:
+            vals = [
+                r[prefix + name] for r in rows
+                if isinstance(r.get(prefix + name), (int, float))
+            ]
+            out.append(
+                f"| {name} | {vals[-1]:.1f} | {max(vals):.1f} "
+                f"| {len(vals)} |"
+            )
+        stages = [
+            r["curriculum_stage"] for r in rows
+            if isinstance(r.get("curriculum_stage"), (int, float))
+        ]
+        if stages:
+            out.append("")
+            out.append(
+                f"- curriculum: stage {int(stages[-1])} at run end "
+                f"(started this segment at {int(stages[0])})"
+            )
     return out
 
 

@@ -25,3 +25,15 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def new_compile_records(c0: int) -> list:
+    """Compile records since event-count snapshot `c0`
+    (`profiler.compile_event_count()`). The record ring is capped, so in
+    a full-suite run len(records) sits at capacity and slicing by list
+    length silently returns [] — index back from the MONOTONIC counter
+    instead."""
+    from actor_critic_tpu.telemetry import profiler
+
+    delta = profiler.compile_event_count() - c0
+    return profiler.compile_records()[-delta:] if delta else []

@@ -383,7 +383,7 @@ def test_async_learner_steady_state_zero_recompiles(tmp_path):
             # Acting/eval mirror on the host; the corrected update is
             # the ONLY device program an async run dispatches.
             assert [n for n, _ in plan] == ["ppo.make_async_update_step"]
-            n0 = len(profiler.compile_records())
+            n0 = profiler.compile_event_count()
             runner = compile_cache.WarmupRunner(plan).start()
             assert runner.wait(300) and "error" not in runner.results[0], (
                 runner.results
@@ -402,7 +402,9 @@ def test_async_learner_steady_state_zero_recompiles(tmp_path):
         for p in pools:
             p.close()
 
-    records = profiler.compile_records()[n0:]
+    from conftest import new_compile_records
+
+    records = new_compile_records(n0)
     update_evs = [r for r in records if r["name"] == "jit_async_update"]
     real = [r for r in update_evs if not r.get("cache_hit")]
     assert len(real) == 1, update_evs  # warmup's one true compile

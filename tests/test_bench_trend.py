@@ -200,3 +200,65 @@ def test_serving_latency_sub_rows(tmp_path):
         "serving_latency.p50_ms",
         "serving_latency.p99_ms",
     ]
+
+
+def test_scenario_fleet_sub_rows(tmp_path):
+    """ISSUE 11 satellite: scenario_fleet expands into the mixture
+    steps/s, one homogeneous-fleet sub-row per member type (union
+    across rounds), and the instance-sweep peak; '-' before the mixture
+    block existed (the PR 8 homogeneous-only record), '?' for malformed
+    sub-records, 'err' for failed subprocesses."""
+    mod = _load()
+    # r01: the PR 8 record — scenario_fleet without a mixture block.
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps({
+        "metric": "a2c", "value": 1.0,
+        "cpu_metrics": {"scenario_fleet": {"value": 280000.0}},
+    }) + "\n")
+    # r02: the full ISSUE 11 record.
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps({
+        "metric": "a2c", "value": 1.0,
+        "cpu_metrics": {
+            "scenario_fleet": {
+                "value": 275000.0,
+                "mixture": {
+                    "steps_per_s": 61000.0,
+                    "per_type_steps_per_s": {
+                        "cartpole": 240000.0, "pendulum": 250000.0,
+                        "acrobot": 90000.0, "maze": 120000.0,
+                    },
+                    "overhead_vs_series_x": 0.7,
+                },
+                "instance_sweep": {
+                    "curve": {"256": 20000.0, "512": 40000.0},
+                    "peak_instances": 512,
+                    "peak_steps_per_s": 40000.0,
+                },
+            },
+        },
+    }) + "\n")
+    # r03: malformed mixture/sweep blocks degrade to '?'.
+    (tmp_path / "BENCH_r03.json").write_text(json.dumps({
+        "metric": "a2c", "value": 1.0,
+        "cpu_metrics": {
+            "scenario_fleet": {
+                "value": 1.0, "mixture": "oops", "instance_sweep": 3,
+            },
+        },
+    }) + "\n")
+    # r04: the whole metric's subprocess failed.
+    (tmp_path / "BENCH_r04.json").write_text(json.dumps({
+        "metric": "a2c", "value": 1.0,
+        "cpu_metrics": {"scenario_fleet": {"error": "rc=1"}},
+    }) + "\n")
+    rounds, rows = mod.trend_rows(str(tmp_path))
+    assert rounds == [1, 2, 3, 4]
+    table = dict(rows)
+    assert table["scenario_fleet"] == ["2.8e+05", "2.75e+05", "1", "err"]
+    assert table["scenario_fleet.mixture"] == ["-", "6.1e+04", "?", "err"]
+    assert table["scenario_fleet.cartpole"] == ["-", "2.4e+05", "?", "err"]
+    assert table["scenario_fleet.maze"] == ["-", "1.2e+05", "?", "err"]
+    assert table["scenario_fleet.sweep_peak"] == ["-", "4e+04", "?", "err"]
+    labels = [label for label, _ in rows]
+    i = labels.index("scenario_fleet")
+    assert labels[i + 1] == "scenario_fleet.mixture"
+    assert "scenario_fleet.acrobot" in labels

@@ -15,8 +15,7 @@ from actor_critic_tpu.telemetry import profiler
 from actor_critic_tpu.utils import compile_cache
 
 
-def _new_records(n0: int) -> list:
-    return profiler.compile_records()[n0:]
+from conftest import new_compile_records as _new_records
 
 
 def _require_introspection():
@@ -132,7 +131,7 @@ def test_chunked_step_compiles_exactly_two_programs():
     step = compile_cache.make_chunked_step(a2c.make_train_step(env, cfg), 4)
     state = a2c.init_state(env, cfg, jax.random.key(1))
 
-    n0 = len(profiler.compile_records())
+    n0 = profiler.compile_event_count()
     state, _ = step(state, 4)   # full program
     state, _ = step(state, 3)   # masked program
     mid = profiler.compile_event_count()
@@ -172,7 +171,7 @@ def test_fused_warmup_makes_first_dispatch_a_cache_hit(tmp_path):
         )
         plan = compile_cache.plan_warmup(ctx)
         assert [n for n, _ in plan] == ["a2c.make_train_step"]
-        n0 = len(profiler.compile_records())
+        n0 = profiler.compile_event_count()
         runner = compile_cache.WarmupRunner(plan).start()
         assert runner.wait(300) and "error" not in runner.results[0], (
             runner.results
@@ -195,6 +194,76 @@ def test_fused_warmup_makes_first_dispatch_a_cache_hit(tmp_path):
         hits = [r for r in evs if r.get("cache_hit")]
         assert len(real) == 1, (name, evs)   # warmup's one true compile
         assert hits, (name, evs)             # the loop hit the cache
+
+
+def test_mixture_fleet_one_program_zero_steady_state_recompiles(tmp_path):
+    """ISSUE 11 acceptance: a heterogeneous mixture fleet of THREE env
+    types (CartPole + Pendulum + Acrobot behind the padded shared
+    interface) steps inside ONE fused XLA program — the registered
+    planners AOT-compile the train step and the per-type eval, the live
+    loop's first dispatches are persistent-cache hits, and steady state
+    (more train iterations + typed evals across EVERY type) compiles
+    NOTHING: the per-instance `lax.switch` and the traced type-id eval
+    keep the whole universe on a fixed program set."""
+    _require_introspection()
+    from actor_critic_tpu.algos import a2c
+    from actor_critic_tpu.envs import make_mixture
+    from actor_critic_tpu.envs import mixture as mx
+
+    env = make_mixture("cartpole,pendulum,acrobot", randomize=0.2)
+    cfg = a2c.A2CConfig(num_envs=8, rollout_steps=2, hidden=(8,))
+    with compile_cache.temporary_cache(tmp_path / "cc"):
+        ctx = compile_cache.WarmupContext(
+            algo="a2c", fused=True, spec=env.spec, cfg=cfg, env=env,
+            eval_every=2,
+        )
+        plan = compile_cache.plan_warmup(ctx)
+        assert [n for n, _ in plan] == [
+            "a2c.make_eval_fn", "a2c.make_train_step",
+            "mixture.make_typed_eval",
+        ]
+        n0 = profiler.compile_event_count()
+        runner = compile_cache.WarmupRunner(plan).start()
+        assert runner.wait(600), runner.results
+        assert not [r for r in runner.results if "error" in r], runner.results
+
+        # The live loop's own jit objects (fresh, same HLO), exactly as
+        # train.py's run_fused builds them.
+        step = jax.jit(a2c.make_train_step(env, cfg), donate_argnums=0)
+        ev = jax.jit(a2c.make_eval_fn(env, cfg), static_argnums=(2, 3))
+        typed = jax.jit(
+            mx.make_typed_eval(env, a2c.make_network(env, cfg)),
+            static_argnums=(3, 4),
+        )
+        state = a2c.init_state(env, cfg, jax.random.key(0))
+        key = jax.random.key(1)
+        state, _ = step(state)
+        float(ev(state, key))
+        for t in range(env.n_types):
+            float(typed(state, key, jnp.asarray(t, jnp.int32)))
+        c0 = profiler.compile_event_count()
+        # Steady state: more iterations, the aggregate eval, and the
+        # typed eval across every member type — zero compile events.
+        for _ in range(3):
+            state, _ = step(state)
+        float(ev(state, key))
+        for t in range(env.n_types):
+            float(typed(state, key, jnp.asarray(t, jnp.int32)))
+        steady = profiler.compile_event_count() - c0
+        assert steady == 0, [
+            r["name"] for r in profiler.compile_records()[-steady:]
+        ]
+
+    # Warmup's one true compile of the mixture train step (the ONE
+    # program the whole heterogeneous fleet steps in); the live loop's
+    # dispatch funneled through as a persistent-cache hit.
+    records = _new_records(n0)
+    step_evs = [r for r in records if "train_step" in r["name"]]
+    real = [r for r in step_evs if not r.get("cache_hit")]
+    assert len(real) == 1, [
+        (r["name"], r.get("cache_hit")) for r in step_evs
+    ]
+    assert any(r.get("cache_hit") for r in step_evs), step_evs
 
 
 def test_host_ppo_steady_state_zero_recompiles(tmp_path):
@@ -222,7 +291,7 @@ def test_host_ppo_steady_state_zero_recompiles(tmp_path):
             # CartPole's MLP mirrors acting/eval on the host, so the only
             # device entry point this run dispatches is the update.
             assert [n for n, _ in plan] == ["ppo.make_host_update_step"]
-            n0 = len(profiler.compile_records())
+            n0 = profiler.compile_event_count()
             runner = compile_cache.WarmupRunner(plan).start()
             assert runner.wait(300) and "error" not in runner.results[0], (
                 runner.results
@@ -279,7 +348,7 @@ def test_quantized_ingest_warmup_steady_state_zero_recompiles(tmp_path):
             n for n, _ in plan if n == "ddpg.make_host_ingest_update"
         ]
         assert ingest_entries, [n for n, _ in plan]
-        n0 = len(profiler.compile_records())
+        n0 = profiler.compile_event_count()
         runner = compile_cache.WarmupRunner(
             [e for e in plan if e[0] == "ddpg.make_host_ingest_update"]
         ).start()

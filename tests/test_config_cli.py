@@ -410,3 +410,62 @@ def test_check_env_convention_env_kwargs(tmp_path):
         warnings.simplefilter("always")
         train_cli.check_env_convention(d3, "host:Pendulum-v1", None, True)
     assert not caught
+
+
+def test_build_env_mixture_spec():
+    """'mixture:<members>' builds the heterogeneous fleet env (ISSUE
+    11): per-type weights parse from the spec, --env-set reaches the
+    mixture maker, and bad members/kwargs exit with the friendly
+    message."""
+    import train as train_cli
+    from actor_critic_tpu.envs.mixture import MixtureEnv
+
+    cfg = PRESETS["a2c_cartpole"].config
+    env, fused = train_cli.build_env(
+        "mixture:cartpole*2,pendulum,acrobot", "a2c", cfg, 0,
+        env_kwargs={"randomize": 0.2, "action_bins": 7},
+    )
+    assert fused and isinstance(env, MixtureEnv)
+    assert env.member_names == ("cartpole", "pendulum", "acrobot")
+    assert env.init_weights == (2.0, 1.0, 1.0)
+    assert env.spec.action_dim == 7  # action_bins reached the maker
+    with pytest.raises(SystemExit, match="bad mixture env"):
+        train_cli.build_env("mixture:cartpole,frogger", "a2c", cfg, 0)
+    with pytest.raises(SystemExit, match="bad --env-set"):
+        train_cli.build_env(
+            "mixture:cartpole,maze", "a2c", cfg, 0, env_kwargs={"nope": 1}
+        )
+
+
+def test_mixture_preset_resolves():
+    pre = resolve("a2c_mixture", None, None, {})
+    assert pre.env.startswith("mixture:")
+    assert pre.env_kwargs == {"randomize": 0.2}
+
+
+def test_curriculum_flag_validation():
+    """--curriculum exits early (before any env/device work) on every
+    doomed combination: non-mixture env, no eval cadence, bad spec."""
+    import train as train_cli
+
+    base = ["--iterations", "1", "--quiet"]
+    with pytest.raises(SystemExit, match="mixture"):
+        train_cli.main(
+            ["--algo", "a2c", "--env", "jax:cartpole",
+             "--curriculum", "10:1"] + base
+        )
+    with pytest.raises(SystemExit, match="eval-every"):
+        train_cli.main(
+            ["--algo", "a2c", "--env", "mixture:cartpole,maze",
+             "--curriculum", "10:1,2"] + base
+        )
+    with pytest.raises(SystemExit, match="bad --curriculum"):
+        train_cli.main(
+            ["--algo", "a2c", "--env", "mixture:cartpole,maze",
+             "--curriculum", "10:1,2,3", "--eval-every", "1"] + base
+        )
+    with pytest.raises(SystemExit, match="bad --curriculum"):
+        train_cli.main(
+            ["--algo", "a2c", "--env", "mixture:cartpole,maze",
+             "--curriculum", "garbage", "--eval-every", "1"] + base
+        )

@@ -162,3 +162,163 @@ def test_pendulum_scaled_actions_and_truncation():
     assert float(out.done) == 1.0
     assert float(out.info["terminated"]) == 0.0  # truncation, not termination
     assert int(out.state.t) == 0
+
+
+def test_acrobot_matches_gymnasium_dynamics():
+    """Gymnasium-parity for the Acrobot member (ISSUE 11 satellite,
+    same discipline as cartpole/pendulum): re-sync both implementations
+    to the same state each step — the double pendulum is chaotic, so
+    per-step comparison tests the RK4 dynamics themselves rather than
+    float32 drift amplification — and compare obs/reward/termination."""
+    gym = pytest.importorskip("gymnasium")
+    from actor_critic_tpu.envs import make_acrobot
+
+    genv = gym.make("Acrobot-v1").unwrapped
+    jenv = make_acrobot()
+    genv.reset(seed=0)
+    state, _ = jenv.reset(jax.random.key(0))
+
+    rng = np.random.RandomState(11)
+    s = rng.uniform(-0.5, 0.5, size=4)
+    for t in range(40):
+        action = int(rng.randint(3))
+        genv.state = s.astype(np.float64).copy()
+        jstate = state._replace(
+            theta1=jnp.asarray(s[0], jnp.float32),
+            theta2=jnp.asarray(s[1], jnp.float32),
+            dtheta1=jnp.asarray(s[2], jnp.float32),
+            dtheta2=jnp.asarray(s[3], jnp.float32),
+        )
+        out = jenv.step(jstate, jnp.asarray(action))
+        gobs, grew, gterm, _, _ = genv.step(action)
+        if gterm:
+            # The JAX env auto-resets; the pre-reset obs must match.
+            np.testing.assert_allclose(
+                out.info["final_obs"], gobs, rtol=1e-4, atol=1e-4
+            )
+            assert float(out.info["terminated"]) == 1.0
+            assert float(out.reward) == grew == 0.0
+        else:
+            np.testing.assert_allclose(out.obs, gobs, rtol=1e-4, atol=1e-4)
+            assert float(out.reward) == grew == -1.0
+            assert float(out.done) == 0.0
+        # Continue from gymnasium's float64 state (the reference).
+        s = np.asarray(genv.state, np.float64)
+        state = out.state
+
+
+def test_acrobot_defaults_and_truncation():
+    """Default scenario carries gymnasium's exact constants (unrandomized
+    dynamics); velocities clip at 4π/9π; the TimeLimit truncates (not
+    terminates) at 500."""
+    from actor_critic_tpu.envs import acrobot as ab
+    from actor_critic_tpu.envs import make_acrobot
+
+    env = make_acrobot()
+    state, obs = env.reset(jax.random.key(1))
+    sc = state.scenario
+    assert float(sc.gravity) == np.float32(ab.GRAVITY)
+    assert float(sc.link_mass_1) == np.float32(ab.LINK_MASS_1)
+    assert float(sc.link_length_2) == np.float32(ab.LINK_LENGTH_2)
+    assert float(sc.torque) == np.float32(ab.TORQUE)
+    assert obs.shape == (6,)
+    # Reset distribution: uniform(-0.1, 0.1) on all four state vars.
+    assert abs(float(state.theta1)) <= 0.1 and abs(float(state.dtheta2)) <= 0.1
+
+    st = state._replace(
+        dtheta1=jnp.asarray(100.0, jnp.float32),
+        dtheta2=jnp.asarray(-100.0, jnp.float32),
+    )
+    out = env.step(st, jnp.asarray(1))
+    assert abs(float(out.state.dtheta1)) <= float(ab.MAX_VEL_1) + 1e-5
+    assert abs(float(out.state.dtheta2)) <= float(ab.MAX_VEL_2) + 1e-5
+
+    st = state._replace(t=jnp.asarray(499, jnp.int32))
+    out = env.step(st, jnp.asarray(1))
+    assert float(out.done) == 1.0
+    assert float(out.info["terminated"]) == 0.0  # hanging start: truncation
+    assert int(out.state.t) == 0  # auto-reset
+
+
+def test_acrobot_scenario_fleet():
+    """randomize=r draws per-instance physics reproducibly (the ISSUE 8
+    contract extended to the new member)."""
+    from actor_critic_tpu.envs import make_acrobot
+
+    env = make_acrobot(randomize=0.3)
+    keys = jax.random.split(jax.random.key(2), 64)
+    s1, _ = jax.vmap(env.reset)(keys)
+    s2, _ = jax.vmap(env.reset)(keys)
+    m = np.asarray(s1.scenario.link_mass_2)
+    assert len(np.unique(m)) > 32
+    assert (m >= 1.0 * 0.7 - 1e-6).all() and (m <= 1.0 * 1.3 + 1e-6).all()
+    np.testing.assert_array_equal(m, np.asarray(s2.scenario.link_mass_2))
+
+
+def test_maze_procedural_generation_and_mechanics():
+    """The maze member (ISSUE 11): per-episode procedural layouts from
+    the instance's own PRNG stream, wall/obstacle blocking, goal
+    termination with reward, time-limit truncation."""
+    from actor_critic_tpu.envs import make_maze
+
+    env = make_maze(size=6)
+    state, obs = env.reset(jax.random.key(0))
+    assert obs.shape == (13,)
+    grid = np.asarray(state.grid)
+    assert grid.shape == (6, 6) and set(np.unique(grid)) <= {0.0, 1.0}
+    # Start and goal cells are always free and distinct.
+    assert grid[int(state.row), int(state.col)] == 0.0
+    assert grid[int(state.goal_row), int(state.goal_col)] == 0.0
+    assert (int(state.row), int(state.col)) != (
+        int(state.goal_row), int(state.goal_col)
+    )
+
+    # Walking into the arena wall stays in place and pays the step cost
+    # (goal pinned far away so the forced position can't terminate).
+    st = state._replace(
+        row=jnp.asarray(0, jnp.int32), col=jnp.asarray(0, jnp.int32),
+        goal_row=jnp.asarray(4, jnp.int32), goal_col=jnp.asarray(4, jnp.int32),
+        grid=state.grid.at[0, 0].set(0.0),
+    )
+    out = env.step(st, jnp.asarray(0))  # up, off the top edge
+    assert int(out.state.row) == 0 and int(out.state.col) == 0
+    assert float(out.done) == 0.0
+    assert float(out.reward) == pytest.approx(-0.05)
+
+    # Stepping onto the goal terminates with goal_reward - step_cost.
+    st = state._replace(
+        row=jnp.asarray(2, jnp.int32), col=jnp.asarray(2, jnp.int32),
+        goal_row=jnp.asarray(2, jnp.int32), goal_col=jnp.asarray(3, jnp.int32),
+        grid=state.grid.at[2, 3].set(0.0),
+    )
+    out = env.step(st, jnp.asarray(1))  # right, onto the goal
+    assert float(out.info["terminated"]) == 1.0
+    assert float(out.done) == 1.0
+    assert float(out.reward) == pytest.approx(1.0 - 0.05)
+
+    # An obstacle blocks the same move.
+    blocked = st._replace(grid=st.grid.at[2, 3].set(1.0))
+    out = env.step(blocked, jnp.asarray(1))
+    assert int(out.state.row) == 2 and int(out.state.col) == 2
+    assert float(out.done) == 0.0
+
+    # Truncation at 8*size; auto-reset regenerates a DIFFERENT layout.
+    st = state._replace(t=jnp.asarray(8 * 6 - 1, jnp.int32))
+    out = env.step(st, jnp.asarray(0))
+    assert float(out.done) == 1.0
+    assert float(out.info["terminated"]) in (0.0, 1.0)
+    assert int(out.state.t) == 0
+    assert not np.array_equal(np.asarray(out.state.grid), grid)
+
+
+def test_maze_fleet_reproducible():
+    from actor_critic_tpu.envs import make_maze
+
+    env = make_maze(randomize=0.4)
+    keys = jax.random.split(jax.random.key(3), 32)
+    s1, o1 = jax.vmap(env.reset)(keys)
+    s2, o2 = jax.vmap(env.reset)(keys)
+    np.testing.assert_array_equal(np.asarray(s1.grid), np.asarray(s2.grid))
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+    d = np.asarray(s1.scenario.density)
+    assert len(np.unique(d)) > 16  # per-instance generation params

@@ -42,6 +42,27 @@ def build_env(spec: str, algo: str, cfg, seed: int, scale_actions=None,
     construction knobs, so kwargs there are an error, not a silent drop."""
     kind, _, name = spec.partition(":")
     env_kwargs = dict(env_kwargs or {})
+    if kind == "mixture":
+        # 'mixture:cartpole*2,pendulum,acrobot,maze' — a heterogeneous
+        # fleet of env TYPES stepping inside one fused program
+        # (envs/mixture.py, ISSUE 11). The member list (with optional
+        # per-type draw weights) is the spec; --env-set reaches the
+        # mixture maker (randomize/action_bins/redraw_types/...).
+        import inspect
+
+        from actor_critic_tpu.envs import make_mixture
+
+        valid = set(inspect.signature(make_mixture).parameters) - {"members"}
+        unknown = sorted(set(env_kwargs) - valid)
+        if unknown:
+            raise SystemExit(
+                f"bad --env-set for {spec}: unknown kwargs {unknown}; "
+                f"valid: {sorted(valid)}"
+            )
+        try:
+            return make_mixture(name, **env_kwargs), True
+        except ValueError as e:
+            raise SystemExit(f"bad mixture env {spec!r}: {e}") from e
     if kind == "jax":
         from actor_critic_tpu import envs as E
 
@@ -125,7 +146,8 @@ def build_env(spec: str, algo: str, cfg, seed: int, scale_actions=None,
                 raise SystemExit(f"bad --env-set for {spec}: {e}") from e
             raise
     raise SystemExit(
-        f"env must be jax:<name>, host:<gym id>, or native:<id>, got {spec!r}"
+        f"env must be jax:<name>, mixture:<members>, host:<gym id>, or "
+        f"native:<id>, got {spec!r}"
     )
 
 
@@ -251,7 +273,9 @@ def steps_per_iteration(algo: str, cfg) -> int:
 
 def run_fused(env, preset, args, logger) -> dict:
     import jax
+    import jax.numpy as jnp
 
+    from actor_critic_tpu.envs import mixture
     from actor_critic_tpu.utils.checkpoint import Checkpointer, checkpointed_train
 
     mod = fused_module(preset.algo)
@@ -298,9 +322,32 @@ def run_fused(env, preset, args, logger) -> dict:
     from actor_critic_tpu.algos.host_loop import should_log
 
     eval_fn = None
+    typed_eval = None
+    eval_matrix: dict = {}
     if getattr(args, "eval_every", 0) > 0:
         eval_fn = jax.jit(mod.make_eval_fn(env, cfg), static_argnums=(2, 3))
         eval_key = jax.random.key(args.seed + 1)
+        if isinstance(env, mixture.MixtureEnv):
+            # Per-type eval matrix (ISSUE 11): one jitted program whose
+            # fleet is pinned to a TRACED type id — every member type
+            # evaluates through the same executable. Last results ride
+            # the sampler registry into /metrics + resources.jsonl
+            # (rendered by scripts/run_report.py).
+            typed_eval = jax.jit(
+                mixture.make_typed_eval(env, mod.make_network(env, cfg)),
+                static_argnums=(3, 4),
+            )
+
+    # Curriculum (ISSUE 11): the controller advances on eval progress;
+    # the new weights are installed into the fleet state between
+    # dispatches (same shapes/dtypes — never a retrace) and ride the
+    # checkpoint, so a resumed run continues the schedule.
+    curriculum_ctl = None
+    pending_weights: list = []
+    if getattr(args, "curriculum", ""):
+        curriculum_ctl = mixture.CurriculumController(
+            mixture.parse_curriculum(args.curriculum, env.member_names)
+        )
 
     def log_fn(it, metrics):
         # Eval cadence is INDEPENDENT of the logging cadence; an eval
@@ -312,6 +359,26 @@ def run_fused(env, preset, args, logger) -> dict:
         ):
             with telemetry.span("eval", it=it):
                 extra["eval_return"] = float(eval_fn(state_box[0], eval_key))
+                if typed_eval is not None:
+                    for t, name in enumerate(env.member_names):
+                        r = float(typed_eval(
+                            state_box[0],
+                            jax.random.fold_in(eval_key, t),
+                            jnp.asarray(t, jnp.int32),
+                        ))
+                        extra[f"eval_return_{name}"] = round(r, 3)
+                        eval_matrix.update(mixture.eval_matrix_row(name, r))
+            if curriculum_ctl is not None:
+                advanced = curriculum_ctl.update(extra["eval_return"])
+                if advanced is not None:
+                    stage, weights = advanced
+                    pending_weights[:] = [(stage, weights)]
+                    print(
+                        f"curriculum: eval {extra['eval_return']:.1f} -> "
+                        f"stage {stage}, weights {list(weights)}",
+                        flush=True,
+                    )
+                extra["curriculum_stage"] = curriculum_ctl.stage
             do_log = True
         if do_log:
             # Health monitors see the materialized row — AFTER the eval
@@ -334,8 +401,22 @@ def run_fused(env, preset, args, logger) -> dict:
     # log_fn needs the CURRENT state for eval; checkpointed_train owns the
     # loop, so expose it via a one-cell box updated by a wrapped step.
     state_box = [state]
+    ctl_synced = [curriculum_ctl is None]
 
     def step_tracking(s, *k):
+        if not ctl_synced[0]:
+            # First dispatch after a (possible) restore: re-align the
+            # host-side curriculum counter from the stage the restored
+            # fleet state carries, so resume continues the schedule.
+            curriculum_ctl.sync(mixture.fleet_stage(s.rollout.env_state))
+            ctl_synced[0] = True
+        if pending_weights:
+            stage, weights = pending_weights.pop()
+            s = s._replace(rollout=s.rollout._replace(
+                env_state=mixture.set_fleet_weights(
+                    s.rollout.env_state, weights, stage
+                )
+            ))
         # jax:* envs fuse the rollout INTO the update program, so the
         # env_step phase has no separable host duration — record it as a
         # Chrome-trace instant so traces still carry the phase.
@@ -344,11 +425,24 @@ def run_fused(env, preset, args, logger) -> dict:
         state_box[0] = out
         return out, m
 
-    state, metrics = checkpointed_train(
-        step_tracking, state, args.iterations,
-        ckpt=ckpt, save_every=args.save_every, log_fn=log_fn,
-        resume=args.resume, stride=chunk,
-    )
+    gauge_key = None
+    if typed_eval is not None:
+        from actor_critic_tpu.telemetry import sampler
+
+        gauge_key = sampler.register_gauge(
+            "mixture_eval", lambda: dict(eval_matrix)
+        )
+    try:
+        state, metrics = checkpointed_train(
+            step_tracking, state, args.iterations,
+            ckpt=ckpt, save_every=args.save_every, log_fn=log_fn,
+            resume=args.resume, stride=chunk,
+        )
+    finally:
+        if gauge_key is not None:
+            from actor_critic_tpu.telemetry import sampler
+
+            sampler.unregister_gauge(gauge_key)
     if ckpt is not None:
         ckpt.close()
     return {k: float(v) for k, v in metrics.items()}
@@ -582,6 +676,16 @@ def main(argv=None) -> int:
         "opp_skill=0.5 --env-set frame_skip=4; merges over the preset's "
         "env_kwargs",
     )
+    p.add_argument(
+        "--curriculum", default="", metavar="SPEC",
+        help="mixture envs (fused, needs --eval-every): re-weight the "
+        "type/scenario draw distribution as learner eval progress "
+        "crosses thresholds — 'THR:w0,w1,..;THR:w0,w1,..', one stage "
+        "per semicolon entry, weights in member order (envs/mixture.py "
+        "grammar). Forces redraw_types=True on the mixture; the stage "
+        "and weights ride the env state inside the checkpoint, so "
+        "--resume continues the schedule.",
+    )
     p.add_argument("--metrics", default="metrics.jsonl", help="JSONL output path")
     p.add_argument(
         "--telemetry-dir",
@@ -814,6 +918,37 @@ def main(argv=None) -> int:
         )
     if args.iterations is None:
         args.iterations = preset.iterations
+
+    if args.curriculum:
+        # Every doomed --curriculum combination exits before any env or
+        # device work: the schedule drives a fused mixture fleet and
+        # advances on the eval cadence.
+        if not preset.env.startswith("mixture:"):
+            raise SystemExit(
+                "--curriculum re-weights a mixture fleet's type draw "
+                "(--env mixture:<members>); it has no effect on "
+                f"{preset.env!r}"
+            )
+        if args.eval_every <= 0:
+            raise SystemExit(
+                "--curriculum advances on learner eval progress — pass "
+                "--eval-every N"
+            )
+        from actor_critic_tpu.envs import mixture as _mixture
+        from actor_critic_tpu.envs import parse_mixture_spec
+
+        try:
+            names = tuple(
+                n for n, _ in
+                parse_mixture_spec(preset.env.partition(":")[2])
+            )
+            _mixture.parse_curriculum(args.curriculum, names)
+        except ValueError as e:
+            raise SystemExit(f"bad --curriculum: {e}") from e
+        # Type re-draws are what the weights act on; an explicit
+        # --env-set redraw_types=false wins (and makes the schedule a
+        # weights-recording no-op, which the user asked for).
+        preset.env_kwargs.setdefault("redraw_types", True)
 
     if args.distributed:
         # Every doomed flag combination exits HERE, before the blocking
