@@ -364,6 +364,7 @@ def _ensure_builtin_checks() -> None:
     # lazy so `import actor_critic_tpu.analysis.core` alone stays cheap.
     from actor_critic_tpu.analysis import (  # noqa: F401
         concurrency,
+        distributed,
         donation,
         host_sync,
         prng,

@@ -193,10 +193,13 @@ def params_file(mailbox_dir: str, rank: int) -> str:
 
 def write_params(mailbox_dir: str, rank: int, version: int, params: Any) -> str:
     """Atomically publish this host's `(version, params)` snapshot:
-    flattened leaves into an .npz written next to the target and
-    `os.replace`-d into place, so a peer reading concurrently sees
+    flattened leaves into an .npz written next to the target, fsynced,
+    and `os.replace`-d into place, so a peer reading concurrently sees
     either the previous complete snapshot or this one — never a torn
-    file. Latest-wins by construction (one file per host)."""
+    file (and, post-crash, never a rename that outlived its data
+    blocks). Latest-wins by construction (one file per host); the tmp
+    name carries the pid so restarted/colliding writers in a shared
+    directory can never interleave into one file."""
     import jax
 
     path = params_file(mailbox_dir, rank)
@@ -207,24 +210,56 @@ def write_params(mailbox_dir: str, rank: int, version: int, params: Any) -> str:
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "wb") as f:
         np.savez(f, **payload)
+        # fsync BEFORE the rename: without it a crash can leave the
+        # rename durable while the data blocks are not — a zero-length
+        # "complete" snapshot, the one torn shape atomic-rename alone
+        # does not exclude.
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)
     return path
 
 
-def read_params(mailbox_dir: str, rank: int, template: Any):
-    """Latest published `(version, params)` of `rank`, rebuilt into
-    `template`'s tree structure; None when the host has not published
-    yet (or the read raced the very first publish's creation)."""
-    import jax
+def _load_snapshot(path: str):
+    """`(version, leaves)` of a published snapshot file, or None when
+    it is absent, the read raced the very first publish's creation, or
+    the file is torn/partial (a crashed or non-atomic writer): torn
+    reads are retried on the next poll, never fatal. The ONE place the
+    torn-file exception set lives — NB `np.load` raises
+    `zipfile.BadZipFile` (NOT an OSError) on a truncated archive and
+    `EOFError` on an empty one; the reverted PR 12 reader missed both
+    and the mailbox writer thread died on the first torn snapshot."""
+    import zipfile
 
-    path = params_file(mailbox_dir, rank)
     try:
         with np.load(path) as z:
             version = int(z["version"])
             leaves = [z[f"leaf{i}"] for i in range(len(z.files) - 1)]
-    except (OSError, KeyError, ValueError):
+    except (OSError, KeyError, ValueError, EOFError, zipfile.BadZipFile):
         return None
+    return version, leaves
+
+
+def read_params(mailbox_dir: str, rank: int, template: Any):
+    """Latest published `(version, params)` of `rank`, rebuilt into
+    `template`'s tree structure; None when absent/torn (the
+    `_load_snapshot` tolerance contract)."""
+    import jax
+
+    out = _load_snapshot(params_file(mailbox_dir, rank))
+    if out is None:
+        return None
+    version, leaves = out
     return version, jax.tree.unflatten(jax.tree.structure(template), leaves)
+
+
+def read_version(mailbox_dir: str, rank: int) -> Optional[int]:
+    """Version field alone of `rank`'s published snapshot — no params
+    template needed, so observers (FleetMonitor, an LB health probe)
+    can read a fleet's mailbox without knowing its tree structure;
+    None when absent/torn (the `_load_snapshot` tolerance contract)."""
+    out = _load_snapshot(params_file(mailbox_dir, rank))
+    return None if out is None else out[0]
 
 
 def gossip_peer(rank: int, world: int, round_: int) -> int:
@@ -286,6 +321,11 @@ class FileMailboxWriter:
         # tolerates a one-poll-stale round — it would just re-read the
         # previous peer's file once)
         self._round = 0
+        # jaxlint: thread-owned=mailbox (single writer: poll_once is
+        # only ever called from the mailbox thread's _run loop — or, in
+        # fleetsan, from the scheduler with the thread never started —
+        # and nothing else reads the per-peer clock)
+        self._seen: dict[int, int] = {}
         self.error: Optional[BaseException] = None
         self._thread = threading.Thread(
             target=self._run, name=f"mailbox-{rank}", daemon=True
@@ -303,23 +343,93 @@ class FileMailboxWriter:
     def join(self, timeout: Optional[float] = None) -> None:
         self._thread.join(timeout)
 
+    def poll_once(self) -> bool:
+        """ONE poll of the ring-scheduled peer: read its published
+        snapshot, drop versions that peer already reached (versions are
+        per-peer clocks — `self._seen` tracks the newest PER RANK so
+        the ring rotating onto a slower peer still deposits its
+        lower-numbered fresh news), deposit the rest. Returns True when
+        a deposit landed. Factored out of the thread loop so fleetsan
+        can drive the REAL consume logic under a deterministic
+        scheduler (no thread, no wall-clock)."""
+        peer = gossip_peer(self._rank, self._world, self._round)
+        out = read_params(self._dir, peer, self._template)
+        if out is None:
+            return False
+        version, params = out
+        if version <= self._seen.get(peer, -1):
+            return False
+        if self._mailbox.deposit(params, version, peer):
+            self._seen[peer] = version
+            return True
+        return False
+
     def _run(self) -> None:
-        # Versions are per-peer clocks (not comparable across peers):
-        # track the newest seen PER RANK so the ring rotating onto a
-        # slower peer still deposits its (lower-numbered) fresh news.
-        seen: dict[int, int] = {}
         try:
             while not self._stop.is_set():
-                peer = gossip_peer(self._rank, self._world, self._round)
-                out = read_params(self._dir, peer, self._template)
-                if out is not None:
-                    version, params = out
-                    if version > seen.get(peer, -1):
-                        if self._mailbox.deposit(params, version, peer):
-                            seen[peer] = version
+                self.poll_once()
                 self._stop.wait(self._poll_s)
         except BaseException as e:  # surfaced by the learner loop
             self.error = e
+
+
+class FleetMonitor:
+    """Fleet-membership observability over the gossip mailbox (ROADMAP
+    elastic-ops item (d), ISSUE 12 satellite): rank, world size, and
+    per-peer last-publish age read from the shared `mailbox_dir` — the
+    same files the exchange itself uses, so "this peer went quiet" is
+    measured at the transport, not inferred. `snapshot()` feeds
+    `/healthz` (serving gateway `--distributed`): a peer whose mailbox
+    age exceeds `stale_after_s` (or that never published) marks the
+    fleet degraded and the endpoint answers 503.
+
+    Ages come from `os.stat` mtime — no parse, so a torn file still
+    reports an age; the version field rides via `read_version` when
+    the file parses (torn/absent -> None, the `read_params` tolerance
+    contract — no params template needed)."""
+
+    def __init__(
+        self,
+        mailbox_dir: str,
+        rank: int,
+        world: int,
+        stale_after_s: float = 30.0,
+    ):
+        self.mailbox_dir = mailbox_dir
+        self.rank = int(rank)
+        self.world = int(world)
+        self.stale_after_s = float(stale_after_s)
+
+    def snapshot(self) -> dict:
+        """{rank, world, stale_after_s, peers: {rank: {age_s, version,
+        published}}, stale: [ranks], ok}. Peers = every OTHER rank of
+        the fleet; `ok` iff none is stale."""
+        now = time.time()
+        peers: dict[str, dict] = {}
+        stale: list[int] = []
+        for peer in range(self.world):
+            if peer == self.rank:
+                continue
+            path = params_file(self.mailbox_dir, peer)
+            entry: dict = {"published": False, "age_s": None, "version": None}
+            try:
+                entry["age_s"] = round(now - os.stat(path).st_mtime, 3)
+                entry["published"] = True
+            except OSError:
+                pass
+            if entry["published"]:
+                entry["version"] = read_version(self.mailbox_dir, peer)
+            if not entry["published"] or entry["age_s"] > self.stale_after_s:
+                stale.append(peer)
+            peers[str(peer)] = entry
+        return {
+            "rank": self.rank,
+            "world": self.world,
+            "stale_after_s": self.stale_after_s,
+            "peers": peers,
+            "stale": stale,
+            "ok": not stale,
+        }
 
 
 # ---------------------------------------------------------------------------

@@ -210,9 +210,16 @@ class ServeGateway:
         stall_after_s: float = 5.0,
         batcher: Optional[MicroBatcher] = None,
         threaded: bool = True,
+        fleet=None,
     ):
         self.store = store
         self.session = session
+        # Optional multihost.FleetMonitor (ISSUE 12 satellite): when
+        # the gateway serves one host of a --distributed fleet,
+        # /healthz surfaces rank/world/per-peer mailbox ages and goes
+        # 503 when a peer's last gossip exchange is older than the
+        # monitor's bound — the ROADMAP elastic-ops observability half.
+        self.fleet = fleet
         self.threaded = bool(threaded)
         self.request_timeout_s = float(request_timeout_s)
         self.stall_after_s = float(stall_after_s)
@@ -349,6 +356,14 @@ class ServeGateway:
         stalled = (not h["alive"]) or (
             h["queue_depth"] > 0 and h["last_flush_age_s"] > self.stall_after_s
         )
+        if self.fleet is not None:
+            snap = self.fleet.snapshot()
+            body["fleet"] = snap
+            if not snap["ok"]:
+                # A quiet peer degrades THIS host's health: the LB
+                # fronting the fleet sees which members report a
+                # partitioned/late mailbox, not just who died.
+                stalled = True
         if stalled:
             body["status"] = "stalled"
             return 503, body

@@ -272,6 +272,23 @@ def multihost_straggler_cell(rec: dict | None) -> str:
     return _numeric_cell(straggler.get("gossip_over_sync"))
 
 
+def multihost_recover_cell(rec: dict | None) -> str:
+    """Wall time-to-recover after an injected host kill (ISSUE 12's
+    fault-injection block; `-` before the block existed, `?`/`err`
+    where it is malformed or the chaos run failed)."""
+    entry, cell = _multihost_entry(rec)
+    if entry is None:
+        return cell
+    fault = entry.get("fault_injection")
+    if fault is None:
+        return "-"
+    if not isinstance(fault, dict):
+        return "?"
+    if "error" in fault:
+        return "err"
+    return _numeric_cell(fault.get("time_to_recover_s"))
+
+
 def trend_rows(root: str) -> tuple[list[int], list[tuple[str, list[str]]]]:
     """(round numbers, [(row label, cells per round)]) — the table body.
 
@@ -303,6 +320,10 @@ def trend_rows(root: str) -> tuple[list[int], list[tuple[str, list[str]]]]:
             rows.append((
                 "multihost_scaling.straggler_gossip_x",
                 [multihost_straggler_cell(r) for r in recs],
+            ))
+            rows.append((
+                "multihost_scaling.recover_s",
+                [multihost_recover_cell(r) for r in recs],
             ))
         if name == "scenario_fleet":
             # Scenario-universe sub-rows (ISSUE 11): the heterogeneous

@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""fleetsan: deterministic multi-process chaos sanitizer for the
+mailbox/gossip/gateway stack (ISSUE 12).
+
+    python scripts/fleetsan.py                       # quick profile
+    python scripts/fleetsan.py --schedules 100       # wider sweep
+    python scripts/fleetsan.py --scenario fleet --writer direct
+                                                     # reproduce the
+                                                     # torn-publish bug
+    python scripts/fleetsan.py --scenario gateway --poller naive
+                                                     # reproduce the
+                                                     # version-regress bug
+    python scripts/fleetsan.py --scenario process    # REAL subprocess
+                                                     # kill/restart TTR
+    python scripts/fleetsan.py --json                # machine output
+
+Exit codes (scripts/tier1.sh runs the quick profile between racesan
+and pytest, under its own timeout):
+    0  clean: every seeded chaos schedule swept without a violation
+    1  violation: a schedule detected a protocol break (torn publish,
+       tempfile collision, version regression, unbounded recovery) —
+       the sanitizer working
+    2  crash: unexpected error (a broken exerciser, not a detection)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[1].strip())
+    p.add_argument(
+        "--schedules", type=int, default=30,
+        help="seeded chaos schedules to sweep (default 30, the tier-1 "
+        "quick profile: half fleet, half gateway)",
+    )
+    p.add_argument(
+        "--seed0", type=int, default=0,
+        help="first seed of the sweep (fixed seeds keep tier-1 "
+        "deterministic; a detected violation names its seed for replay)",
+    )
+    p.add_argument(
+        "--scenario", choices=("all", "fleet", "gateway", "process"),
+        default="all",
+        help="which unit to exercise (default: the quick profile; "
+        "'process' spawns REAL gossip workers and SIGKILLs one)",
+    )
+    p.add_argument(
+        "--writer", choices=("atomic", "direct", "shared-tmp"),
+        default="atomic",
+        help="fleet publish mode: 'direct'/'shared-tmp' are the "
+        "reverted-bug writers (expected exit 1)",
+    )
+    p.add_argument(
+        "--poller", choices=("guarded", "naive"), default="guarded",
+        help="gateway consume mode: 'naive' is the reverted "
+        "no-per-peer-clock consumer (expected exit 1)",
+    )
+    p.add_argument(
+        "--world", type=int, default=3,
+        help="fleet scenario rank count (default 3 — ring rotation "
+        "needs >= 3 to distinguish per-peer clocks from global ones)",
+    )
+    p.add_argument(
+        "--duration-s", type=float, default=8.0,
+        help="process scenario: per-worker wall window",
+    )
+    p.add_argument("--json", action="store_true", help="machine output")
+    args = p.parse_args(argv)
+
+    from actor_critic_tpu.analysis import fleetsan
+
+    try:
+        if args.scenario == "all":
+            out = fleetsan.quick_profile(
+                schedules=args.schedules, seed0=args.seed0
+            )
+        elif args.scenario == "fleet":
+            out = fleetsan.exercise_sweep(
+                range(args.seed0, args.seed0 + args.schedules),
+                lambda s: fleetsan.exercise_fleet(
+                    s, world=args.world,
+                    writer=args.writer.replace("-", "_"),
+                ),
+            )
+        elif args.scenario == "gateway":
+            out = fleetsan.exercise_sweep(
+                range(args.seed0, args.seed0 + args.schedules),
+                lambda s: fleetsan.exercise_gateway(
+                    s, poller=args.poller
+                ),
+            )
+        else:
+            out = fleetsan.run_process_chaos(
+                duration_s=args.duration_s, seed=args.seed0
+            )
+    except fleetsan.FleetSanError as e:
+        # A detected violation names its seed: rerun that single seed
+        # to replay the schedule (and its faults) bit-identically.
+        print(f"fleetsan: VIOLATION DETECTED: {e}", file=sys.stderr)
+        return 1
+    except Exception as e:
+        print(f"fleetsan: error: {type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(json.dumps(out, indent=2, default=str))
+    elif args.scenario == "process":
+        print(
+            f"fleetsan: host kill/restart clean — time-to-recover "
+            f"{out.get('time_to_recover_s')}s "
+            f"(survivor mixes {out.get('survivor_gossip_mixes')})"
+        )
+    else:
+        print(f"fleetsan: {out.get('schedules', 0)} chaos schedule(s) clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -394,10 +394,26 @@ def run_bench(args) -> dict:
         "sync": run_cluster(2, "sync", **straggle),
         "gossip": run_cluster(2, "gossip", **straggle),
     }
+    # Fault injection (ISSUE 12 satellite): SIGKILL a REAL gossip
+    # worker mid-run, restart it, and measure wall time-to-recover —
+    # fleetsan's process injector reused as the bench driver. Malformed
+    # or failed runs degrade to an error entry (bench_trend renders
+    # `?`), never take the whole grid down.
+    from actor_critic_tpu.analysis import fleetsan
+
+    try:
+        fault = fleetsan.run_process_chaos(
+            world=2, duration_s=max(duration * 2, 12.0),
+            kill_after_s=max(duration / 3, 3.0),
+            timeout_s=args.run_timeout, seed=args.seed,
+        )
+    except Exception as e:
+        fault = {"error": f"{type(e).__name__}: {e}"}
     agg = lambda r: r["aggregate_steps_per_s"]  # noqa: E731
     record = {
         "metric": "multihost_scaling",
         "value": round(agg(sync["4"]) / agg(sync["1"]), 2),
+        "fault_injection": fault,
         "unit": "x aggregate consumed env-steps/s, 4 processes vs 1 "
                 "(sync all-reduce, sleep-padded CartPole, CPU local "
                 "cluster)",

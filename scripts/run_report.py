@@ -582,6 +582,22 @@ def static_findings() -> list[str]:
             "check-then-act) — `python scripts/racesan.py` exercises "
             "the queue/publisher units under deterministic schedules",
         ]
+    dist = [
+        f for f in new
+        if f.get("check")
+        in ("collective-discipline", "mailbox-protocol", "rank-affinity")
+    ]
+    if dist:
+        # Distributed row (ISSUE 12): fleet-protocol hazards — a
+        # desynced collective or torn mailbox shows up as a cross-host
+        # hang/clobber, the most expensive class to diagnose from logs.
+        out += [
+            f"- **distributed**: {len(dist)} of these are fleet-protocol "
+            "hazards (collective-discipline / mailbox-protocol / "
+            "rank-affinity) — `python scripts/fleetsan.py` exercises "
+            "the mailbox/gossip/gateway stack under deterministic "
+            "chaos schedules",
+        ]
     out += [
         f"- `{f.get('path')}:{f.get('line')}` **[{f.get('check')}]** "
         f"{f.get('message')}"

@@ -144,7 +144,34 @@ def main(argv=None) -> int:
         "--no-warmup", action="store_true",
         help="skip startup bucket compilation (first flushes compile)",
     )
+    p.add_argument(
+        "--distributed", action="store_true",
+        help="this gateway serves one host of a gossip fleet: /healthz "
+        "surfaces fleet membership (rank, world, per-peer mailbox age) "
+        "read from --mailbox-dir and answers 503 when a peer's last "
+        "publish is older than --stale-after-s",
+    )
+    p.add_argument(
+        "--mailbox-dir", default=None,
+        help="the fleet's shared gossip mailbox directory "
+        "(train.py/launch_multihost --mailbox-dir)",
+    )
+    p.add_argument("--rank", type=int, default=0,
+                   help="this host's fleet rank (default 0)")
+    p.add_argument("--world", type=int, default=None,
+                   help="fleet size (required with --distributed)")
+    p.add_argument(
+        "--stale-after-s", type=float, default=30.0,
+        help="peer mailbox age bound before /healthz degrades to 503 "
+        "(default 30)",
+    )
     args = p.parse_args(argv)
+
+    if args.distributed and (args.mailbox_dir is None or args.world is None):
+        raise SystemExit(
+            "--distributed wants --mailbox-dir and --world (the fleet "
+            "this gateway is a member of)"
+        )
 
     from actor_critic_tpu import config as config_mod
     from actor_critic_tpu import serving
@@ -218,9 +245,19 @@ def main(argv=None) -> int:
         n_warm = engine.warm(store.get(store.default_id).params)
         print(f"warm: {n_warm} act buckets compiled", flush=True)
 
+    fleet = None
+    if args.distributed:
+        from actor_critic_tpu.parallel.multihost import FleetMonitor
+
+        fleet = FleetMonitor(
+            args.mailbox_dir, args.rank, args.world,
+            stale_after_s=args.stale_after_s,
+        )
+
     gateway = serving.ServeGateway(
         store, port=args.port, host=args.host, session=session,
         max_wait_us=args.max_wait_us, queue_limit=args.queue_limit,
+        fleet=fleet,
     )
     # The ACTUAL bound port — with --port 0 this is the OS-assigned one.
     print(

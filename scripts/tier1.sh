@@ -13,6 +13,12 @@ env JAX_PLATFORMS=cpu python scripts/jaxlint.py actor_critic_tpu train.py bench 
 # timeout so a schedule hang (exit 124) cannot eat the pytest budget
 # below (exit 1 = race detected, 2 = exerciser crash).
 timeout -k 5 120 env JAX_PLATFORMS=cpu python scripts/racesan.py --schedules 100 || exit $?
+# Fleet chaos sanitizer quick profile (ISSUE 12): 30 fixed-seed chaos
+# schedules over the gossip-fleet + gateway-swap units (real mailbox
+# objects, injected kills/torn files/reordered delivery), under its
+# OWN timeout like the racesan step (exit 1 = protocol violation
+# detected, 2 = exerciser crash).
+timeout -k 5 180 env JAX_PLATFORMS=cpu python scripts/fleetsan.py --schedules 30 || exit $?
 # Multi-process CPU smoke (ISSUE 9): a 2-process jax.distributed local
 # cluster must come up against a localhost coordinator, train a few
 # blocks through the global-mesh learner, and agree bit-exactly on the

@@ -1,0 +1,35 @@
+"""Flag fixture (MUST FLAG collective-discipline, all three shapes):
+an axis name no mesh declares, a collective gated on a process-local
+branch, and a collective inside an exception-swallowing try. Parsed
+only — never imported."""
+
+import time
+
+import jax
+
+FLEET_AXIS = "dp"
+
+mesh = jax.make_mesh((1,), (FLEET_AXIS,))
+
+
+def reduce_bad_axis(x):
+    return jax.lax.psum(x, "dq")  # typo'd axis: no mesh declares "dq"
+
+
+def rank_gated_reduce(x, rank):
+    if rank == 0:  # process-local predicate: only rank 0 enters
+        return jax.lax.psum(x, "dp")  # ...the psum the others sit in
+    return x
+
+
+def deadline_gated_reduce(x, deadline):
+    while time.monotonic() < deadline:  # wall clocks differ per host
+        x = jax.lax.pmean(x, "dp")
+    return x
+
+
+def swallowed_reduce(x):
+    try:
+        return jax.lax.pmean(x, "dp")
+    except RuntimeError:
+        return x  # this host skips the exchange the fleet executes

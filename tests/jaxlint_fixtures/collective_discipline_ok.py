@@ -1,0 +1,38 @@
+"""Near miss: the collective_discipline_flag.py shapes made safe — the
+declared axis constant everywhere, branches keyed on fleet-uniform
+values, a stop decision all-reduced as a vote instead of gating the
+exchange, and a try whose handler re-raises. Parsed only — never
+imported."""
+
+import time
+
+import jax
+
+FLEET_AXIS = "dp"
+
+mesh = jax.make_mesh((1,), (FLEET_AXIS,))
+
+
+def reduce_declared_axis(x):
+    return jax.lax.psum(x, FLEET_AXIS)  # the declared constant
+
+
+def mode_gated_reduce(x, mode):
+    if mode == "sync":  # fleet-uniform flag: every host agrees
+        return jax.lax.psum(x, FLEET_AXIS)
+    return x
+
+
+def voted_stop_reduce(x, deadline):
+    # The designed shape: the process-local deadline rides INTO the
+    # collective as a vote; the break decision is its fleet-agreed sum.
+    vote = 1.0 if time.monotonic() >= deadline else 0.0
+    votes = jax.lax.psum(x * 0 + vote, FLEET_AXIS)
+    return votes
+
+
+def reraising_reduce(x):
+    try:
+        return jax.lax.pmean(x, FLEET_AXIS)
+    except RuntimeError:
+        raise  # a dead host takes its fleet slot down loudly

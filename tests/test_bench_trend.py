@@ -83,6 +83,7 @@ def _write_multihost_rounds(root: Path):
                     "4": {"aggregate_steps_per_s": 184.0},
                 },
                 "straggler": {"gossip_over_sync": 2.01},
+                "fault_injection": {"time_to_recover_s": 8.41},
             },
         },
     }) + "\n")
@@ -92,6 +93,7 @@ def _write_multihost_rounds(root: Path):
             "multihost_scaling": {
                 "value": 0.5, "sync": "oops",
                 "straggler": {"gossip_over_sync": None},
+                "fault_injection": {"error": "FleetSanError: rejoin"},
             },
         },
     }) + "\n")
@@ -113,6 +115,12 @@ def test_multihost_per_process_rows(tmp_path):
     assert table["multihost_scaling.p4"] == ["-", "184", "?", "?"]
     assert table["multihost_scaling.straggler_gossip_x"] == [
         "-", "2.01", "?", "?",
+    ]
+    # ISSUE 12 satellite: wall time-to-recover after an injected host
+    # kill; '-' before the fault-injection block existed, 'err' where
+    # the chaos run itself failed.
+    assert table["multihost_scaling.recover_s"] == [
+        "-", "8.41", "err", "?",
     ]
     # Sub-rows sit directly under the main multihost row.
     labels = [label for label, _ in rows]

@@ -1,0 +1,337 @@
+"""Tier-1 wiring for fleetsan, the deterministic multi-process chaos
+sanitizer (ISSUE 12).
+
+Four layers, mirroring test_racesan.py one level up:
+
+1. **Scheduler mechanics** — a seeded chaos schedule replays
+   bit-identically (trace AND outcome), different seeds genuinely
+   permute interleavings and fault placement.
+2. **Reverted protocol bugs as runtime regressions** — the non-atomic
+   writer (`writer="direct"`) is torn-read-detected on EVERY schedule,
+   the shared-tempfile writer (`writer="shared_tmp"`) collides within a
+   small seed sweep and replays from its recorded seed, and the
+   no-per-peer-clock gateway consumer (`poller="naive"`) regresses the
+   resident policy on every schedule.
+3. **Mailbox hygiene units** — `read_params` tolerates torn/truncated/
+   empty snapshot files (the PR 12 `BadZipFile`/`EOFError` fix) and
+   `write_params`' pid-suffixed tmp names cannot collide across ranks.
+4. **Fleet observability** — `FleetMonitor.snapshot()` fields, and the
+   serving gateway's `/healthz` surfacing fleet membership + degrading
+   to 503 when a peer's mailbox goes stale (ISSUE 12 satellite).
+
+The chaos units drive the REAL `write_params`/`read_params`/
+`FileMailboxWriter.poll_once`/`ParamMailbox`/`PolicyStore.swap` objects
+on tiny trees — jax is imported transitively, no device work.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from actor_critic_tpu.analysis import fleetsan
+from actor_critic_tpu.analysis.fleetsan import FleetSanError
+from actor_critic_tpu.parallel.multihost import (
+    FleetMonitor,
+    params_file,
+    read_params,
+    read_version,
+    write_params,
+)
+
+# ---------------------------------------------------------------------------
+# scheduler mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_seeded_fleet_schedule_replays_bit_identically():
+    reports = [
+        fleetsan.exercise_fleet(seed=7, world=3, rounds=6) for _ in range(2)
+    ]
+    assert reports[0]["trace"] == reports[1]["trace"]
+    assert reports[0]["faults"] == reports[1]["faults"]
+    assert reports[0]["takes"] == reports[1]["takes"]
+    assert reports[0]["recover_rounds"] == reports[1]["recover_rounds"]
+
+
+def test_seeded_gateway_schedule_replays_bit_identically():
+    reports = [fleetsan.exercise_gateway(seed=3) for _ in range(2)]
+    assert reports[0]["trace"] == reports[1]["trace"]
+    assert reports[0]["swaps"] == reports[1]["swaps"]
+    assert reports[0]["faults"] == reports[1]["faults"]
+
+
+def test_different_seeds_permute_schedules_and_faults():
+    traces = set()
+    fault_menus = set()
+    for seed in range(8):
+        r = fleetsan.exercise_fleet(seed=seed, world=3, rounds=5)
+        traces.add(tuple(r["trace"]))
+        fault_menus.add(tuple(r["faults"]))
+    assert len(traces) > 1, "8 seeds produced one interleaving"
+    assert len(fault_menus) > 1, "8 seeds produced one fault placement"
+
+
+def test_clean_fleet_schedule_reports_progress():
+    r = fleetsan.exercise_fleet(seed=0, world=3, rounds=8)
+    assert r["violations"] == 0
+    assert r["takes"] > 0 and r["deposits"] > 0
+    # every injected kill recovered within the drain bound
+    assert len(r["recover_rounds"]) == r["kills"]
+
+
+# ---------------------------------------------------------------------------
+# reverted protocol bugs reproduce deterministically
+# ---------------------------------------------------------------------------
+
+
+def test_direct_writer_torn_publish_caught_on_every_schedule():
+    """The reverted non-atomic writer (consumed path written in place)
+    is read torn at the interleave point — every seed, not a lucky
+    preemption."""
+    for seed in range(6):
+        with pytest.raises(FleetSanError, match="unreadable|corrupt"):
+            fleetsan.exercise_fleet(
+                seed=seed, world=3, rounds=6, writer="direct", faults=False
+            )
+
+
+def test_shared_tmp_collision_caught_and_replays_from_its_seed():
+    """The shared-tempfile writer collides within a small seed sweep;
+    the recorded seed then reproduces the SAME detection bit-for-bit
+    (racesan's catch-then-replay contract at process granularity)."""
+    caught_seed = None
+    first_msg = None
+    for seed in range(16):
+        try:
+            fleetsan.exercise_fleet(
+                seed=seed, world=3, rounds=8, writer="shared_tmp",
+                faults=False,
+            )
+        except FleetSanError as e:
+            caught_seed, first_msg = seed, str(e)
+            break
+    assert caught_seed is not None, (
+        "16 seeds never collided the shared tempfile"
+    )
+    with pytest.raises(FleetSanError) as again:
+        fleetsan.exercise_fleet(
+            seed=caught_seed, world=3, rounds=8, writer="shared_tmp",
+            faults=False,
+        )
+    assert str(again.value) == first_msg
+
+
+def test_naive_gateway_poller_version_regression_every_schedule():
+    """The reverted consumer (no per-peer clock, raw read-then-swap)
+    swaps the replayed stale snapshot in — the scripted chaos sequence
+    exercises the regression path on every schedule."""
+    for seed in range(6):
+        with pytest.raises(FleetSanError, match="regress|swapped BACK"):
+            fleetsan.exercise_gateway(seed=seed, poller="naive")
+
+
+def test_guarded_gateway_poller_sweeps_clean():
+    for seed in range(6):
+        r = fleetsan.exercise_gateway(seed=seed, poller="guarded")
+        assert r["violations"] == 0
+        assert r["swaps"] > 0
+
+
+def test_quick_profile_sweeps_clean():
+    """The exact fixed-seed profile scripts/tier1.sh runs (smaller
+    schedule count here — the tier-1 step runs the full one)."""
+    out = fleetsan.quick_profile(schedules=6, seed0=0)
+    assert out["violations"] == 0
+    assert out["schedules"] == 6
+    assert out["fleet"]["takes"] > 0
+    assert out["gateway"]["swaps"] > 0
+
+
+# ---------------------------------------------------------------------------
+# mailbox hygiene units (the PR 12 fixes as regressions)
+# ---------------------------------------------------------------------------
+
+
+def _tree():
+    return {"w": np.zeros((2, 2), np.float32)}
+
+
+def test_read_params_tolerates_truncated_and_empty_files(tmp_path):
+    """`np.load` raises zipfile.BadZipFile on a truncated archive and
+    EOFError on an empty one — neither is an OSError; the pre-fix
+    reader died on the first torn snapshot."""
+    mailbox = str(tmp_path)
+    write_params(mailbox, 0, 3, _tree())
+    path = params_file(mailbox, 0)
+    size = os.path.getsize(path)
+    for cut in (0, 1, size // 2, size - 1):
+        with open(path, "r+b") as f:
+            f.truncate(cut)
+        assert read_params(mailbox, 0, _tree()) is None, (
+            f"torn read at {cut}/{size} bytes was not tolerated"
+        )
+        assert read_version(mailbox, 0) is None
+    # the next publish repairs the file for good
+    write_params(mailbox, 0, 4, _tree())
+    out = read_params(mailbox, 0, _tree())
+    assert out is not None and out[0] == 4
+    assert read_version(mailbox, 0) == 4
+
+
+def test_write_params_tmp_names_are_process_unique(tmp_path):
+    """The tmp is pid-suffixed next to the target: two ranks (or a
+    restarted writer) publishing into a shared directory can never
+    interleave into one tempfile."""
+    mailbox = str(tmp_path)
+    write_params(mailbox, 0, 1, _tree())
+    write_params(mailbox, 1, 1, _tree())
+    leftovers = [
+        f
+        for root, _dirs, files in os.walk(mailbox)
+        for f in files
+        if ".tmp" in f
+    ]
+    assert leftovers == [], f"stale tempfiles after publish: {leftovers}"
+
+
+# ---------------------------------------------------------------------------
+# fleet observability: FleetMonitor + gateway /healthz (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_monitor_snapshot_fields(tmp_path):
+    mailbox = str(tmp_path)
+    write_params(mailbox, 1, 5, _tree())
+    write_params(mailbox, 2, 9, _tree())
+    mon = FleetMonitor(mailbox, rank=0, world=3, stale_after_s=30.0)
+    snap = mon.snapshot()
+    assert snap["rank"] == 0 and snap["world"] == 3
+    assert set(snap["peers"]) == {"1", "2"}
+    assert snap["peers"]["1"]["version"] == 5
+    assert snap["peers"]["2"]["version"] == 9
+    assert snap["ok"] and snap["stale"] == []
+
+
+def test_fleet_monitor_flags_silent_and_stale_peers(tmp_path):
+    mailbox = str(tmp_path)
+    write_params(mailbox, 1, 2, _tree())
+    # peer 2 never published; peer 1 goes stale once its mtime ages out
+    mon = FleetMonitor(mailbox, rank=0, world=3, stale_after_s=0.2)
+    snap = mon.snapshot()
+    assert 2 in snap["stale"] and not snap["ok"]
+    assert snap["peers"]["1"]["published"]
+    old = time.time() - 10.0
+    os.utime(params_file(mailbox, 1), (old, old))
+    snap = mon.snapshot()
+    assert set(snap["stale"]) == {1, 2}
+
+
+class _StubEngine:
+    """jax-free engine: action = obs[:, 0] * params['scale'][0]."""
+
+    max_rows = 8
+
+    def prepare_params(self, params):
+        return {k: np.array(v) for k, v in params.items()}
+
+    def act(self, params, obs):
+        return np.asarray(obs)[:, 0] * params["scale"][0]
+
+
+def _get(url: str, timeout: float = 10.0):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_gateway_healthz_surfaces_fleet_membership(tmp_path):
+    """/healthz of a --distributed gateway carries rank/world/per-peer
+    mailbox ages, and a stale peer degrades the endpoint to 503 — the
+    LB fronting the fleet sees the partition, not just dead members."""
+    from actor_critic_tpu import serving
+
+    mailbox = str(tmp_path)
+    write_params(mailbox, 1, 7, _tree())
+    store = serving.PolicyStore()
+    store.register("default", _StubEngine(), {"scale": np.ones(1)})
+    fleet = FleetMonitor(mailbox, rank=0, world=2, stale_after_s=60.0)
+    gw = serving.ServeGateway(store, port=0, fleet=fleet)
+    try:
+        status, body = _get(gw.url + "/healthz")
+        assert status == 200
+        assert body["fleet"]["rank"] == 0
+        assert body["fleet"]["world"] == 2
+        peer = body["fleet"]["peers"]["1"]
+        assert peer["published"] and peer["version"] == 7
+        assert peer["age_s"] is not None
+        # the peer's mailbox ages past the bound -> fleet degraded
+        old = time.time() - 3600.0
+        os.utime(params_file(mailbox, 1), (old, old))
+        status, body = _get(gw.url + "/healthz")
+        assert status == 503
+        assert body["status"] == "stalled"
+        assert body["fleet"]["stale"] == [1]
+    finally:
+        gw.close()
+
+
+def test_gateway_without_fleet_has_no_fleet_block():
+    from actor_critic_tpu import serving
+
+    store = serving.PolicyStore()
+    store.register("default", _StubEngine(), {"scale": np.ones(1)})
+    gw = serving.ServeGateway(store, port=0)
+    try:
+        status, body = _get(gw.url + "/healthz")
+        assert status == 200
+        assert "fleet" not in body
+    finally:
+        gw.close()
+
+
+# ---------------------------------------------------------------------------
+# the CLI contract tier-1 relies on
+# ---------------------------------------------------------------------------
+
+
+def _load_cli():
+    import importlib.util
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "fleetsan_cli", os.path.join(repo, "scripts", "fleetsan.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_cli_quick_profile_exits_zero(capsys):
+    cli = _load_cli()
+    assert cli.main(["--schedules", "4"]) == 0
+    assert "clean" in capsys.readouterr().out
+
+
+def test_cli_reverted_writer_exits_one(capsys):
+    cli = _load_cli()
+    assert cli.main(
+        ["--scenario", "fleet", "--writer", "direct", "--schedules", "2"]
+    ) == 1
+    assert "VIOLATION" in capsys.readouterr().err
+
+
+def test_cli_naive_poller_exits_one(capsys):
+    cli = _load_cli()
+    assert cli.main(
+        ["--scenario", "gateway", "--poller", "naive", "--schedules", "2"]
+    ) == 1
+    assert "VIOLATION" in capsys.readouterr().err

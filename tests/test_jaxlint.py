@@ -42,6 +42,9 @@ PAIRS = [
     ("lock-discipline", "lock_discipline"),
     ("publish-aliasing", "publish_aliasing"),
     ("check-then-act", "check_then_act"),
+    ("collective-discipline", "collective_discipline"),
+    ("mailbox-protocol", "mailbox_protocol"),
+    ("rank-affinity", "rank_affinity"),
 ]
 
 
@@ -427,7 +430,7 @@ def test_malformed_baseline_is_a_crash_not_a_clean_run(tmp_path):
 # ---------------------------------------------------------------------------
 
 
-def test_cli_list_checks_names_all_nine(capsys):
+def test_cli_list_checks_names_all_twelve(capsys):
     cli = _load_cli()
     assert cli.main(["--list-checks"]) == 0
     out = capsys.readouterr().out
@@ -435,6 +438,7 @@ def test_cli_list_checks_names_all_nine(capsys):
         "donation-aliasing", "tracer-leak", "prng-reuse",
         "recompile-hazard", "host-sync", "warmup-registry",
         "lock-discipline", "publish-aliasing", "check-then-act",
+        "collective-discipline", "mailbox-protocol", "rank-affinity",
     ):
         assert name in out
 
@@ -714,4 +718,99 @@ def test_pr6_copy_on_transfer_revert_trips_publish_aliasing(tmp_path):
             checks=["publish-aliasing"],
         )
         == []
+    )
+
+
+# ---------------------------------------------------------------------------
+# the PR 12 protocol bugs reproduce as findings (ISSUE 12 acceptance)
+# ---------------------------------------------------------------------------
+
+# multihost.read_params as it was BEFORE the PR 12 torn-read fix: the
+# handler tuple misses zipfile.BadZipFile/EOFError, so the first torn
+# snapshot (SIGKILL mid-publish on a non-atomic writer, fs hiccup)
+# kills the mailbox writer thread. Reverting the fix must trip
+# mailbox-protocol.
+_PRE_FIX_READER = (
+    "import os\n"
+    "import numpy as np\n"
+    "def params_file(mailbox_dir, rank):\n"
+    "    return os.path.join(mailbox_dir, f'host{rank}', 'params.npz')\n"
+    "def read_params(mailbox_dir, rank):\n"
+    "    path = params_file(mailbox_dir, rank)\n"
+    "    try:\n"
+    "        with np.load(path) as z:\n"
+    "            return {k: z[k] for k in z.files}\n"
+    "    except (OSError, KeyError, ValueError):\n"
+    "        return None\n"
+)
+
+
+def test_pr12_torn_reader_revert_trips_mailbox_protocol(tmp_path):
+    flagged = _run_snippet(tmp_path, _PRE_FIX_READER)
+    assert [f.check for f in flagged] == ["mailbox-protocol"]
+    assert "BadZipFile" in flagged[0].message
+    # the fixed multihost.py sweeps clean
+    assert (
+        analysis.analyze_paths(
+            ["actor_critic_tpu/parallel/multihost.py"],
+            str(REPO),
+            checks=["mailbox-protocol"],
+        )
+        == []
+    )
+
+
+# train.py's --distributed telemetry wiring as it was BEFORE the PR 12
+# rank-affinity fix: every host hands the SAME --telemetry-dir and
+# metrics path to its session/logger — N hosts interleave one jsonl.
+_PRE_FIX_TELEMETRY = (
+    "class TelemetrySession:\n"
+    "    def __init__(self, directory, **kw):\n"
+    "        self.directory = directory\n"
+    "class JsonlLogger:\n"
+    "    def __init__(self, path, **kw):\n"
+    "        self.path = path\n"
+    "def main(args):\n"
+    "    if args.distributed:\n"
+    "        pass  # ranks join the fleet here\n"
+    "    session = TelemetrySession(args.telemetry_dir)\n"
+    "    logger = JsonlLogger(args.metrics)\n"
+    "    return session, logger\n"
+)
+
+
+def test_pr12_telemetry_clobber_revert_trips_rank_affinity(tmp_path):
+    flagged = _run_snippet(tmp_path, _PRE_FIX_TELEMETRY)
+    assert {f.check for f in flagged} == {"rank-affinity"}
+    assert len(flagged) == 2  # the session AND the logger
+    # the fixed train.py (host<rank>-suffixed paths) sweeps clean
+    assert (
+        analysis.analyze_paths(
+            ["train.py"], str(REPO), checks=["rank-affinity"]
+        )
+        == []
+    )
+
+
+# The PR 9 review bug as a snippet: a GLOBAL newest-seen version clock
+# across peers permanently mutes every host slower than the fastest.
+_GLOBAL_CLOCK_POLL = (
+    "def poll(mailbox, schedule):\n"
+    "    newest = -1\n"
+    "    for peer in schedule:\n"
+    "        out = mailbox.read(peer)\n"
+    "        if out is None:\n"
+    "            continue\n"
+    "        version, params = out\n"
+    "        if version > newest:\n"
+    "            newest = version\n"
+    "            mailbox.deposit(params, version, peer)\n"
+)
+
+
+def test_global_version_clock_trips_mailbox_protocol(tmp_path):
+    flagged = _run_snippet(tmp_path, _GLOBAL_CLOCK_POLL)
+    assert [f.check for f in flagged] == ["mailbox-protocol"]
+    assert "per-peer" in flagged[0].message.lower() or (
+        "PER RANK" in flagged[0].message
     )

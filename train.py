@@ -21,6 +21,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import os
 import sys
 import time
 
@@ -995,6 +996,19 @@ def main(argv=None) -> int:
             distributed_init(
                 args.coordinator, args.num_processes, args.process_id
             )
+        # Rank affinity for the shared artifact paths: every host of
+        # the fleet runs this same main() with the same flags, so an
+        # unsuffixed --telemetry-dir/--metrics would interleave N
+        # hosts' appends into ONE spans.jsonl/metrics.jsonl (torn lines
+        # on a shared filesystem; scrambled rows even locally). Same
+        # host<rank>/ convention as scripts/launch_multihost.py.
+        rank = args.process_id
+        if args.telemetry_dir:
+            args.telemetry_dir = os.path.join(
+                args.telemetry_dir, f"host{rank}"
+            )
+        root, ext = os.path.splitext(args.metrics)
+        args.metrics = f"{root}.host{rank}{ext}"
 
     print(
         f"algo={preset.algo} env={preset.env} iterations={args.iterations} "
