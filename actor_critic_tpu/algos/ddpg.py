@@ -490,6 +490,23 @@ def make_host_ingest_update(action_dim: int, cfg: DDPGConfig):
     return ingest_update
 
 
+def make_device_ingest_update(
+    action_dim: int, cfg: DDPGConfig, ring_codecs: dict
+):
+    """Device-data-plane ingest (ISSUE 13): the staged block is
+    gathered + decoded from the HBM trajectory ring INSIDE the jitted
+    program before the replay scatter and update loop — zero
+    host→device transfers per consumed block. The update-gate floor is
+    the host path's max(batch_size, nstep) (n-step windows must never
+    clamp into zero-initialized ring slots)."""
+    from actor_critic_tpu.data_plane import device_replay
+
+    return device_replay.make_device_ingest_update(
+        make_update_loop, action_dim, cfg, ring_codecs,
+        min_size=max(cfg.batch_size, cfg.nstep),
+    )
+
+
 def make_greedy_act(action_dim: int, cfg: DDPGConfig):
     """Noiseless actor for host eval (host_loop.host_evaluate)."""
     actor, _ = _modules(action_dim, cfg)
@@ -556,13 +573,18 @@ def train_host_async(
     eval_steps: int = 1000,
     queue_depth: int = 4,
     max_staleness: Optional[int] = None,
+    data_plane: str = "host",
+    plane_codec: str = "fp32",
+    transfer_pad_s: float = 0.0,
 ):
     """DDPG/TD3 with decoupled actor services (ISSUE 9 satellite; the
     PPO-only restriction of `--async-actors` lifted): one exploration
     thread per pool pushes [K, E_a] transition blocks through the
     bounded trajectory queue; the learner ingests each into the replay
     ring and updates — replay absorbs the behavior staleness natively,
-    so there is no correction knob here. Returns (learner, history)."""
+    so there is no correction knob here. `data_plane="device"` stages
+    the blocks encoded in HBM instead (ISSUE 13; see
+    host_loop.off_policy_train_host_async). Returns (learner, history)."""
     from actor_critic_tpu.algos.host_loop import off_policy_train_host_async
     from actor_critic_tpu.models.host_actor import (
         make_ddpg_host_explore,
@@ -578,6 +600,9 @@ def train_host_async(
         seed=seed, log_every=log_every, log_fn=log_fn,
         eval_every=eval_every, eval_envs=eval_envs, eval_steps=eval_steps,
         queue_depth=queue_depth, max_staleness=max_staleness,
+        data_plane=data_plane, plane_codec=plane_codec,
+        transfer_pad_s=transfer_pad_s,
+        make_device_ingest_update=make_device_ingest_update,
     )
 
 

@@ -401,10 +401,13 @@ def async_host_ckpt_state(pools, **device_state) -> dict:
 
 def async_host_maybe_save(
     ckpt, it: int, save_every: int, num_iterations: int, pools,
-    metrics: dict, **device_state,
+    metrics: dict, data_plane: str = "host", **device_state,
 ) -> None:
     """Async-driver twin of `host_maybe_save` over the whole actor
-    fleet's pools (`it` is 1-based consumed-block count)."""
+    fleet's pools (`it` is 1-based consumed-block count). Device-plane
+    runs (ISSUE 13) additionally carry the trajectory ring's quantizer
+    stats in `device_state["ring_quant"]` — the stripped-ring contract:
+    storage is transient collection data and never saved."""
     if ckpt is None or not should_save(it, save_every, num_iterations):
         return
     import jax
@@ -420,6 +423,11 @@ def async_host_maybe_save(
             # so the fleet size must match (async_host_resume checks
             # this BEFORE orbax's opaque structure-mismatch error).
             "_async_actors": float(len(pools)),
+            # Same guard for the data plane: a device-plane checkpoint
+            # carries a ring_quant leaf the host plane's template lacks
+            # (and vice versa) — fail with advice, not an orbax
+            # structure error. 1.0 = device.
+            "_data_plane_device": float(data_plane == "device"),
         }
         ckpt.save(
             it, async_host_ckpt_state(pools, **device_state),
@@ -427,12 +435,15 @@ def async_host_maybe_save(
         )
 
 
-def async_host_resume(ckpt, template: dict, pools) -> tuple[Optional[dict], int]:
+def async_host_resume(
+    ckpt, template: dict, pools, data_plane: str = "host",
+) -> tuple[Optional[dict], int]:
     """Restore the latest async checkpoint and push every actor pool's
     normalizer state back; (None, 0) when nothing is saved yet. The
     saved tree must carry the same number of pool states as the resuming
     fleet (`--async-actors` must not change across a resume — each
-    pool's stats belong to its own actor's env shard)."""
+    pool's stats belong to its own actor's env shard), and the data
+    plane must match the checkpoint's (the save trees differ)."""
     step = ckpt.latest_step()
     if step is None:
         return None, 0
@@ -443,6 +454,19 @@ def async_host_resume(ckpt, template: dict, pools) -> tuple[Optional[dict], int]
             f"checkpoint carries {int(saved_actors)} actor-pool states "
             f"but this run has {len(pools)} actors — resume with the "
             "original --async-actors count"
+        )
+    # Missing key = a checkpoint that predates the flag, which can only
+    # be host-plane (the device plane shipped with the flag) — default
+    # to 0.0 so a --data-plane device resume of a legacy checkpoint
+    # gets THIS advice, not orbax's opaque structure-mismatch error.
+    saved_plane = saved_metrics.get("_data_plane_device", 0.0)
+    if bool(saved_plane) != (data_plane == "device"):
+        saved_name = "device" if saved_plane else "host"
+        raise ValueError(
+            f"checkpoint was written by a --data-plane {saved_name} run "
+            f"but this run uses --data-plane {data_plane} — the save "
+            "trees differ (the device plane checkpoints its ring's "
+            "quantizer stats); resume with the original flag"
         )
     restored = ckpt.restore(template, step)
     saved_pools = restored["pools"]
@@ -730,6 +754,10 @@ def off_policy_train_host_async(
     eval_steps: int = 1000,
     queue_depth: int = 4,
     max_staleness: Optional[int] = None,
+    data_plane: str = "host",
+    plane_codec: str = "fp32",
+    transfer_pad_s: float = 0.0,
+    make_device_ingest_update: Optional[Callable] = None,
 ):
     """Async actor–learner loop for the off-policy trainers (DDPG/TD3,
     SAC) — the ROADMAP item PR 6 left open: replay absorbs behavior-
@@ -753,6 +781,15 @@ def off_policy_train_host_async(
     consumed. Checkpointing is not wired for this mode (per-actor pools
     carry independent normalizer state; the PPO async driver grew the
     multi-pool save tree first — see ppo.train_host_async).
+
+    `data_plane="device"` (ISSUE 13): actors stage encoded blocks in
+    the HBM `data_plane.DeviceTrajRing` (codec per `plane_codec`) and
+    `make_device_ingest_update(action_dim, cfg, ring_codecs)` — the
+    per-algo factory ddpg/sac pass — builds the jitted program that
+    gathers + decodes the slot, scatters it into the replay ring, and
+    updates, with zero host→device transfers per consumed block.
+    `transfer_pad_s` is the tunnel-wall testbed pad (ppo.train_host_async
+    docstring).
 
     Returns (learner, history).
     """
@@ -783,8 +820,17 @@ def off_policy_train_host_async(
             "async actor–learner mode needs the numpy actor mirror "
             "(MLP torso; models/host_actor.py)"
         )
+    if data_plane not in ("host", "device"):
+        raise ValueError(
+            f"data_plane must be 'host' or 'device', got {data_plane!r}"
+        )
+    use_device_plane = data_plane == "device"
+    if use_device_plane and make_device_ingest_update is None:
+        raise ValueError(
+            "data_plane='device' needs the algo's make_device_ingest_update "
+            "factory (ddpg/sac pass it through train_host_async)"
+        )
     host_explore = make_host_explore(spec, cfg)
-    ingest_update = make_ingest_update(spec.action_dim, cfg)
 
     def actor_act_factory(actor_id: int):
         # Per-actor step counter, read/written only on that actor's
@@ -805,10 +851,27 @@ def off_policy_train_host_async(
 
         return make_act_fn
 
-    queue = TrajQueue(
-        depth=queue_depth, max_staleness=max_staleness,
-        policy="drop_oldest",
-    )
+    if use_device_plane:
+        from actor_critic_tpu.data_plane import device_replay
+        from actor_critic_tpu.data_plane import ring as dp_ring
+
+        queue = dp_ring.DeviceTrajRing(
+            depth=queue_depth,
+            block_spec=device_replay.offpolicy_block_spec(spec, cfg, A),
+            codec=plane_codec,
+            max_staleness=max_staleness,
+            policy="drop_oldest",
+            transfer_pad_s=transfer_pad_s,
+        )
+        ingest_update = make_device_ingest_update(
+            spec.action_dim, cfg, queue.codecs
+        )
+    else:
+        queue = TrajQueue(
+            depth=queue_depth, max_staleness=max_staleness,
+            policy="drop_oldest",
+        )
+        ingest_update = make_ingest_update(spec.action_dim, cfg)
     publisher = PolicyPublisher(np_params, version=0)
     stop = threading.Event()
     actors = [
@@ -852,22 +915,43 @@ def off_policy_train_host_async(
                 )
                 staleness = max(it - block.version, 0)
                 env_steps = sum(a.steps_collected for a in actors)
-                with telemetry.span("host_to_device"):
-                    # jnp.array, NOT asarray: the transfer must snapshot
-                    # the slot before release (the PR 6 contract).
-                    traj = OffPolicyTransition(
-                        obs=jnp.array(block.arrays["obs"]),
-                        action=jnp.array(block.arrays["action"]),
-                        reward=jnp.array(block.arrays["reward"]),
-                        next_obs=jnp.array(block.arrays["final_obs"]),
-                        terminated=jnp.array(block.arrays["terminated"]),
-                        done=jnp.array(block.arrays["done"]),
-                    )
-                queue.release(block)
-                with telemetry.span("update", dispatch="async"):
-                    learner, metrics = ingest_update(
-                        learner, traj, jnp.asarray(env_steps, jnp.int32)
-                    )
+                if use_device_plane:
+                    # Zero-transfer consume (ISSUE 13): the staged block
+                    # already lives in HBM; the jitted ingest gathers +
+                    # decodes it and scatters into the replay ring in
+                    # one program — only the slot index crosses.
+                    telemetry.instant("host_to_device", device_plane=True)
+                    slot = np.int32(block.slot)
+                    steps = jnp.asarray(env_steps, jnp.int32)
+                    with telemetry.span("update", dispatch="async"):
+                        learner, metrics = queue.run(
+                            lambda state: ingest_update(
+                                learner, state, slot, steps
+                            )
+                        )
+                    # After the dispatch: device execution order now
+                    # reads the slot before any later overwrite.
+                    queue.release(block)
+                else:
+                    with telemetry.span("host_to_device"):
+                        if transfer_pad_s > 0:
+                            time.sleep(transfer_pad_s)  # tunnel testbed
+                        # jnp.array, NOT asarray: the transfer must
+                        # snapshot the slot before release (the PR 6
+                        # contract).
+                        traj = OffPolicyTransition(
+                            obs=jnp.array(block.arrays["obs"]),
+                            action=jnp.array(block.arrays["action"]),
+                            reward=jnp.array(block.arrays["reward"]),
+                            next_obs=jnp.array(block.arrays["final_obs"]),
+                            terminated=jnp.array(block.arrays["terminated"]),
+                            done=jnp.array(block.arrays["done"]),
+                        )
+                    queue.release(block)
+                    with telemetry.span("update", dispatch="async"):
+                        learner, metrics = ingest_update(
+                            learner, traj, jnp.asarray(env_steps, jnp.int32)
+                        )
                 qs = queue.stats()
                 extra = {
                     "env_steps": env_steps,

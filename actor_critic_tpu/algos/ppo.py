@@ -291,25 +291,17 @@ def make_policy_step(env_spec, cfg: PPOConfig):
     return policy_step
 
 
-def make_host_update_step(env_spec, cfg: PPOConfig, can_truncate: bool = True):
-    """Jitted per-iteration update for host-collected trajectories.
-
-    Takes time-major [T, E] arrays (one host→device transfer per
-    iteration — SURVEY §3.1 boundary fix), computes truncation-aware GAE
-    on-device, and runs the in-jit epoch/minibatch PPO update.
-
-    `final_values`/`bootstrap_value` may be supplied externally (overlap
-    mode computes them with the host mirror so EVERY value estimate in
-    the GAE — per-step, truncation-bootstrap, and rollout bootstrap —
-    comes from the same stale behavior params; passing None recomputes
-    them in-jit with the current params, correct for the synchronous
-    path where behavior == current).
-    """
+def make_host_update_fn(env_spec, cfg: PPOConfig, can_truncate: bool = True):
+    """The UNJITTED per-iteration update body behind
+    `make_host_update_step` — factored out (ISSUE 13) so the device
+    data plane can inline it after its in-jit ring gather+decode
+    (`make_device_update_step` with correction="none") and stay
+    bit-identical to the lockstep program: one body, two dispatch
+    wrappers, zero drift surface."""
     net = make_network(env_spec, cfg)
     opt = make_optimizer(cfg)
     apply_fn = net.apply
 
-    @jax.jit
     def update(
         params, opt_state, obs, action, log_prob, value, reward, done,
         terminated, final_obs, last_obs, key,
@@ -345,6 +337,111 @@ def make_host_update_step(env_spec, cfg: PPOConfig, can_truncate: bool = True):
         )
 
     return update
+
+
+def make_host_update_step(env_spec, cfg: PPOConfig, can_truncate: bool = True):
+    """Jitted per-iteration update for host-collected trajectories.
+
+    Takes time-major [T, E] arrays (one host→device transfer per
+    iteration — SURVEY §3.1 boundary fix), computes truncation-aware GAE
+    on-device, and runs the in-jit epoch/minibatch PPO update.
+
+    `final_values`/`bootstrap_value` may be supplied externally (overlap
+    mode computes them with the host mirror so EVERY value estimate in
+    the GAE — per-step, truncation-bootstrap, and rollout bootstrap —
+    comes from the same stale behavior params; passing None recomputes
+    them in-jit with the current params, correct for the synchronous
+    path where behavior == current).
+    """
+    return jax.jit(make_host_update_fn(env_spec, cfg, can_truncate))
+
+
+def async_block_spec(
+    spec, cfg: PPOConfig, actors: int, correction: str = "vtrace"
+) -> dict:
+    """dict[name → jax.ShapeDtypeStruct] of the [T, E_a] block an async
+    ActorService pushes (E_a = num_envs // actors; actions are int64 —
+    async acting is always the numpy mirror). The device trajectory
+    ring's storage spec (`data_plane/ring.py`), shared by the drivers
+    and the warmup planners so their signatures can never drift.
+    `correction="none"` blocks additionally carry the mirror-computed
+    `final_values`/`bootstrap_value` (the `block_extras` contract)."""
+    import numpy as np
+
+    actors = max(int(actors), 1)
+    T = cfg.rollout_steps
+    E = cfg.num_envs // actors
+    s = _compile_cache.array_struct
+
+    def obs_s(lead):
+        return s((*lead, *spec.obs_shape), spec.obs_dtype)
+
+    if spec.discrete:
+        action = s((T, E), np.int64)  # mirror samples with np.argmax
+    else:
+        action = s((T, E, spec.action_dim), np.float32)
+    out = {
+        "obs": obs_s((T, E)),
+        "action": action,
+        "log_prob": s((T, E), np.float32),
+        "value": s((T, E), np.float32),
+        "reward": s((T, E), np.float32),
+        "done": s((T, E), np.float32),
+        "terminated": s((T, E), np.float32),
+        "final_obs": obs_s((T, E)),
+        "last_obs": obs_s((E,)),
+    }
+    if correction == "none":
+        out["final_values"] = s((T, E), np.float32)
+        out["bootstrap_value"] = s((E,), np.float32)
+    return out
+
+
+def make_device_update_step(
+    env_spec,
+    cfg: PPOConfig,
+    ring_codecs: dict,
+    can_truncate: bool = True,
+    correction: str = "vtrace",
+    rho_bar: float = 1.0,
+    c_bar: float = 1.0,
+):
+    """Device-data-plane learner program (ISSUE 13): ONE jitted dispatch
+    gathers the consumed slot from the HBM trajectory ring, decodes it
+    through the ring's codecs, and runs the update — the V-trace
+    correction itself is `make_async_update_fn`'s body unchanged, and
+    `correction="none"` inlines `make_host_update_fn`'s body, so with
+    the all-raw fp32 codec the program computes bit-for-bit what the
+    host plane's update computes (the depth-1 equivalence tests pin
+    this). Signature: `(params, opt_state, ring_state, slot, key,
+    progress=None)` — the slot index scalar is the ONLY thing the
+    learner transfers per consumed block."""
+    from actor_critic_tpu.data_plane import ring as dp_ring
+
+    if correction == "none":
+        body = make_host_update_fn(env_spec, cfg, can_truncate)
+    else:
+        body = make_async_update_fn(
+            env_spec, cfg, can_truncate, correction, rho_bar, c_bar
+        )
+
+    @jax.jit
+    def device_update(params, opt_state, ring_state, slot, key,
+                      progress=None):
+        b = dp_ring.gather_block(ring_state, slot, ring_codecs)
+        kwargs = {}
+        if correction == "none":
+            kwargs["final_values"] = b["final_values"]
+            kwargs["bootstrap_value"] = b["bootstrap_value"]
+        if progress is not None:
+            kwargs["progress"] = progress
+        return body(
+            params, opt_state, b["obs"], b["action"], b["log_prob"],
+            b["value"], b["reward"], b["done"], b["terminated"],
+            b["final_obs"], b["last_obs"], key, **kwargs,
+        )
+
+    return device_update
 
 
 def init_host_params(env_spec, cfg: PPOConfig, key: jax.Array):
@@ -684,6 +781,9 @@ def train_host_async(
     ckpt=None,
     save_every: int = 0,
     resume: bool = False,
+    data_plane: str = "host",
+    plane_codec: str = "fp32",
+    transfer_pad_s: float = 0.0,
 ):
     """Async actor–learner PPO on host env pools (ISSUE 6 tentpole).
 
@@ -710,9 +810,23 @@ def train_host_async(
     change across a resume. `strict_lockstep` is the test hook:
     with one actor, `queue_depth=1`, `updates_per_block=1` and
     `correction="none"` the run is bit-for-bit `train_host`
-    (tests/test_async_host.py). Returns (params, opt_state, history).
+    (tests/test_async_host.py).
+
+    `data_plane="device"` (ISSUE 13) swaps the host-numpy TrajQueue for
+    the HBM-resident `data_plane.DeviceTrajRing`: actors enqueue
+    encoded blocks (`plane_codec` ∈ fp32/f16/int8 — one small
+    host→device put at collection time, on the ACTOR thread), and the
+    learner's jitted program gathers + decodes the slot in-jit — zero
+    host→device transfers per consumed block. The fp32 codec at depth 1
+    with `correction="none"` stays bitwise-equal to the host plane.
+    `transfer_pad_s` is the tunnel-wall testbed knob (bench A/B): it
+    pads every block transfer — the learner-side `jnp.array` on the
+    host plane, the actor-side enqueue put on the device plane.
+
+    Returns (params, opt_state, history).
     """
     import threading
+    import time as _time
 
     import numpy as np
 
@@ -736,6 +850,11 @@ def train_host_async(
     spec, E_a = validate_pools(pools)
     if updates_per_block < 1:
         raise ValueError("updates_per_block must be >= 1")
+    if data_plane not in ("host", "device"):
+        raise ValueError(
+            f"data_plane must be 'host' or 'device', got {data_plane!r}"
+        )
+    use_device_plane = data_plane == "device"
 
     key = jax.random.key(seed)
     key, pkey = jax.random.split(key)
@@ -750,10 +869,31 @@ def train_host_async(
     host_policy = host_actor.make_ppo_host_policy(spec, cfg)
     host_value = host_actor.make_ppo_host_value(spec, cfg)
     host_greedy = host_actor.make_ppo_host_greedy(spec, cfg)
-    update = make_async_update_step(
-        spec, cfg, can_truncate=True, correction=correction,
-        rho_bar=rho_bar, c_bar=c_bar,
-    )
+    if use_device_plane:
+        from actor_critic_tpu.data_plane import ring as dp_ring
+
+        queue = dp_ring.DeviceTrajRing(
+            depth=queue_depth,
+            block_spec=async_block_spec(spec, cfg, len(pools), correction),
+            codec=plane_codec,
+            max_staleness=None if strict_lockstep else max_staleness,
+            policy="block" if strict_lockstep else "drop_oldest",
+            transfer_pad_s=transfer_pad_s,
+        )
+        update = make_device_update_step(
+            spec, cfg, queue.codecs, can_truncate=True,
+            correction=correction, rho_bar=rho_bar, c_bar=c_bar,
+        )
+    else:
+        queue = TrajQueue(
+            depth=queue_depth,
+            max_staleness=None if strict_lockstep else max_staleness,
+            policy="block" if strict_lockstep else "drop_oldest",
+        )
+        update = make_async_update_step(
+            spec, cfg, can_truncate=True, correction=correction,
+            rho_bar=rho_bar, c_bar=c_bar,
+        )
 
     def make_act_fn(actor_params, rng):
         def act(o):
@@ -783,21 +923,38 @@ def train_host_async(
 
     start_it = 0
     if ckpt is not None and resume:
-        template = async_host_ckpt_state(
-            pools, params=params, opt_state=opt_state, key=key
-        )
-        restored, start_it = async_host_resume(ckpt, template, pools)
-        if restored is not None:
-            params = restored["params"]
-            opt_state = restored["opt_state"]
-            key = restored["key"]
-            np_params = jax.device_get(params)
+        # The device plane's checkpoint carries the ring's quantizer
+        # stats ONLY (ring storage is transient collection data — the
+        # strip_replay contract taken to its limit); resume reattaches
+        # a fresh ring that re-encodes against the restored
+        # standardization.
+        try:
+            ring_extra = (
+                {"ring_quant": queue.quant_host()}
+                if use_device_plane else {}
+            )
+            template = async_host_ckpt_state(
+                pools, params=params, opt_state=opt_state, key=key,
+                **ring_extra,
+            )
+            restored, start_it = async_host_resume(
+                ckpt, template, pools, data_plane=data_plane
+            )
+            if restored is not None:
+                params = restored["params"]
+                opt_state = restored["opt_state"]
+                key = restored["key"]
+                np_params = jax.device_get(params)
+                if use_device_plane:
+                    queue.install_quant(restored["ring_quant"])
+        except BaseException:
+            # The queue now exists BEFORE resume (the ring's quant
+            # template comes from it); a resume failure must not leak
+            # its process-wide sampler gauge (and, for the device ring,
+            # the HBM storage its stats closure pins).
+            queue.close()
+            raise
 
-    queue = TrajQueue(
-        depth=queue_depth,
-        max_staleness=None if strict_lockstep else max_staleness,
-        policy="block" if strict_lockstep else "drop_oldest",
-    )
     publisher = PolicyPublisher(np_params, version=start_it)
     stop = threading.Event()
     actors = [
@@ -847,35 +1004,62 @@ def train_host_async(
                 # collected), fetched BEFORE the dispatch below.
                 publisher.publish(jax.device_get(params), version=it)
                 staleness = max(it - block.version, 0)
-                with telemetry.span("host_to_device"):
-                    # jnp.array, NOT asarray: the CPU backend may alias
-                    # numpy buffers zero-copy, and releasing the slot
-                    # below lets the next put() rewrite that memory
-                    # while the dispatched update still reads it — the
-                    # transfer must snapshot the block.
-                    arrays = {
-                        k: jnp.array(v) for k, v in block.arrays.items()
-                    }
-                queue.release(block)
                 kwargs = {}
-                if correction == "none":
-                    kwargs["final_values"] = arrays["final_values"]
-                    kwargs["bootstrap_value"] = arrays["bootstrap_value"]
                 if cfg.anneal_iters > 0:
                     kwargs["progress"] = jnp.asarray(
                         min(it / cfg.anneal_iters, 1.0), jnp.float32
                     )
-                with telemetry.span("update", dispatch="async"):
-                    for _ in range(updates_per_block):
-                        key, ukey = jax.random.split(key)
-                        params, opt_state, metrics = update(
-                            params, opt_state,
-                            arrays["obs"], arrays["action"],
-                            arrays["log_prob"], arrays["value"],
-                            arrays["reward"], arrays["done"],
-                            arrays["terminated"], arrays["final_obs"],
-                            arrays["last_obs"], ukey, **kwargs,
-                        )
+                if use_device_plane:
+                    # Zero-transfer consume: the block already lives in
+                    # HBM (the actor enqueued encoded bytes at
+                    # collection time); the learner ships only the slot
+                    # index and the update program gathers + decodes
+                    # in-jit. The phase instant keeps the trace's
+                    # host_to_device lane honest about the absence.
+                    telemetry.instant("host_to_device", device_plane=True)
+                    slot = np.int32(block.slot)
+                    with telemetry.span("update", dispatch="async"):
+                        for _ in range(updates_per_block):
+                            key, ukey = jax.random.split(key)
+                            params, opt_state, metrics = queue.run(
+                                lambda state: update(
+                                    params, opt_state, state, slot,
+                                    ukey, **kwargs,
+                                )
+                            )
+                    # Release AFTER the final dispatch against the slot:
+                    # dispatch order is device execution order, so any
+                    # later enqueue that overwrites it runs after the
+                    # gathers (ring.py donation discipline).
+                    queue.release(block)
+                else:
+                    with telemetry.span("host_to_device"):
+                        if transfer_pad_s > 0:
+                            _time.sleep(transfer_pad_s)  # tunnel testbed
+                        # jnp.array, NOT asarray: the CPU backend may
+                        # alias numpy buffers zero-copy, and releasing
+                        # the slot below lets the next put() rewrite
+                        # that memory while the dispatched update still
+                        # reads it — the transfer must snapshot the
+                        # block.
+                        arrays = {
+                            k: jnp.array(v) for k, v in block.arrays.items()
+                        }
+                    queue.release(block)
+                    if correction == "none":
+                        kwargs["final_values"] = arrays["final_values"]
+                        kwargs["bootstrap_value"] = arrays["bootstrap_value"]
+                    with telemetry.span("update", dispatch="async"):
+                        for _ in range(updates_per_block):
+                            key, ukey = jax.random.split(key)
+                            params, opt_state, metrics = update(
+                                params, opt_state,
+                                arrays["obs"], arrays["action"],
+                                arrays["log_prob"], arrays["value"],
+                                arrays["reward"], arrays["done"],
+                                arrays["terminated"], arrays["final_obs"],
+                                arrays["last_obs"], ukey, **kwargs,
+                            )
                 qs = queue.stats()
                 extra = {
                     "env_steps": sum(a.steps_collected for a in actors),
@@ -909,7 +1093,12 @@ def train_host_async(
                 )
                 async_host_maybe_save(
                     ckpt, it + 1, save_every, num_iterations, pools,
-                    metrics, params=params, opt_state=opt_state, key=key,
+                    metrics, data_plane=data_plane,
+                    params=params, opt_state=opt_state, key=key,
+                    **(
+                        {"ring_quant": queue.quant_host()}
+                        if use_device_plane else {}
+                    ),
                 )
         if ckpt is not None:
             ckpt.wait()  # the final async save must be durable
@@ -1008,7 +1197,12 @@ def _warmup_async_update(ctx):
     """The async learner's corrected-update program ([T, E_a] blocks) —
     registered so cold starts keep the PR 4 warm-path win and the
     steady-state compile-count regression test stays at zero."""
-    if ctx.fused or ctx.algo != "ppo" or not ctx.async_actors:
+    if (
+        ctx.fused or ctx.algo != "ppo" or not ctx.async_actors
+        or ctx.data_plane == "device"  # ISSUE 13: device plane runs
+        # ppo.make_device_update_step instead — same correction, but
+        # the block arrives via the in-jit ring gather, not arguments.
+    ):
         return None
     import numpy as np
 
@@ -1028,6 +1222,45 @@ def _warmup_async_update(ctx):
         ctx.spec, cfg, can_truncate=True, correction=ctx.async_correction
     )
     return lambda: _compile_cache.aot_compile(jitted, *args, **kwargs)
+
+
+@_compile_cache.register_warmup("ppo.make_device_update_step")
+def _warmup_device_update(ctx):
+    """The device-data-plane learner program (ISSUE 13): ring gather +
+    codec decode + corrected update in one executable — warmed so the
+    new plane keeps the steady-state-zero-recompile contract the host
+    plane's program has."""
+    if (
+        ctx.fused or ctx.algo != "ppo" or not ctx.async_actors
+        or ctx.data_plane != "device"
+    ):
+        return None
+    import numpy as np
+
+    from actor_critic_tpu.data_plane import codecs as np_codecs
+    from actor_critic_tpu.data_plane import ring as dp_ring
+
+    cfg = ctx.cfg
+    block_spec = async_block_spec(
+        ctx.spec, cfg, ctx.async_actors, ctx.async_correction
+    )
+    kinds = np_codecs.traj_codecs(ctx.plane_codec, block_spec)
+    state_abs = dp_ring.abstract_ring_state(
+        block_spec, ctx.queue_depth, kinds
+    )
+    params_abs, opt_abs = _abstract_host_params(ctx.spec, cfg)
+    kwargs = {}
+    if cfg.anneal_iters > 0:
+        kwargs["progress"] = _compile_cache.array_struct((), np.float32)
+    jitted = make_device_update_step(
+        ctx.spec, cfg, kinds, can_truncate=True,
+        correction=ctx.async_correction,
+    )
+    return lambda: _compile_cache.aot_compile(
+        jitted, params_abs, opt_abs, state_abs,
+        _compile_cache.scalar_struct(np.int32),
+        _compile_cache.key_struct(), **kwargs,
+    )
 
 
 @_compile_cache.register_warmup("ppo.make_greedy_act")

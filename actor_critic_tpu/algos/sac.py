@@ -406,6 +406,22 @@ def make_host_ingest_update(action_dim: int, cfg: SACConfig):
     return ingest_update
 
 
+def make_device_ingest_update(
+    action_dim: int, cfg: SACConfig, ring_codecs: dict
+):
+    """Device-data-plane ingest (ISSUE 13): in-jit ring gather + decode
+    ahead of the replay scatter and update loop — zero host→device
+    transfers per consumed block (ddpg.make_device_ingest_update
+    docstring; SAC's update gate is batch_size, it has no n-step
+    window)."""
+    from actor_critic_tpu.data_plane import device_replay
+
+    return device_replay.make_device_ingest_update(
+        make_update_loop, action_dim, cfg, ring_codecs,
+        min_size=cfg.batch_size,
+    )
+
+
 def make_greedy_act(action_dim: int, cfg: SACConfig):
     """Tanh-mean actor for host eval (host_loop.host_evaluate)."""
     actor, _ = _modules(action_dim, cfg)
@@ -470,10 +486,14 @@ def train_host_async(
     eval_steps: int = 1000,
     queue_depth: int = 4,
     max_staleness: Optional[int] = None,
+    data_plane: str = "host",
+    plane_codec: str = "fp32",
+    transfer_pad_s: float = 0.0,
 ):
     """SAC with decoupled actor services (ISSUE 9 satellite; mirrors
     ddpg.train_host_async — replay absorbs behavior staleness, only the
-    ingest hand-off is wired through the queue). Returns
+    ingest hand-off is wired through the queue; `data_plane="device"`
+    stages blocks encoded in HBM, ISSUE 13). Returns
     (learner, history)."""
     from actor_critic_tpu.algos.host_loop import off_policy_train_host_async
     from actor_critic_tpu.models.host_actor import (
@@ -490,6 +510,9 @@ def train_host_async(
         seed=seed, log_every=log_every, log_fn=log_fn,
         eval_every=eval_every, eval_envs=eval_envs, eval_steps=eval_steps,
         queue_depth=queue_depth, max_staleness=max_staleness,
+        data_plane=data_plane, plane_codec=plane_codec,
+        transfer_pad_s=transfer_pad_s,
+        make_device_ingest_update=make_device_ingest_update,
     )
 
 

@@ -825,6 +825,170 @@ def exercise_batcher(
     return report
 
 
+def attach_ring_poisoner(ring: Any) -> Any:
+    """Leased-slot write tripwire for the DEVICE trajectory ring
+    (ISSUE 13; `data_plane/ring.py`). The ring's blocks live in HBM, so
+    the numpy `writeable=False` freeze cannot reach them — but every
+    overwrite passes through exactly one choke point, the slot claim:
+    wrap `_claim_slot_locked` so a put that claims a slot the learner
+    still holds LEASED crashes at the claim site. The correct ring
+    never trips it (leased slots are excluded from free/reclaim by
+    construction); the `buggy_writer` revert in `exercise_device_ring`
+    — drop-oldest reclaiming the lease like a pending block — trips it
+    on every schedule where the writer meets a held lease."""
+    orig = ring._claim_slot_locked
+
+    def claim():
+        slot = orig()
+        if slot is not None and slot in ring._leased:
+            raise RacesanError(
+                f"device-ring enqueue claimed LEASED slot {slot} — the "
+                "learner's in-flight gather would read the overwrite "
+                "(write-after-publish, device-plane class)"
+            )
+        return slot
+
+    ring._claim_slot_locked = claim
+    return ring
+
+
+def exercise_device_ring(
+    seed: int,
+    producers: int = 2,
+    blocks_per_producer: int = 3,
+    depth: int = 2,
+    poison: bool = True,
+    consumer: str = "leased",
+    buggy_writer: bool = False,
+    timeout_s: float = 30.0,
+) -> dict:
+    """One seeded schedule over the REAL `DeviceTrajRing`: producer
+    threads enqueue uniform-fill blocks (encoded host-side, scattered
+    into HBM by the donated enqueue program), a consumer leases slots,
+    gathers them back off the device, and verifies each block is the
+    uniform fill its lease's version promises — actor-enqueue vs
+    learner-gather interleavings, scheduled one thread at a time.
+
+    `consumer="released"` reproduces the alias-class bug: the consumer
+    RELEASES the slot before reading it, so a drop-oldest overwrite of
+    the freed slot lands under its read — caught by the value check on
+    schedules where the writer runs inside the window.
+    `buggy_writer=True` reverts the lease protection (drop-oldest may
+    reclaim a LEASED slot, as if it were merely pending) — the
+    poisoner's claim-site check catches it on every schedule where a
+    full ring meets a held lease. NB: dispatches real jitted programs;
+    first call per process pays one enqueue compile."""
+    import jax
+
+    from actor_critic_tpu.data_plane import ring as dp_ring
+
+    if consumer not in ("leased", "released"):
+        raise ValueError(f"unknown consumer mode {consumer!r}")
+    if buggy_writer:
+        # Depth 1 makes the hazard unconditional: while the consumer
+        # holds the single slot's lease, EVERY producer put finds free
+        # and pending empty, and the reverted claim reaches for the
+        # leased slot — the poisoner then fires on every schedule
+        # instead of only those where drop-oldest pressure lines up.
+        depth = 1
+    block_spec = {"x": jax.ShapeDtypeStruct((2, 2), np.float32)}
+    ring = dp_ring.DeviceTrajRing(
+        depth=depth, block_spec=block_spec, codec="fp32",
+        policy="drop_oldest", register_gauge=False,
+    )
+    if buggy_writer:
+        # Reverted lease protection: treat a leased slot like a pending
+        # one — the pre-ISSUE 13 hazard the poisoner exists to catch.
+        orig_claim = ring._claim_slot_locked
+
+        def claim_ignoring_leases():
+            slot = orig_claim()
+            if slot is None and ring._leased:
+                slot = next(iter(sorted(ring._leased)))
+                ring._drops_full += 1
+            return slot
+
+        ring._claim_slot_locked = claim_ignoring_leases
+    sched = CoopScheduler(seed)
+    sched.trace_locks(ring, "_cv")
+    if poison:
+        attach_ring_poisoner(ring)
+    report = {
+        "seed": seed, "consumed": 0, "race_detected": False,
+        "consumer": consumer,
+    }
+    done = {"producers": 0}
+    expect = {
+        float(_fill_value(p, b))
+        for p in range(producers)
+        for b in range(blocks_per_producer)
+    }
+
+    def producer(p: int) -> None:
+        buf = np.zeros((2, 2), np.float32)
+        payload = {"x": buf}
+        for b in range(blocks_per_producer):
+            fill = _fill_value(p, b)
+            buf.fill(fill)
+            sched.yield_point("filled")
+            while True:
+                # jaxlint: disable=publish-aliasing (deliberate buffer
+                # reuse: DeviceTrajRing.put ENCODES — copies — the
+                # arrays host-side before the device put, so reusing
+                # the fill buffer is the producer contract under test)
+                if ring.put(payload, int(fill), p, timeout=0):
+                    break
+                sched.yield_point("put-retry")
+        done["producers"] += 1  # serialized by the scheduler
+
+    def consume() -> None:
+        total = producers * blocks_per_producer
+        while True:
+            all_done = done["producers"] >= producers
+            lease = ring.get(timeout=0)
+            if lease is None:
+                if all_done and len(ring) == 0:
+                    return
+                sched.yield_point("idle")
+                continue
+            if consumer == "released":
+                # The bug: the slot re-enters the writable pool while
+                # this thread still intends to read it.
+                ring.release(lease)
+                sched.yield_point("post-release")
+            x = np.asarray(
+                ring.run(lambda state: state.storage["x"][lease.slot])
+            )
+            uniform = bool(np.all(x == x.flat[0]))
+            value = float(x.flat[0])
+            if not uniform or value != float(lease.version) or (
+                value not in expect
+            ):
+                report["race_detected"] = True
+                raise RacesanError(
+                    f"device-ring block corrupted under seed {seed}: "
+                    f"uniform={uniform}, value={value!r}, lease version "
+                    f"{lease.version} — a slot was overwritten under a "
+                    "live read (device-plane zero-copy class)"
+                )
+            if consumer == "leased":
+                ring.release(lease)
+            report["consumed"] += 1
+            if report["consumed"] >= total:
+                return
+
+    for p in range(producers):
+        sched.spawn(f"producer-{p}", lambda p=p: producer(p))
+    sched.spawn("consumer", consume)
+    try:
+        sched.run(timeout_s=timeout_s)
+    finally:
+        report["produced"] = ring.stats()["puts"]
+        report["trace_len"] = len(sched.trace)
+        ring.close()
+    return report
+
+
 def exercise_sweep(
     seeds: Iterable[int],
     scenario: Callable[[int], dict],

@@ -35,6 +35,7 @@ SCAN_DIRS = (
     "actor_critic_tpu/algos",
     "actor_critic_tpu/models",
     "actor_critic_tpu/serving",  # gateway act programs (ISSUE 10)
+    "actor_critic_tpu/data_plane",  # device ring/replay programs (ISSUE 13)
 )
 _EXEMPT_HOME = "actor_critic_tpu/utils/compile_cache.py"
 
@@ -84,6 +85,7 @@ def load_registry() -> tuple[set[str], dict[str, str]]:
     actor_critic_tpu.config pulls in every algo module, whose
     register_warmup calls run as import side effects."""
     import actor_critic_tpu.config  # noqa: F401 — registration side effect
+    import actor_critic_tpu.data_plane  # noqa: F401 — device-plane planners
     import actor_critic_tpu.serving  # noqa: F401 — serving-side planners
     from actor_critic_tpu.utils import compile_cache
 

@@ -1,0 +1,33 @@
+"""Device-resident data plane (ISSUE 13): trajectory and replay data
+live in HBM end to end, so steady-state learner consumption performs
+zero host→device transfers.
+
+- `data_plane.ring` — the donated device trajectory ring
+  (`DeviceTrajRing`): actors enqueue host-encoded int8/f16 blocks, the
+  learner gathers + decodes inside its jitted update program.
+- `data_plane.device_replay` — the off-policy twin: staged blocks feed
+  the donated replay ring inside one jitted ingest+update program, plus
+  the R2D2-style burn-in/train sequence consumer over
+  `replay.sample_sequences`.
+- `data_plane.codecs` — the host-side numpy mirror of the
+  `replay/quantize.py` calibrate-then-freeze codecs (actors encode
+  without touching the device) and the per-key trajectory codec specs.
+
+Wiring: `train.py --data-plane {host,device}` on the async drivers
+(`--async-actors`); README "Device data plane" covers when device beats
+host and the codec trade-offs.
+"""
+
+from actor_critic_tpu.data_plane.codecs import (  # noqa: F401
+    TRAJ_MODES,
+    traj_codecs,
+)
+from actor_critic_tpu.data_plane.ring import (  # noqa: F401
+    DeviceTrajRing,
+    RingLease,
+    RingState,
+    gather_block,
+    init_ring,
+    make_enqueue,
+)
+from actor_critic_tpu.data_plane import device_replay  # noqa: F401

@@ -1,0 +1,209 @@
+"""Device replay plane for the off-policy trainers (ISSUE 13 tentpole,
+part 2).
+
+The quantized `ReplayState` ring already lives donated in HBM with
+`add_batch`/`sample`/`sample_sequences` fused into the DDPG/TD3/SAC
+update programs — but the ASYNC actor–learner drivers still hand each
+consumed transition block to the learner as host numpy, paying one
+host→device transfer per update cycle on the learner thread. This
+module closes that gap: actors stage encoded blocks into a
+`data_plane.ring.DeviceTrajRing`, and ONE jitted program per consumed
+block gathers + decodes the staged slot, scatters it into the replay
+ring, and runs the whole update loop — the learner performs zero
+host→device transfers in steady state (only the slot index rides the
+dispatch), and the replay ring itself never leaves the device.
+
+Also here: the R2D2-style sequence consumer over
+`replay.sample_sequences` (arxiv 1803.0933's burn-in/train window
+split), buildable now that the 3.08× mixed-codec capacity supports
+long windows — `sample_training_sequences` draws [B, burn_in + L]
+windows of consecutive inserts, splits the burn-in prefix (recurrent
+warmup; consumers stop gradients through it) from the train window,
+and hands back the episode-validity mask consumers weight losses with
+(`sequence_window_mask`; the same alive-before-done convention
+`ddpg.nstep_batch` masks its n-step returns with, so the two consumers
+can never disagree about where an episode ends inside a window). The
+wrap/episode-boundary contract itself lives on
+`replay.sample_sequences` (documented + tested in tests/test_replay.py
+ahead of this consumer).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from actor_critic_tpu import replay
+from actor_critic_tpu.data_plane import ring as dp_ring
+from actor_critic_tpu.utils import compile_cache as _compile_cache
+
+
+def offpolicy_block_spec(spec, cfg, actors: int) -> dict:
+    """The [K, E_a] transition-block spec an off-policy ActorService
+    pushes (host_collect keys; E_a = num_envs // actors). `last_obs`
+    rides along because ActorService records it into every block — the
+    ingest ignores it, but the ring spec must match what `put` sees."""
+    actors = max(int(actors), 1)
+    K = cfg.steps_per_iter
+    E = cfg.num_envs // actors
+    s = _compile_cache.array_struct
+    obs = lambda lead: s((*lead, *spec.obs_shape), spec.obs_dtype)  # noqa: E731
+    return {
+        "obs": obs((K, E)),
+        "action": s((K, E, spec.action_dim), np.float32),
+        "reward": s((K, E), np.float32),
+        "done": s((K, E), np.float32),
+        "terminated": s((K, E), np.float32),
+        "final_obs": obs((K, E)),
+        "last_obs": obs((E,)),
+    }
+
+
+def make_device_ingest_update(
+    make_update_loop,
+    action_dim: int,
+    cfg,
+    ring_codecs: dict,
+    min_size: int,
+):
+    """Jitted `(learner, ring_state, slot, env_steps) → (learner,
+    metrics)`: gather + decode the staged block INSIDE the program,
+    scatter it into the (donated) replay ring, and run the algo's
+    update loop — the device-plane twin of the per-algo
+    `make_host_ingest_update`, shared by DDPG/TD3 and SAC through their
+    `make_update_loop` factories. `min_size` is the algo's update-gate
+    floor (DDPG: max(batch_size, nstep) — n-step windows must never
+    clamp into zero-initialized ring slots; SAC: batch_size).
+
+    The learner state is donated (argnum 0, the existing in-place
+    replay discipline); the ring state is a READ-ONLY input — its
+    donation belongs to the enqueue program, and dispatch ordering
+    under the ring lock keeps the two from aliasing (ring.py docstring).
+    """
+    from actor_critic_tpu.algos.common import OffPolicyTransition
+
+    update_loop = make_update_loop(action_dim, cfg)
+    codecs = replay.offpolicy_codecs(cfg.replay_dtype)
+
+    @partial(jax.jit, donate_argnums=0)
+    def ingest_update(ls, ring_state: dp_ring.RingState, slot, env_steps):
+        block = dp_ring.gather_block(ring_state, slot, ring_codecs)
+        traj = OffPolicyTransition(
+            obs=block["obs"],
+            action=block["action"],
+            reward=block["reward"],
+            next_obs=block["final_obs"],
+            terminated=block["terminated"],
+            done=block["done"],
+        )
+        flat = jax.tree.map(lambda x: x.reshape(-1, *x.shape[2:]), traj)
+        rbuf = replay.add_batch(ls.replay, flat, codecs)
+        do_update = jnp.logical_and(
+            env_steps >= cfg.warmup_steps, rbuf.size >= min_size
+        )
+        return update_loop(ls._replace(replay=rbuf), do_update)
+
+    return ingest_update
+
+
+# ---------------------------------------------------------------------------
+# R2D2-style sequence consumer (replay.sample_sequences)
+# ---------------------------------------------------------------------------
+
+def sequence_window_mask(done: jax.Array) -> jax.Array:
+    """[B, L] done flags → float32 validity mask: step t is valid iff
+    no episode ended at a step STRICTLY BEFORE t inside the window —
+    the step carrying the terminal reward is itself valid (it belongs
+    to the episode), everything after it is a different episode and
+    must not contribute (the `ddpg.nstep_batch` alive-before
+    convention, factored out so every sequence consumer masks
+    identically)."""
+    d = done.astype(jnp.float32)
+    return jnp.cumprod(
+        jnp.concatenate([jnp.ones_like(d[:, :1]), 1.0 - d[:, :-1]], axis=1),
+        axis=1,
+    )
+
+
+def split_burn_in(seq: Any, burn_in: int):
+    """[B, burn_in + L] windows → (burn, train, train_mask): the R2D2
+    split — `burn` (None when burn_in == 0) warms recurrent state with
+    gradients stopped by the consumer; `train` carries the loss steps;
+    `train_mask` is the episode-validity mask over the WHOLE window
+    sliced to the train half, so a done inside the burn-in prefix
+    correctly invalidates the train steps after it (they belong to the
+    next episode — training on them against burn-in state from the
+    previous one is the splice this mask exists to prevent)."""
+    done = seq.done
+    mask = sequence_window_mask(done)
+    train = jax.tree.map(lambda x: x[:, burn_in:], seq)
+    if burn_in == 0:
+        return None, train, mask
+    burn = jax.tree.map(lambda x: x[:, :burn_in], seq)
+    return burn, train, mask[:, burn_in:]
+
+
+def sample_training_sequences(
+    state: replay.ReplayState,
+    key: jax.Array,
+    batch_size: int,
+    seq_len: int,
+    burn_in: int = 0,
+    codecs: Optional[Any] = None,
+):
+    """Draw `batch_size` R2D2-style training windows from the replay
+    ring: `burn_in + seq_len` CONSECUTIVE INSERTS per window
+    (`replay.sample_sequences` — windows may wrap the physical ring but
+    never cross the write-cursor seam; see its contract), split into
+    (burn, train, train_mask). Callers ensure
+    `size >= burn_in + seq_len` and, as with `DDPGConfig.nstep`, that
+    consecutive inserts are one env's consecutive timesteps
+    (num_envs == 1 for interleave-free windows)."""
+    seq = replay.sample_sequences(
+        state, key, batch_size, burn_in + seq_len, codecs
+    )
+    return split_burn_in(seq, burn_in)
+
+
+# -- AOT warmup (ISSUE 13: every new jitted entry point has a planner) ------
+
+@_compile_cache.register_warmup("device_replay.make_device_ingest_update")
+def _warmup_device_ingest(ctx):
+    if (
+        ctx.data_plane != "device"
+        or not ctx.async_actors
+        or ctx.fused
+        or ctx.algo not in ("ddpg", "td3", "sac")
+    ):
+        return None
+    from actor_critic_tpu.algos import ddpg, sac
+    from actor_critic_tpu.data_plane import codecs as np_codecs
+
+    mod = ddpg if ctx.algo in ("ddpg", "td3") else sac
+    cfg = ctx.cfg
+    min_size = (
+        max(cfg.batch_size, cfg.nstep)
+        if hasattr(cfg, "nstep") else cfg.batch_size
+    )
+    block_spec = offpolicy_block_spec(ctx.spec, cfg, ctx.async_actors)
+    kinds = np_codecs.traj_codecs(ctx.plane_codec, block_spec)
+    learner_abs = jax.eval_shape(
+        partial(
+            mod.init_learner, tuple(ctx.spec.obs_shape),
+            ctx.spec.action_dim, cfg,
+        ),
+        jax.random.key(0),
+    )
+    state_abs = dp_ring.abstract_ring_state(block_spec, ctx.queue_depth, kinds)
+    jitted = make_device_ingest_update(
+        mod.make_update_loop, ctx.spec.action_dim, cfg, kinds, min_size
+    )
+    s = _compile_cache.scalar_struct
+    return lambda: _compile_cache.aot_compile(
+        jitted, learner_abs, state_abs, s(np.int32), s(np.int32)
+    )

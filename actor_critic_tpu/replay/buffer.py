@@ -208,16 +208,36 @@ def sample_sequences(
 ) -> Any:
     """Sample `batch_size` sequences of `seq_len` consecutive INSERTS.
 
-    Start offsets are drawn in insertion order relative to the oldest
-    valid entry, so a window can wrap around the physical ring but never
-    crosses the write-cursor seam (which would splice the newest and
-    oldest transitions into a fabricated sequence). Callers ensure
-    size >= seq_len. Returned leaves are [batch_size, seq_len, ...]
-    (codec-decoded like `sample`). Sequences may still span episode
-    boundaries; consumers mask on their stored `done` flags — see
-    `algos.ddpg` `DDPGConfig.nstep`, whose n-step TD target is the
-    in-tree consumer (ADVICE: a sequence/R2D2 style recurrent consumer
-    would sit on the same call).
+    THE WINDOW CONTRACT (ISSUE 13 — pinned by tests/test_replay.py
+    before the R2D2-style consumer builds on it):
+
+    1. **Insertion order, never the seam.** Start offsets are drawn in
+       insertion order relative to the OLDEST valid entry, so a window
+       may wrap around the physical ring (its indices straddle slot
+       capacity-1 → 0) but can never cross the write-cursor seam —
+       which would splice the ring's newest transitions onto its oldest
+       and fabricate a sequence no policy ever produced. Every returned
+       window is `seq_len` transitions that were inserted consecutively.
+    2. **Episode boundaries are the CONSUMER's job.** A window may
+       contain `done == 1` anywhere inside it; this function returns it
+       unmodified (truncating would make window shapes dynamic).
+       Consumers mask using the stored `done` flags, with the shared
+       alive-before-done convention: the step carrying `done` is the
+       LAST valid step of its episode (its reward is the terminal
+       reward), every later step in the window belongs to a different
+       episode and must not contribute. In-tree consumers:
+       `algos.ddpg.nstep_batch` (n-step TD prefix) and
+       `data_plane.device_replay.sequence_window_mask` /
+       `split_burn_in` (the R2D2-style burn-in/train split).
+    3. **Env interleaving is the CALLER's job.** The ring stores
+       flattened [K, E] rollouts, so consecutive inserts are one env's
+       consecutive timesteps only when E == 1 (`DDPGConfig.nstep`
+       enforces this); with E > 1 a window interleaves envs.
+
+    Callers ensure size >= seq_len (the max_start clamp below only
+    keeps randint's bounds legal under tracing — a smaller ring would
+    silently clamp windows into zero-initialized slots). Returned
+    leaves are [batch_size, seq_len, ...], codec-decoded like `sample`.
     """
     if codecs is None:
         _guard_defaulted_codecs(state)

@@ -324,6 +324,15 @@ class WarmupContext:
     # (E_a = num_envs // async_actors).
     async_actors: int = 0
     async_correction: str = "vtrace"
+    # Device-resident data plane (ISSUE 13): "device" stages trajectory
+    # blocks in a donated HBM ring (data_plane/ring.py) and the learner
+    # gathers+decodes in-jit — a different update program (and an
+    # enqueue program) than the host plane's, at the same block shapes.
+    # plane_codec picks the ring's per-key quantize codecs; queue_depth
+    # sizes the ring the warmup's abstract state must match.
+    data_plane: str = "host"
+    plane_codec: str = "fp32"
+    queue_depth: int = 4
     # Policy-serving gateway (ISSUE 10): non-empty bucket sizes put the
     # context in SERVING mode — plan_warmup then runs only the planners
     # registered with `register_warmup(..., serving=True)` (the serving
@@ -688,6 +697,11 @@ def register_offpolicy_warmups(module: str, aliases, *,
         import numpy as np
 
         if ctx.fused or ctx.algo not in aliases:
+            return None
+        if ctx.data_plane == "device" and ctx.async_actors:
+            # ISSUE 13: the device plane dispatches
+            # device_replay.make_device_ingest_update instead — the
+            # argument-fed program would be a wasted warmup compile.
             return None
         from actor_critic_tpu.algos.common import OffPolicyTransition
 

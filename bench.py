@@ -156,13 +156,15 @@ def _error_line(msg: str, root: str | None = None) -> str:
 # CPU-runnable bench/suite.py metrics promoted into every bench.py
 # record (ROADMAP "Bench resilience"; ISSUE 6 satellite, extended by
 # ISSUE 8's replay_sample_throughput, ISSUE 9's multihost_scaling,
-# ISSUE 10's serving_latency and ISSUE 11's scenario_fleet):
+# ISSUE 10's serving_latency, ISSUE 11's scenario_fleet and ISSUE 13's
+# consumed_env_steps_per_s data-plane A/B):
 # the TPU headline stays on top when the tunnel is alive, but a dead
 # tunnel no longer means an evidence-free round — host_pool_scaling,
 # startup_to_first_step, async_decoupling, update_wall,
-# replay_sample_throughput, multihost_scaling, serving_latency and
+# replay_sample_throughput, multihost_scaling, serving_latency,
 # scenario_fleet (heterogeneous mixture + the steps/s-vs-instance-count
-# sweep) are measured on the CPU backend regardless. BENCH_CPU_METRICS overrides the set (comma
+# sweep) and consumed_env_steps_per_s (host vs device data plane) are
+# measured on the CPU backend regardless. BENCH_CPU_METRICS overrides the set (comma
 # list of bench/suite.py names); "0"/"none"/"off" disables. Trend the
 # block across rounds with scripts/bench_trend.py. Budget note: the
 # multihost grid adds ~2 minutes of multi-process cluster runs and the
@@ -172,7 +174,7 @@ def _error_line(msg: str, root: str | None = None) -> str:
 DEFAULT_CPU_METRICS = (
     "host_pool_scaling,startup_to_first_step,async_decoupling,update_wall,"
     "replay_sample_throughput,multihost_scaling,serving_latency,"
-    "scenario_fleet"
+    "scenario_fleet,consumed_env_steps_per_s"
 )
 
 

@@ -471,6 +471,52 @@ def bench_update_wall():
     vtrace_s = timeit(
         ppo.make_async_update_step(spec, cfg, correction="vtrace")
     )
+
+    # Device-data-plane re-measurement (ISSUE 13): the same V-trace
+    # update with the block gathered + decoded from the HBM trajectory
+    # ring INSIDE the program — the gather/decode prefix is the only
+    # delta, so this wall is the honest denominator of the device
+    # plane's updates/s (and its overhead vs the argument-fed program
+    # is the in-jit staging cost).
+    from actor_critic_tpu.data_plane import ring as dp_ring
+
+    block_spec = ppo.async_block_spec(spec, cfg, 1, "vtrace")
+    ring = dp_ring.DeviceTrajRing(
+        depth=2, block_spec=block_spec, codec="fp32",
+        register_gauge=False,
+    )
+    block = {
+        "obs": np.asarray(obs), "action": np.asarray(args["action"]),
+        "log_prob": np.asarray(args["log_prob"]),
+        "value": np.asarray(args["value"]),
+        "reward": np.asarray(args["reward"]),
+        "done": np.asarray(args["done"]),
+        "terminated": np.asarray(args["terminated"]),
+        "final_obs": np.asarray(obs), "last_obs": np.asarray(last_obs),
+    }
+    ring.put(block, version=0)
+    lease = ring.get(timeout=1.0)
+    dev_update = ppo.make_device_update_step(
+        spec, cfg, ring.codecs, correction="vtrace"
+    )
+    slot = np.int32(lease.slot)
+
+    def dev_call():
+        return ring.run(
+            lambda state: dev_update(params, opt_state, state, slot, key)
+        )
+
+    out = dev_call()
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    reps = 20
+    for _ in range(reps):
+        out = dev_call()
+        jax.block_until_ready(out)
+    device_s = (time.perf_counter() - t0) / reps
+    ring.release(lease)
+    ring.close()
+
     return {
         "metric": "steady_state_update_wall",
         "value": round(plain_s * 1e3, 2),
@@ -479,6 +525,146 @@ def bench_update_wall():
         "updates_per_s": round(1.0 / plain_s, 1),
         "vtrace_corrected_ms": round(vtrace_s * 1e3, 2),
         "vtrace_overhead_x": round(vtrace_s / plain_s, 2),
+        "device_plane_ms": round(device_s * 1e3, 2),
+        "device_gather_overhead_x": round(device_s / vtrace_s, 2),
+    }
+
+
+def bench_data_plane():
+    """End-to-end async-pipeline A/B, host vs device data plane
+    (ISSUE 13 acceptance row): the SAME async PPO run — two actor
+    services, V-trace learner, identical consumed env-steps — once
+    through the host-numpy TrajQueue (one host→device transfer per
+    consumed block on the learner thread) and once through the HBM
+    DeviceTrajRing (actors enqueue int8-encoded blocks at collection
+    time; the learner gathers + decodes in-jit, transferring only the
+    slot index).
+
+    Testbed: every block transfer is padded with a 10 ms wall sleep
+    (`transfer_pad_s`, the serving bench's dispatch-pad discipline
+    modeling the ~26 ms axon tunnel round trip) — on the host plane
+    that wall lands on the LEARNER per consumed block; on the device
+    plane it lands on ACTOR threads at collection time, overlapped
+    with learning. That relocation is the architectural win a CPU-local
+    jnp.asarray (~µs) cannot exhibit; the UNPADDED A/B rides along for
+    transparency. Per-consumed-block transfer bytes are recorded for
+    both planes (device consume = 0 by construction — acceptance), and
+    a depth-1 `correction="none"` bitwise-equivalence check between the
+    planes runs inside the record so the speed row and the correctness
+    claim travel together."""
+    from actor_critic_tpu.algos import ppo
+    from actor_critic_tpu.data_plane import ring as dp_ring
+    from actor_critic_tpu.envs.host_pool import HostEnvPool
+
+    E, K, iters, pad = 8, 32, 50, 0.010
+    cfg = ppo.PPOConfig(
+        num_envs=E, rollout_steps=K, epochs=4, num_minibatches=4,
+        lr=3e-3, hidden=(64, 64),
+    )
+
+    def pools():
+        return [
+            HostEnvPool("CartPole-v1", E // 2, seed=0),
+            HostEnvPool("CartPole-v1", E // 2, seed=100003),
+        ]
+
+    def run(plane: str, pad_s: float) -> float:
+        ps = pools()
+        try:
+            t0 = time.perf_counter()
+            ppo.train_host_async(
+                ps, cfg, iters, seed=0, log_every=0,
+                queue_depth=4, max_staleness=8, correction="vtrace",
+                data_plane=plane,
+                plane_codec="int8" if plane == "device" else "fp32",
+                transfer_pad_s=pad_s,
+            )
+            return time.perf_counter() - t0
+        finally:
+            for p in ps:
+                p.close()
+
+    consumed = iters * K * (E // 2)
+
+    def ab(pad_s: float) -> dict:
+        host_wall = run("host", pad_s)
+        device_wall = run("device", pad_s)
+        host_sps = consumed / host_wall
+        device_sps = consumed / device_wall
+        return {
+            "host": {
+                "consumed_steps_per_s": round(host_sps, 1),
+                "wall_s": round(host_wall, 2),
+            },
+            "device": {
+                "consumed_steps_per_s": round(device_sps, 1),
+                "wall_s": round(device_wall, 2),
+            },
+            "device_over_host_x": round(device_sps / host_sps, 2),
+        }
+
+    # Transfer-byte accounting straight from the ring (no estimates).
+    ps = pools()
+    spec = ps[0].spec
+    for p in ps:
+        p.close()
+    block_spec = ppo.async_block_spec(spec, cfg, 2, "vtrace")
+    acct = dp_ring.DeviceTrajRing(
+        depth=1, block_spec=block_spec, codec="int8", register_gauge=False
+    )
+    bytes_row = {
+        "host_per_consumed_block": acct.raw_bytes_per_block(),
+        "device_per_consumed_block": 0,  # slot index only — acceptance
+        "device_enqueue_per_block": acct.bytes_per_block(),
+        "codec_mix": acct.codec_mix(),
+    }
+    acct.close()
+
+    # Depth-1 bitwise equivalence rides in the record: the device plane
+    # must be a pure relocation, not a silent algorithm change.
+    eq_cfg = ppo.PPOConfig(
+        num_envs=4, rollout_steps=8, epochs=2, num_minibatches=2,
+        hidden=(16,),
+    )
+
+    def strict(plane: str):
+        pool = HostEnvPool("CartPole-v1", 4, seed=0)
+        try:
+            p, o, _ = ppo.train_host_async(
+                [pool], eq_cfg, 3, seed=0, log_every=0,
+                queue_depth=1, correction="none", strict_lockstep=True,
+                data_plane=plane, plane_codec="fp32",
+            )
+            return p, o
+        finally:
+            pool.close()
+
+    ph, oh = strict("host")
+    pd, od = strict("device")
+    bitwise = all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(
+            jax.tree.leaves((ph, oh)), jax.tree.leaves((pd, od))
+        )
+    )
+
+    padded = ab(pad)
+    raw = ab(0.0)
+    return {
+        "metric": "consumed_env_steps_per_s",
+        "value": padded["device"]["consumed_steps_per_s"],
+        "unit": "consumed env-steps/s, async PPO device data plane "
+                f"({pad * 1e3:.0f} ms tunnel-padded transfers; host "
+                "TrajQueue A/B inline)",
+        **padded,
+        "raw_transfer": raw,
+        "per_block_transfer_bytes": bytes_row,
+        "depth1_bitwise_equal": bool(bitwise),
+        "config": {
+            "num_envs": E, "rollout_steps": K, "iterations": iters,
+            "actors": 2, "transfer_pad_ms": pad * 1e3,
+            "device_codec": "int8", "correction": "vtrace",
+        },
     }
 
 
@@ -935,6 +1121,7 @@ BENCHES = {
     "host_pool_scaling": bench_host_pool_scaling,
     "async_decoupling": bench_async_decoupling,
     "update_wall": bench_update_wall,
+    "consumed_env_steps_per_s": bench_data_plane,
     "replay_sample_throughput": bench_replay_sample_throughput,
     "multihost_scaling": bench_multihost_scaling,
     "serving_latency": bench_serving_latency,

@@ -261,6 +261,35 @@ def scenario_sweep_cell(rec: dict | None) -> str:
     return _numeric_cell(sweep.get("peak_steps_per_s"))
 
 
+def data_plane_cell(rec: dict | None, plane: str) -> str:
+    """One plane's consumed env-steps/s from the ISSUE 13 data-plane
+    A/B record (`-` before the metric existed, `?` malformed)."""
+    entry, cell = _metric_entry(rec, "consumed_env_steps_per_s")
+    if entry is None:
+        return cell
+    sub = entry.get(plane)
+    if sub is None:
+        return "-"
+    if not isinstance(sub, dict):
+        return "?"
+    return _numeric_cell(sub.get("consumed_steps_per_s"))
+
+
+def data_plane_bytes_cell(rec: dict | None) -> str:
+    """Per-consumed-block enqueue bytes of the device plane (the host
+    plane's per-block figure rides the same record; consume-side
+    transfer is 0 by construction)."""
+    entry, cell = _metric_entry(rec, "consumed_env_steps_per_s")
+    if entry is None:
+        return cell
+    bytes_row = entry.get("per_block_transfer_bytes")
+    if bytes_row is None:
+        return "-"
+    if not isinstance(bytes_row, dict):
+        return "?"
+    return _numeric_cell(bytes_row.get("device_enqueue_per_block"))
+
+
 def multihost_straggler_cell(rec: dict | None) -> str:
     """The straggler A/B ratio (gossip over sync fleet throughput)."""
     entry, cell = _multihost_entry(rec)
@@ -354,6 +383,21 @@ def trend_rows(root: str) -> tuple[list[int], list[tuple[str, list[str]]]]:
                     f"serving_latency.{field}",
                     [serving_cell(r, field) for r in recs],
                 ))
+        if name == "consumed_env_steps_per_s":
+            # Data-plane A/B sub-rows (ISSUE 13): each plane's absolute
+            # consumed env-steps/s and the device plane's per-block
+            # enqueue bytes, so a regression in either plane (or a
+            # codec silently fattening the enqueue) is visible even
+            # when the headline device figure holds.
+            for plane in ("host", "device"):
+                rows.append((
+                    f"consumed_env_steps_per_s.{plane}",
+                    [data_plane_cell(r, plane) for r in recs],
+                ))
+            rows.append((
+                "consumed_env_steps_per_s.enqueue_bytes",
+                [data_plane_bytes_cell(r) for r in recs],
+            ))
     return rounds, rows
 
 

@@ -44,14 +44,29 @@ def main(argv=None) -> int:
     )
     p.add_argument(
         "--scenario",
-        choices=("all", "queue", "publisher", "mailbox", "batcher"),
+        choices=(
+            "all", "queue", "publisher", "mailbox", "batcher",
+            "device_ring",
+        ),
         default="all",
-        help="which unit to exercise (default: all four, split evenly)",
+        help="which unit to exercise (default: all four jax-light units, "
+        "split evenly; device_ring drives the ISSUE 13 HBM trajectory "
+        "ring's enqueue-vs-gather interleavings — it dispatches real "
+        "jitted programs, so it runs only when asked for)",
     )
     p.add_argument(
         "--consumer", choices=("snapshot", "alias"), default="snapshot",
         help="queue consumer mode: 'alias' reproduces the reverted "
-        "PR 6 copy-on-transfer consumer (expected exit 1)",
+        "PR 6 copy-on-transfer consumer (expected exit 1). For "
+        "--scenario device_ring, 'alias' maps to the release-before-"
+        "read consumer (same bug class; expected exit 1)",
+    )
+    p.add_argument(
+        "--writer", choices=("correct", "buggy"), default="correct",
+        help="device_ring writer mode: 'buggy' reverts the leased-slot "
+        "protection (drop-oldest reclaims a slot the learner still "
+        "holds) — the ring poisoner catches it at the claim site "
+        "(expected exit 1)",
     )
     p.add_argument(
         "--submit", choices=("copy", "alias"), default="copy",
@@ -85,6 +100,17 @@ def main(argv=None) -> int:
             out = racesan.exercise_sweep(
                 range(args.seed0, args.seed0 + args.schedules),
                 lambda s: racesan.exercise_mailbox(s, poison=poison),
+            )
+        elif args.scenario == "device_ring":
+            out = racesan.exercise_sweep(
+                range(args.seed0, args.seed0 + args.schedules),
+                lambda s: racesan.exercise_device_ring(
+                    s, poison=poison,
+                    consumer=(
+                        "released" if args.consumer == "alias" else "leased"
+                    ),
+                    buggy_writer=(args.writer == "buggy"),
+                ),
             )
         elif args.scenario == "batcher":
             out = racesan.exercise_sweep(

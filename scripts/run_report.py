@@ -271,6 +271,31 @@ def resource_summary(rows: list[dict]) -> list[str]:
             f"{rp.get('capacity_multiplier', 1.0)}x transitions/byte; "
             f"codecs {rp.get('codec_mix', '?')}"
         )
+    # Device trajectory ring (data_plane/ring.py gauge, ISSUE 13):
+    # slots x encoded bytes/block x codec mix is the static shape of
+    # the HBM data plane; the enqueue-byte total vs the raw figure
+    # shows what the codec saved, and the TrajQueue-compatible counters
+    # carry the same back-pressure story as the traj-queue row. Static
+    # + cumulative facts, so the LAST row suffices.
+    dr_rows = [
+        r["device_ring"] for r in rows
+        if isinstance(r.get("device_ring"), dict)
+    ]
+    if dr_rows:
+        dr = dr_rows[-1]
+        out.append(
+            f"- **device ring**: {dr.get('slots', '?')} slots x "
+            f"{dr.get('bytes_per_block', '?')} B/block encoded "
+            f"(raw {dr.get('raw_bytes_per_block', '?')} B; codecs "
+            f"{dr.get('codec_mix', '?')}); enqueue transfers "
+            f"{_fmt_bytes(dr.get('enqueue_bytes', 0))} total, consume "
+            f"transfers {dr.get('consume_transfer_bytes', 0)} B; "
+            f"staleness last {dr.get('observe_staleness', 0)} / max "
+            f"{dr.get('staleness_max', 0)}; drops "
+            f"{dr.get('drops_full', 0)} full + "
+            f"{dr.get('drops_stale', 0)} stale; learner idle "
+            f"{_fmt_s(float(dr.get('learner_idle_s', 0.0)))}"
+        )
     # Policy-serving gateway (serving/batcher.py gauge, ISSUE 10):
     # latency percentiles and occupancy say whether the micro-batch
     # window is tuned right; rejected counts are the 503 back-pressure

@@ -38,7 +38,7 @@ takes_value() {
     --chunk|--eval-every|--eval-envs|--eval-steps|--workers|--ckpt-dir|\
     --compile-cache-dir|--save-every|--stall-timeout|--async-actors|\
     --updates-per-block|--max-staleness|--queue-depth|--async-correction|\
-    --replay-dtype|--curriculum)
+    --replay-dtype|--curriculum|--data-plane|--data-plane-codec)
       return 0 ;;
   esac
   return 1

@@ -25,3 +25,21 @@ def quantized_ingest_stale_read(encode, state, batch):
     step = jax.jit(lambda s, b: encode(s, b), donate_argnums=0)
     new_state = step(state, batch)  # donates `state` ...
     return new_state, state.quant   # ... then reads the donated tree
+
+
+def ring_enqueue_stale_gather(gather_block, ring_state, encoded, slot):
+    """ISSUE 13 device-ring shape: the donated enqueue consumes the
+    ring state, then the learner's gather is dispatched against the
+    OLD binding — XLA already reused that buffer for the scatter."""
+    enqueue = jax.jit(lambda s, e: e, donate_argnums=0)
+    new_state = enqueue(ring_state, encoded)  # donates `ring_state` ...
+    return new_state, gather_block(ring_state, slot)  # ... stale gather
+
+
+def ring_enqueue_restored(ckpt, template, encoded):
+    """Device-ring resume near-bug: a checkpoint-restored ring donated
+    straight into the enqueue — the PR 4 restore-aliased class at the
+    new call site."""
+    enqueue = jax.jit(lambda s, e: e, donate_argnums=0)
+    ring_state = ckpt.restore(template)
+    return enqueue(ring_state, encoded)  # restore-aliased buffer donated

@@ -33,3 +33,14 @@ def quantized_ingest(encode, decode, state, batch, key):
     state = step(state, batch)  # rebound by the donating call
     sampled = decode(state.quant, state.storage)  # reads the NEW binding
     return state, sampled
+
+
+def ring_enqueue_then_gather(gather_block, ring_state, blocks, slot):
+    """ISSUE 13 device-ring discipline (must NOT flag): the donated
+    enqueue REBINDS the ring state every put, and the learner's gather
+    reads the current binding — the DeviceTrajRing lock serializes the
+    two dispatches, so no stale handle ever exists."""
+    enqueue = jax.jit(lambda s, e: e, donate_argnums=0)
+    for encoded in blocks:
+        ring_state = enqueue(ring_state, encoded)  # rebound per put
+    return ring_state, gather_block(ring_state, slot)  # NEW binding

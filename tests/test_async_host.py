@@ -35,15 +35,23 @@ def _tree_equal(a, b) -> bool:
 # ------------------------------------------------------- lockstep equivalence
 
 @pytest.mark.parametrize(
+    "data_plane",
+    ["host", "device"],
+    ids=["host_plane", "device_plane"],
+)
+@pytest.mark.parametrize(
     "epochs,minibatches",
     [(2, 2), (1, 1)],
     ids=["ppo_shaped", "a2c_shaped"],  # 1 epoch x 1 full-batch mb = A2C-style
 )
-def test_async_depth1_is_bitwise_lockstep(epochs, minibatches):
+def test_async_depth1_is_bitwise_lockstep(epochs, minibatches, data_plane):
     """Async mode with one actor, queue depth 1, updates-per-block 1 and
     correction='none' must be bit-for-bit the current train_host
     pipeline (params AND optimizer state) — the refactor is pure
-    decoupling, not a silent algorithm change."""
+    decoupling, not a silent algorithm change. The device data plane
+    (ISSUE 13, fp32 codec: the block round-trips the HBM ring and is
+    gathered+decoded in-jit) must preserve the same bits — relocation,
+    not a new algorithm."""
     cfg = ppo.PPOConfig(
         num_envs=4, rollout_steps=8, epochs=epochs,
         num_minibatches=minibatches, hidden=(16,),
@@ -60,6 +68,7 @@ def test_async_depth1_is_bitwise_lockstep(epochs, minibatches):
         p_async, o_async, hist = ppo.train_host_async(
             [pool], cfg, 3, seed=0, log_every=0, updates_per_block=1,
             queue_depth=1, correction="none", strict_lockstep=True,
+            data_plane=data_plane, plane_codec="fp32",
         )
     finally:
         pool.close()
@@ -409,5 +418,68 @@ def test_async_learner_steady_state_zero_recompiles(tmp_path):
     real = [r for r in update_evs if not r.get("cache_hit")]
     assert len(real) == 1, update_evs  # warmup's one true compile
     assert any(r.get("cache_hit") for r in update_evs), update_evs
+    # Steady state: iterations past the second compile NOTHING.
+    assert counts[4] == counts[2], records
+
+
+def test_device_plane_steady_state_zero_recompiles(tmp_path):
+    """ISSUE 13 acceptance: the device data plane's BOTH new jitted
+    programs — the donated ring enqueue and the gather+decode+update —
+    are AOT-warmed (registry planners), and steady state compiles
+    nothing: blocks are fixed-shape ring slots, the slot index is a
+    traced scalar, and the calibrating quant re-uploads are
+    shape-stable."""
+    if not profiler.ensure_compile_introspection():
+        pytest.skip("jax compile funnel unavailable in this jax version")
+    cfg = ppo.PPOConfig(
+        num_envs=4, rollout_steps=8, epochs=1, num_minibatches=2,
+        hidden=(16,),
+    )
+    pools = [
+        HostEnvPool("CartPole-v1", 2, seed=0),
+        HostEnvPool("CartPole-v1", 2, seed=100003),
+    ]
+    try:
+        with compile_cache.temporary_cache(tmp_path / "cc"):
+            ctx = compile_cache.WarmupContext(
+                algo="ppo", fused=False, spec=pools[0].spec, cfg=cfg,
+                eval_every=0, overlap=True, async_actors=2,
+                async_correction="vtrace", data_plane="device",
+                plane_codec="int8", queue_depth=4,
+            )
+            plan = compile_cache.plan_warmup(ctx)
+            # The device plane's two programs — and NOT the host
+            # plane's argument-fed update.
+            assert [n for n, _ in plan] == [
+                "ppo.make_device_update_step", "ring.make_enqueue",
+            ]
+            n0 = profiler.compile_event_count()
+            runner = compile_cache.WarmupRunner(plan).start()
+            assert runner.wait(300), runner.results
+            assert not any("error" in r for r in runner.results), (
+                runner.results
+            )
+
+            counts = {}
+
+            def log_fn(it, m):
+                counts[it] = profiler.compile_event_count()
+
+            ppo.train_host_async(
+                pools, cfg, 4, seed=0, log_every=1, log_fn=log_fn,
+                correction="vtrace", data_plane="device",
+                plane_codec="int8", queue_depth=4,
+            )
+    finally:
+        for p in pools:
+            p.close()
+
+    from conftest import new_compile_records
+
+    records = new_compile_records(n0)
+    for name in ("jit_device_update", "jit_enqueue"):
+        evs = [r for r in records if r["name"] == name]
+        real = [r for r in evs if not r.get("cache_hit")]
+        assert len(real) <= 1, (name, evs)  # at most warmup's compile
     # Steady state: iterations past the second compile NOTHING.
     assert counts[4] == counts[2], records

@@ -270,3 +270,63 @@ def test_scenario_fleet_sub_rows(tmp_path):
     i = labels.index("scenario_fleet")
     assert labels[i + 1] == "scenario_fleet.mixture"
     assert "scenario_fleet.acrobot" in labels
+
+
+def _write_data_plane_rounds(root: Path):
+    """r01 without the metric, r02 a full data-plane A/B record, r03 a
+    malformed one, r04 unparseable."""
+    (root / "BENCH_r01.json").write_text(json.dumps({
+        "metric": "a2c", "value": 1.0,
+        "cpu_metrics": {"host_pool_scaling": {"value": 3.0}},
+    }) + "\n")
+    (root / "BENCH_r02.json").write_text(json.dumps({
+        "metric": "a2c", "value": 1.0,
+        "cpu_metrics": {
+            "consumed_env_steps_per_s": {
+                "value": 1210.6,
+                "host": {"consumed_steps_per_s": 808.3},
+                "device": {"consumed_steps_per_s": 1210.6},
+                "per_block_transfer_bytes": {
+                    "host_per_consumed_block": 7232,
+                    "device_per_consumed_block": 0,
+                    "device_enqueue_per_block": 2960,
+                },
+            },
+        },
+    }) + "\n")
+    (root / "BENCH_r03.json").write_text(json.dumps({
+        "metric": "a2c", "value": 1.0,
+        "cpu_metrics": {
+            "consumed_env_steps_per_s": {
+                "value": 0.5, "host": "oops", "device": {},
+                "per_block_transfer_bytes": [],
+            },
+        },
+    }) + "\n")
+    (root / "BENCH_r04.json").write_text("{not json")
+
+
+def test_data_plane_sub_rows(tmp_path):
+    """ISSUE 13 satellite: the consumed_env_steps_per_s record expands
+    into per-plane steps/s sub-rows plus the device enqueue bytes; '-'
+    before the metric existed, '?' for malformed sub-records."""
+    mod = _load()
+    _write_data_plane_rounds(tmp_path)
+    rounds, rows = mod.trend_rows(str(tmp_path))
+    assert rounds == [1, 2, 3, 4]
+    table = dict(rows)
+    assert table["consumed_env_steps_per_s"] == ["-", "1210.6", "0.5", "?"]
+    assert table["consumed_env_steps_per_s.host"] == ["-", "808.3", "?", "?"]
+    assert table["consumed_env_steps_per_s.device"] == [
+        "-", "1210.6", "?", "?",
+    ]
+    assert table["consumed_env_steps_per_s.enqueue_bytes"] == [
+        "-", "2960", "?", "?",
+    ]
+    labels = [label for label, _ in rows]
+    main = labels.index("consumed_env_steps_per_s")
+    assert labels[main + 1 : main + 4] == [
+        "consumed_env_steps_per_s.host",
+        "consumed_env_steps_per_s.device",
+        "consumed_env_steps_per_s.enqueue_bytes",
+    ]

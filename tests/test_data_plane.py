@@ -1,0 +1,575 @@
+"""Device-resident data plane (ISSUE 13): ring bookkeeping (drop-oldest
++ staleness-bound semantics carried over from TrajQueue), codec
+round-trips through the device ring, the host-numpy codec mirror vs the
+device decode, checkpoint strip/resume-reattach of ring quant stats,
+the off-policy device ingest, and the R2D2-style sequence consumer."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from actor_critic_tpu.data_plane import codecs as np_codecs
+from actor_critic_tpu.data_plane import device_replay
+from actor_critic_tpu.data_plane import ring as dp_ring
+from actor_critic_tpu.replay import quantize
+
+
+def _spec(shape=(3, 2), dtype=np.float32, **extra):
+    out = {"x": jax.ShapeDtypeStruct(shape, dtype)}
+    out.update(extra)
+    return out
+
+
+def _ring(depth=2, codec="fp32", spec=None, **kw):
+    return dp_ring.DeviceTrajRing(
+        depth=depth, block_spec=spec or _spec(), codec=codec,
+        register_gauge=False, **kw,
+    )
+
+
+def _slot(ring, lease, name="x"):
+    return np.asarray(
+        ring.run(lambda state: state.storage[name][lease.slot])
+    )
+
+
+class TestRingBookkeeping:
+    def test_init_shapes_and_codec_mix(self):
+        spec = {
+            "obs": jax.ShapeDtypeStruct((4, 2, 3), np.float32),
+            "action": jax.ShapeDtypeStruct((4, 2), np.int64),
+            "done": jax.ShapeDtypeStruct((4, 2), np.float32),
+            "log_prob": jax.ShapeDtypeStruct((4, 2), np.float32),
+        }
+        ring = dp_ring.DeviceTrajRing(
+            depth=3, block_spec=spec, codec="int8", register_gauge=False
+        )
+        st = ring._state
+        assert st.storage["obs"].shape == (3, 4, 2, 3)
+        assert st.storage["obs"].dtype == jnp.int8       # obs-family i8
+        assert st.storage["done"].dtype == jnp.int8      # bool8
+        assert st.storage["log_prob"].dtype == jnp.float32  # always raw
+        assert st.storage["action"].dtype == jnp.int32   # raw, canonical
+        assert st.versions.shape == (3,)
+        assert "obs:i8" in ring.codec_mix()
+        assert ring.bytes_per_block() < ring.raw_bytes_per_block()
+        ring.close()
+
+    def test_put_get_release_cycle(self):
+        ring = _ring(depth=2)
+        a = np.full((3, 2), 7.0, np.float32)
+        assert ring.put({"x": a}, version=0, actor_id=1)
+        lease = ring.get(timeout=1.0)
+        assert (lease.version, lease.actor_id, lease.seq) == (0, 1, 0)
+        np.testing.assert_array_equal(_slot(ring, lease), a)
+        # The caller's array was copied at encode: mutate and re-check.
+        a.fill(-1.0)
+        np.testing.assert_array_equal(
+            _slot(ring, lease), np.full((3, 2), 7.0, np.float32)
+        )
+        ring.release(lease)
+        assert ring.get(timeout=0) is None
+        ring.close()
+
+    def test_device_version_tree_mirrors_host_bookkeeping(self):
+        ring = _ring(depth=2)
+        for v in range(2):
+            ring.put({"x": np.full((3, 2), float(v), np.float32)}, version=v)
+        st = ring._state
+        assert sorted(np.asarray(st.versions).tolist()) == [0, 1]
+        assert sorted(np.asarray(st.seqs).tolist()) == [0, 1]
+        assert int(st.count) == 2
+        ring.close()
+
+    def test_drop_oldest_backpressure(self):
+        ring = _ring(depth=2)
+        for v in range(4):  # 2 slots, 4 puts: two oldest dropped
+            assert ring.put(
+                {"x": np.full((3, 2), float(v), np.float32)}, version=v
+            )
+        stats = ring.stats()
+        assert stats["drops_full"] == 2
+        lease = ring.get(timeout=1.0)
+        assert lease.version == 2  # oldest SURVIVING block
+        np.testing.assert_array_equal(
+            _slot(ring, lease), np.full((3, 2), 2.0, np.float32)
+        )
+        ring.close()
+
+    def test_drop_oldest_never_reclaims_leased_slot(self):
+        ring = _ring(depth=1)
+        assert ring.put({"x": np.zeros((3, 2), np.float32)}, version=0)
+        lease = ring.get(timeout=1.0)
+        # Single slot leased: a put must WAIT, not overwrite the lease.
+        assert not ring.put(
+            {"x": np.ones((3, 2), np.float32)}, version=1, timeout=0.05
+        )
+        np.testing.assert_array_equal(
+            _slot(ring, lease), np.zeros((3, 2), np.float32)
+        )
+        ring.release(lease)
+        assert ring.put({"x": np.ones((3, 2), np.float32)}, version=1)
+        ring.close()
+
+    def test_staleness_bound_drops_at_get(self):
+        ring = _ring(depth=4, max_staleness=1)
+        for v in range(3):
+            ring.put({"x": np.full((3, 2), float(v), np.float32)}, version=v)
+        ring.set_consumer_version(2)
+        lease = ring.get(timeout=1.0)
+        # versions 0 (lag 2) dropped; version 1 (lag 1) is consumable.
+        assert lease.version == 1
+        assert ring.stats()["drops_stale"] == 1
+        ring.close()
+
+    def test_block_policy_waits_for_free_slot(self):
+        ring = _ring(depth=1, codec="fp32")
+        ring.policy = "block"
+        assert ring.put({"x": np.zeros((3, 2), np.float32)}, version=0)
+        assert not ring.put(
+            {"x": np.ones((3, 2), np.float32)}, version=1, timeout=0.05
+        )
+        lease = ring.get(timeout=1.0)
+        ring.release(lease)
+        assert ring.put({"x": np.ones((3, 2), np.float32)}, version=1)
+        ring.close()
+
+    def test_stats_gauge_row_fields(self):
+        ring = _ring(depth=2, codec="fp32")
+        ring.put({"x": np.zeros((3, 2), np.float32)}, version=0)
+        s = ring.stats()
+        assert s["consume_transfer_bytes"] == 0
+        assert s["enqueue_bytes"] == 3 * 2 * 4
+        assert s["bytes_per_block"] == s["raw_bytes_per_block"] == 24
+        assert s["slots"] == s["capacity"] == 2
+        ring.close()
+
+
+class TestCodecsThroughRing:
+    def test_fp32_roundtrip_is_bitwise(self):
+        rng = np.random.default_rng(0)
+        a = rng.normal(size=(3, 2)).astype(np.float32)
+        ring = _ring(codec="fp32")
+        ring.put({"x": a}, version=0)
+        lease = ring.get(timeout=1.0)
+        decoded = np.asarray(ring.run(
+            lambda st: dp_ring.gather_block(st, lease.slot, ring.codecs)
+        )["x"])
+        np.testing.assert_array_equal(decoded, a)
+        ring.close()
+
+    @pytest.mark.parametrize("codec,bound", [("f16", 2e-3), ("int8", None)])
+    def test_quantized_roundtrip_error_bounds(self, codec, bound):
+        rng = np.random.default_rng(1)
+        a = rng.normal(0, 2, size=(8, 4)).astype(np.float32)
+        spec = {"obs": jax.ShapeDtypeStruct((8, 4), np.float32)}
+        ring = dp_ring.DeviceTrajRing(
+            depth=2, block_spec=spec, codec=codec, register_gauge=False
+        )
+        assert ring.codecs["obs"] == ("f16" if codec == "f16" else "i8")
+        ring.put({"obs": a}, version=0)
+        lease = ring.get(timeout=1.0)
+        decoded = np.asarray(ring.run(
+            lambda st: dp_ring.gather_block(st, lease.slot, ring.codecs)
+        )["obs"])
+        if bound is None:
+            # i8: scale/127 per element, scale = running |x - mean| max.
+            stats = ring.quant_host()["obs"]
+            bound = float(stats["scale"]) / 127.0 + 1e-6
+        assert np.max(np.abs(decoded - a)) <= bound
+        ring.close()
+
+    def test_int8_flags_and_small_ints_exact(self):
+        spec = {
+            "done": jax.ShapeDtypeStruct((4, 2), np.float32),
+            "action": jax.ShapeDtypeStruct((4, 2), np.int64),
+        }
+        ring = dp_ring.DeviceTrajRing(
+            depth=1, block_spec=spec, codec="int8", register_gauge=False
+        )
+        done = np.asarray([[0, 1]] * 4, np.float32)
+        action = np.asarray([[0, 1]] * 4, np.int64)
+        ring.put({"done": done, "action": action}, version=0)
+        lease = ring.get(timeout=1.0)
+        out = ring.run(
+            lambda st: dp_ring.gather_block(st, lease.slot, ring.codecs)
+        )
+        np.testing.assert_array_equal(np.asarray(out["done"]), done)
+        np.testing.assert_array_equal(np.asarray(out["action"]), action)
+        ring.close()
+
+    def test_host_mirror_matches_device_decode_exactly(self):
+        """The i8 encode happens on the HOST (numpy) and the decode on
+        device — with ONE stats tree both sides must reproduce the
+        decode table exactly: decode(encode(x)) computed by numpy must
+        equal the device's decode of the same codes bit-for-bit."""
+        rng = np.random.default_rng(2)
+        x = rng.normal(0, 3, size=(16, 4)).astype(np.float32)
+        stats = np_codecs.np_init_stats("i8", ())
+        stats = np_codecs.np_update_stats("i8", stats, x)
+        codes = np_codecs.np_encode("i8", stats, x)
+        host_decoded = np_codecs.np_decode("i8", stats, codes)
+        dev_stats = quantize.QuantStats(
+            mean=jnp.asarray(stats["mean"]),
+            scale=jnp.asarray(stats["scale"]),
+            count=jnp.asarray(stats["count"]),
+        )
+        dev_decoded = np.asarray(
+            quantize.decode("i8", dev_stats, jnp.asarray(codes))
+        )
+        np.testing.assert_array_equal(host_decoded, dev_decoded)
+
+    def test_np_stats_calibrate_then_freeze(self):
+        stats = np_codecs.np_init_stats("i8", ())
+        big = np.full((quantize.CALIBRATION_TRANSITIONS,), 5.0, np.float32)
+        stats = np_codecs.np_update_stats("i8", stats, big)
+        frozen_mean = float(stats["mean"])
+        # Past calibration: a wildly different batch must not move them.
+        stats2 = np_codecs.np_update_stats(
+            "i8", stats, np.full((64,), -100.0, np.float32)
+        )
+        assert float(stats2["mean"]) == frozen_mean
+        assert float(stats2["scale"]) == float(stats["scale"])
+
+    def test_calibration_clock_counts_transitions_not_elements(self):
+        """The freeze threshold is defined in TRANSITIONS: a
+        [K, E, obs_dim] block must advance the clock by K*E, not
+        K*E*obs_dim (which would freeze the window obs_dim× early,
+        before the random-warmup coverage), and the [E, ...] last_obs
+        by E."""
+        stats = np_codecs.np_init_stats("i8", ())
+        stats = np_codecs.np_update_stats(
+            "i8", stats, np.ones((64, 8), np.float32), num_transitions=64
+        )
+        assert int(stats["count"]) == 64
+        spec = {
+            "obs": jax.ShapeDtypeStruct((4, 2, 3), np.float32),
+            "reward": jax.ShapeDtypeStruct((4, 2), np.float32),
+            "last_obs": jax.ShapeDtypeStruct((2, 3), np.float32),
+        }
+        ring = dp_ring.DeviceTrajRing(
+            depth=2, block_spec=spec, codec="int8", register_gauge=False
+        )
+        assert ring._transitions_per_put == {
+            "obs": 8, "reward": 8, "last_obs": 2,
+        }
+        rng = np.random.default_rng(0)
+        ring.put({
+            "obs": rng.normal(size=(4, 2, 3)).astype(np.float32),
+            "reward": rng.normal(size=(4, 2)).astype(np.float32),
+            "last_obs": rng.normal(size=(2, 3)).astype(np.float32),
+        }, version=0)
+        q = ring.quant_host()
+        assert int(q["obs"]["count"]) == 8       # K*E, not K*E*obs_dim
+        assert int(q["reward"]["count"]) == 8
+        assert int(q["last_obs"]["count"]) == 2  # E rows
+        ring.close()
+
+    def test_raw_keys_never_quantize(self):
+        spec = {
+            "log_prob": jax.ShapeDtypeStruct((4, 2), np.float32),
+            "value": jax.ShapeDtypeStruct((4, 2), np.float32),
+            "action": jax.ShapeDtypeStruct((4, 2, 1), np.float32),
+        }
+        kinds = np_codecs.traj_codecs("int8", spec)
+        assert kinds == {
+            "log_prob": "raw", "value": "raw", "action": "raw"
+        }
+
+    def test_bad_codec_mode_rejected(self):
+        with pytest.raises(ValueError, match="data-plane codec"):
+            np_codecs.traj_codecs("bf16", _spec())
+
+
+class TestCheckpointStats:
+    def test_quant_host_install_roundtrip(self):
+        rng = np.random.default_rng(3)
+        spec = {"obs": jax.ShapeDtypeStruct((8, 4), np.float32)}
+        ring = dp_ring.DeviceTrajRing(
+            depth=2, block_spec=spec, codec="int8", register_gauge=False
+        )
+        ring.put({"obs": rng.normal(0, 2, (8, 4)).astype(np.float32)},
+                 version=0)
+        saved = ring.quant_host()
+        assert float(saved["obs"]["scale"]) > quantize._EPS
+        ring.close()
+        # Fresh ring (resume-reattach): storage zeroed, stats restored —
+        # new blocks encode against the run's original standardization.
+        ring2 = dp_ring.DeviceTrajRing(
+            depth=2, block_spec=spec, codec="int8", register_gauge=False
+        )
+        ring2.install_quant(saved)
+        again = ring2.quant_host()
+        for k in ("mean", "scale", "count"):
+            np.testing.assert_array_equal(
+                again["obs"][k], saved["obs"][k]
+            )
+        # And the DEVICE quant tree matches too (decode path).
+        np.testing.assert_array_equal(
+            np.asarray(ring2._state.quant["obs"].scale),
+            saved["obs"]["scale"],
+        )
+        ring2.close()
+
+    def test_async_ppo_device_plane_ckpt_strip_resume(self, tmp_path):
+        """e2e: a device-plane async PPO run checkpoints (ring storage
+        stripped by construction — only quant stats ride the tree),
+        resumes, and REFUSES a data-plane flip."""
+        gym = pytest.importorskip("gymnasium")  # noqa: F841
+        from actor_critic_tpu.algos import ppo
+        from actor_critic_tpu.envs.host_pool import HostEnvPool
+        from actor_critic_tpu.utils.checkpoint import Checkpointer
+
+        cfg = ppo.PPOConfig(
+            num_envs=2, rollout_steps=4, epochs=1, num_minibatches=1,
+            hidden=(8,),
+        )
+        ckpt_dir = tmp_path / "ck"
+
+        def run(iters, resume, plane="device"):
+            pool = HostEnvPool("CartPole-v1", 2, seed=0)
+            ckpt = Checkpointer(str(ckpt_dir))
+            try:
+                return ppo.train_host_async(
+                    [pool], cfg, iters, seed=0, log_every=1,
+                    correction="vtrace", data_plane=plane,
+                    plane_codec="int8", ckpt=ckpt, save_every=2,
+                    resume=resume,
+                )
+            finally:
+                ckpt.close()
+                pool.close()
+
+        run(2, resume=False)
+        # Resume continues from block 2 with the restored quant stats.
+        _, _, hist = run(4, resume=True)
+        assert [it for it, _ in hist] == [3, 4]
+        # A host-plane resume into a device-plane checkpoint must fail
+        # with advice, not an orbax structure error.
+        with pytest.raises(ValueError, match="data-plane"):
+            run(6, resume=True, plane="host")
+
+
+class TestOffPolicyDevicePlane:
+    def test_ddpg_device_ingest_fills_replay(self):
+        """The jitted gather+decode+ingest program: a staged block lands
+        in the replay ring bit-consistently with the host-path ingest
+        under the fp32 codec."""
+        from actor_critic_tpu.algos import ddpg
+        from actor_critic_tpu.algos.common import OffPolicyTransition
+        from actor_critic_tpu.envs.jax_env import EnvSpec
+
+        spec = EnvSpec(
+            obs_shape=(3,), action_dim=1, discrete=False,
+            obs_dtype=np.float32, can_truncate=True,
+        )
+        cfg = ddpg.DDPGConfig(
+            num_envs=2, steps_per_iter=4, updates_per_iter=1,
+            buffer_capacity=64, batch_size=4, warmup_steps=0, hidden=(8,),
+        )
+        rng = np.random.default_rng(0)
+        K, E = cfg.steps_per_iter, cfg.num_envs
+        block = {
+            "obs": rng.normal(size=(K, E, 3)).astype(np.float32),
+            "action": np.tanh(rng.normal(size=(K, E, 1))).astype(np.float32),
+            "reward": rng.normal(size=(K, E)).astype(np.float32),
+            "done": np.zeros((K, E), np.float32),
+            "terminated": np.zeros((K, E), np.float32),
+            "final_obs": rng.normal(size=(K, E, 3)).astype(np.float32),
+            "last_obs": rng.normal(size=(E, 3)).astype(np.float32),
+        }
+        block_spec = device_replay.offpolicy_block_spec(spec, cfg, 1)
+        ring = dp_ring.DeviceTrajRing(
+            depth=2, block_spec=block_spec, codec="fp32",
+            register_gauge=False,
+        )
+        ring.put(block, version=0)
+        lease = ring.get(timeout=1.0)
+        ingest = ddpg.make_device_ingest_update(
+            spec.action_dim, cfg, ring.codecs
+        )
+        learner = ddpg.init_learner((3,), 1, cfg, jax.random.key(0))
+        learner, _ = ring.run(
+            lambda st: ingest(
+                learner, st, np.int32(lease.slot), np.int32(0)
+            )
+        )
+        ring.release(lease)
+        ring.close()
+        assert int(learner.replay.size) == K * E
+        # The ring scattered exactly the block's transitions.
+        host = OffPolicyTransition(
+            obs=block["obs"], action=block["action"],
+            reward=block["reward"], next_obs=block["final_obs"],
+            terminated=block["terminated"], done=block["done"],
+        )
+        flat = jax.tree.map(
+            lambda x: x.reshape(-1, *x.shape[2:]), host
+        )
+        np.testing.assert_array_equal(
+            np.asarray(learner.replay.storage.obs[: K * E]), flat.obs
+        )
+        np.testing.assert_array_equal(
+            np.asarray(learner.replay.storage.reward[: K * E]),
+            flat.reward,
+        )
+
+
+class TestWarmupPlanners:
+    def test_offpolicy_device_plan_and_aot_compile(self):
+        """A ddpg device-plane context plans exactly the device ingest +
+        ring enqueue (plus the mirror-independent fused-free set), and
+        the thunks AOT-compile cleanly — every new jitted entry point
+        has a working planner."""
+        from actor_critic_tpu.algos import ddpg
+        from actor_critic_tpu.envs.jax_env import EnvSpec
+        from actor_critic_tpu.utils import compile_cache
+
+        spec = EnvSpec(
+            obs_shape=(3,), action_dim=1, discrete=False,
+            obs_dtype=np.float32, can_truncate=True,
+        )
+        cfg = ddpg.DDPGConfig(
+            num_envs=2, steps_per_iter=4, updates_per_iter=1,
+            buffer_capacity=64, batch_size=4, warmup_steps=0, hidden=(8,),
+        )
+        ctx = compile_cache.WarmupContext(
+            algo="ddpg", fused=False, spec=spec, cfg=cfg,
+            eval_every=0, overlap=True, async_actors=2,
+            data_plane="device", plane_codec="int8", queue_depth=3,
+        )
+        plan = dict(compile_cache.plan_warmup(ctx))
+        assert "device_replay.make_device_ingest_update" in plan
+        assert "ring.make_enqueue" in plan
+        # The host-plane ingest planner must NOT also fire: a device
+        # run never dispatches the argument-fed program, so warming it
+        # would be a wasted compile.
+        assert "ddpg.make_host_ingest_update" not in plan
+        for name, thunk in plan.items():
+            thunk()  # AOT lower+compile must succeed
+
+    def test_host_plane_context_plans_no_device_programs(self):
+        from actor_critic_tpu.algos import ddpg
+        from actor_critic_tpu.envs.jax_env import EnvSpec
+        from actor_critic_tpu.utils import compile_cache
+
+        spec = EnvSpec(
+            obs_shape=(3,), action_dim=1, discrete=False,
+            obs_dtype=np.float32, can_truncate=True,
+        )
+        cfg = ddpg.DDPGConfig(num_envs=2, steps_per_iter=4, hidden=(8,))
+        ctx = compile_cache.WarmupContext(
+            algo="ddpg", fused=False, spec=spec, cfg=cfg,
+            eval_every=0, overlap=True, async_actors=2,
+        )
+        names = [n for n, _ in compile_cache.plan_warmup(ctx)]
+        assert "device_replay.make_device_ingest_update" not in names
+        assert "ring.make_enqueue" not in names
+
+
+class TestSequenceConsumer:
+    def _seq(self, done_rows):
+        """OffPolicyTransition-shaped [B, L] windows with given dones."""
+        from actor_critic_tpu.algos.common import OffPolicyTransition
+
+        done = jnp.asarray(done_rows, jnp.float32)
+        B, L = done.shape
+        base = jnp.arange(B * L, dtype=jnp.float32).reshape(B, L)
+        return OffPolicyTransition(
+            obs=base[..., None], action=base[..., None], reward=base,
+            next_obs=base[..., None], terminated=done, done=done,
+        )
+
+    def test_window_mask_alive_before_done(self):
+        mask = device_replay.sequence_window_mask(
+            jnp.asarray([[0, 1, 0, 0], [0, 0, 0, 0]], jnp.float32)
+        )
+        # Done step itself valid (terminal reward counts); after, not.
+        np.testing.assert_array_equal(
+            np.asarray(mask), [[1, 1, 0, 0], [1, 1, 1, 1]]
+        )
+
+    def test_mask_matches_nstep_batch_convention(self):
+        """The R2D2 mask and ddpg.nstep_batch must agree on which steps
+        belong to the window's episode: the masked reward prefix sum at
+        gamma=1 equals nstep_batch's return G."""
+        from actor_critic_tpu.algos import ddpg
+
+        seq = self._seq([[0, 1, 0], [0, 0, 0], [1, 0, 0]])
+        batch, _ = ddpg.nstep_batch(seq, gamma=1.0)
+        mask = device_replay.sequence_window_mask(seq.done)
+        np.testing.assert_allclose(
+            np.asarray(batch.reward),
+            np.asarray(jnp.sum(seq.reward * mask, axis=1)),
+        )
+
+    def test_split_burn_in_shapes_and_cross_boundary_mask(self):
+        seq = self._seq([[0, 1, 0, 0, 0]])  # done inside the burn-in
+        burn, train, train_mask = device_replay.split_burn_in(seq, 2)
+        assert burn.reward.shape == (1, 2)
+        assert train.reward.shape == (1, 3)
+        # The burn-in's done invalidates EVERY train step: they belong
+        # to the next episode (the splice the mask exists to prevent).
+        np.testing.assert_array_equal(np.asarray(train_mask), [[0, 0, 0]])
+
+    def test_split_burn_in_zero_is_passthrough(self):
+        seq = self._seq([[0, 0, 1]])
+        burn, train, mask = device_replay.split_burn_in(seq, 0)
+        assert burn is None
+        np.testing.assert_array_equal(
+            np.asarray(train.reward), np.asarray(seq.reward)
+        )
+        np.testing.assert_array_equal(np.asarray(mask), [[1, 1, 1]])
+
+    def test_sample_training_sequences_draws_consecutive_inserts(self):
+        from actor_critic_tpu import replay
+
+        example = {"v": jnp.zeros((), jnp.float32),
+                   "done": jnp.zeros((), jnp.float32)}
+        state = replay.init(example, capacity=32)
+        fill = {
+            "v": jnp.arange(24, dtype=jnp.float32),
+            "done": jnp.zeros(24, jnp.float32),
+        }
+        state = replay.add_batch(state, fill)
+        out = replay.sample_sequences(
+            state, jax.random.key(0), 16, 6
+        )
+        v = np.asarray(out["v"])
+        # Every window is consecutive inserts (contract point 1).
+        np.testing.assert_array_equal(np.diff(v, axis=1), 1.0)
+
+
+def test_run_report_device_ring_row():
+    """The run-report Resources section renders the device-ring gauge
+    row (slots x bytes/block x codec mix; ISSUE 13 satellite)."""
+    import importlib.util
+    from pathlib import Path
+
+    spec = importlib.util.spec_from_file_location(
+        "run_report",
+        Path(__file__).parent.parent / "scripts" / "run_report.py",
+    )
+    run_report = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(run_report)
+
+    rows = [
+        {"ts": 1.0, "recompiles": 0,
+         "device_ring": {"capacity": 4, "slots": 4,
+                         "bytes_per_block": 2960,
+                         "raw_bytes_per_block": 7232,
+                         "enqueue_bytes": 148000,
+                         "consume_transfer_bytes": 0,
+                         "codec_mix": "obs:i8,log_prob:raw",
+                         "observe_staleness": 1, "staleness_max": 2,
+                         "drops_full": 3, "drops_stale": 0,
+                         "learner_idle_s": 0.42}},
+    ]
+    text = "\n".join(run_report.resource_summary(rows))
+    assert "device ring" in text
+    assert "4 slots x 2960 B/block" in text
+    assert "raw 7232 B" in text
+    assert "consume transfers 0 B" in text
+    assert "3 full" in text

@@ -314,3 +314,61 @@ def test_hardened_mailbox_freezes_consumer_view_and_spares_depositor():
     assert float(stored["w"][0]) == 1.0  # snapshot taken BEFORE the 9.0
     with pytest.raises(ValueError, match="read-only"):
         stored["w"][0] = 3.0
+
+
+# ---------------------------------------------------------------------------
+# the device trajectory ring (ISSUE 13)
+# ---------------------------------------------------------------------------
+
+
+def test_device_ring_exerciser_sweeps_clean_with_poison():
+    """Actor-enqueue vs learner-gather interleavings over the REAL
+    DeviceTrajRing (jitted enqueue + device gather) sweep clean under
+    the leased-slot poisoner."""
+    out = racesan.exercise_sweep(
+        range(6), lambda s: racesan.exercise_device_ring(s, poison=True)
+    )
+    assert out["races"] == 0
+    assert out["consumed"] > 0
+
+
+def test_device_ring_exerciser_replays_bit_identically():
+    a = racesan.exercise_device_ring(5, poison=True)
+    b = racesan.exercise_device_ring(5, poison=True)
+    assert a["consumed"] == b["consumed"]
+    assert a["trace_len"] == b["trace_len"]
+
+
+def test_device_ring_buggy_writer_is_caught_at_the_claim_site():
+    """Reverting the leased-slot protection (drop-oldest reclaims a
+    slot the learner still holds) trips the ring poisoner at the claim
+    site on EVERY schedule — the device-plane write-after-publish
+    class."""
+    for seed in range(3):
+        with pytest.raises(RacesanError, match="LEASED slot"):
+            racesan.exercise_device_ring(
+                seed, poison=True, buggy_writer=True
+            )
+
+
+def test_device_ring_release_before_read_is_detected():
+    """The alias-class consumer (release, THEN read the slot) lets a
+    drop-oldest overwrite land under the live read — the value check
+    catches it within a short seed sweep, and the detecting seed
+    replays."""
+    detected = None
+    for seed in range(16):
+        try:
+            racesan.exercise_device_ring(
+                seed, poison=True, consumer="released",
+                blocks_per_producer=4, depth=1,
+            )
+        except RacesanError:
+            detected = seed
+            break
+    assert detected is not None, "no schedule exposed the stale read"
+    with pytest.raises(RacesanError):
+        racesan.exercise_device_ring(
+            detected, poison=True, consumer="released",
+            blocks_per_producer=4, depth=1,
+        )

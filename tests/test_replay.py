@@ -136,6 +136,52 @@ class TestSampling:
         np.testing.assert_allclose(np.diff(r, axis=1), np.ones((64, 2)))
         assert r.min() >= 4.0 and r.max() <= 11.0
 
+    def test_sample_sequences_episode_boundary_contract(self):
+        """Contract point 2 (ISSUE 13, pinned before the R2D2-style
+        consumer builds on it): windows MAY span episode boundaries and
+        are returned UNMODIFIED — the stored done flags arrive intact,
+        and masking is the consumer's job (the shared alive-before-done
+        convention: the done step is the last valid step of its
+        episode)."""
+        ex = {**_example(), "done": jnp.zeros((), jnp.float32)}
+        state = replay.init(ex, capacity=32)
+        done = np.zeros(16, np.float32)
+        done[5] = 1.0  # an episode ends at insert 5
+        b = _batch(np.arange(16), 16)
+        b["done"] = jnp.asarray(done)
+        state = replay.add_batch(state, b)
+        out = replay.sample_sequences(state, jax.random.key(4), 128, 4)
+        r = np.asarray(out["reward"])
+        d = np.asarray(out["done"])
+        # Windows are still consecutive inserts even when they contain
+        # the boundary, and the done flag rides exactly where stored.
+        np.testing.assert_allclose(np.diff(r, axis=1), np.ones((128, 3)))
+        np.testing.assert_array_equal(d, (r == 5.0).astype(np.float32))
+        # Some sampled window genuinely spans the boundary (done NOT in
+        # the final slot), so the contract is exercised, not vacuous.
+        spans = d[:, :-1].sum() > 0
+        assert spans
+        # The in-tree consumer convention cuts contributions after the
+        # done: mask == alive-before-done (device_replay shares this
+        # with ddpg.nstep_batch — tested against each other there).
+        from actor_critic_tpu.data_plane import device_replay
+
+        mask = np.asarray(
+            device_replay.sequence_window_mask(jnp.asarray(d))
+        )
+        after_done = (np.cumsum(d, axis=1) - d) > 0
+        np.testing.assert_array_equal(mask == 0.0, after_done)
+
+    def test_sample_sequences_never_clamps_into_unwritten_slots(self):
+        """Contract's caller obligation, enforced by construction for
+        size >= seq_len: max_start keeps every window inside the valid
+        region, so no sampled row reads a zero-initialized slot."""
+        state = replay.init(_example(), capacity=64)
+        state = replay.add_batch(state, _batch(np.arange(1, 9), 8))
+        out = replay.sample_sequences(state, jax.random.key(5), 64, 8)
+        r = np.asarray(out["reward"])
+        assert r.min() >= 1.0  # zero-filled slots would read 0.0
+
 
 class TestDonation:
     def test_inplace_update_under_donation(self):

@@ -580,6 +580,8 @@ def run_host_async(pools, preset, args, logger) -> dict:
                 queue_depth=args.queue_depth,
                 max_staleness=resolve_staleness(args, "ppo"),
                 correction=args.async_correction,
+                data_plane=args.data_plane,
+                plane_codec=args.data_plane_codec,
                 ckpt=ckpt, save_every=args.save_every, resume=args.resume,
             )
         else:
@@ -595,6 +597,8 @@ def run_host_async(pools, preset, args, logger) -> dict:
                 eval_steps=args.eval_steps,
                 queue_depth=args.queue_depth,
                 max_staleness=resolve_staleness(args, preset.algo),
+                data_plane=args.data_plane,
+                plane_codec=args.data_plane_codec,
             )
     finally:
         if ckpt is not None:
@@ -777,6 +781,29 @@ def main(argv=None) -> int:
         "queue recycles its oldest block's slot for the incoming one)",
     )
     p.add_argument(
+        "--data-plane", choices=("host", "device"), default="host",
+        help="async mode: where trajectory blocks live between actor "
+        "and learner (actor_critic_tpu/data_plane/). 'host' (default) "
+        "is the PR 6 numpy TrajQueue — one host→device transfer per "
+        "consumed block on the learner thread; 'device' stages encoded "
+        "blocks in a donated HBM ring at collection time (actor-side "
+        "put of already-encoded bytes) and the learner gathers+decodes "
+        "INSIDE its jitted update — zero steady-state host→device "
+        "transfers per consumed block. Never flip it on a resumed run "
+        "(the save trees differ).",
+    )
+    p.add_argument(
+        "--data-plane-codec", choices=("fp32", "f16", "int8"),
+        default="fp32",
+        help="device data plane: per-key block codec "
+        "(data_plane/codecs.py). fp32 = raw (bitwise-equal to the host "
+        "plane at depth 1); f16 halves observation bytes; int8 "
+        "standardizes obs + rewards to calibrated int8 and packs the "
+        "flags (~4x smaller enqueue on obs-dominated blocks). Behavior "
+        "log-probs/values/actions always stay raw — quantizing them "
+        "would bias the V-trace correction itself.",
+    )
+    p.add_argument(
         "--async-correction", choices=("vtrace", "none"), default="vtrace",
         help="async mode: staleness correction — 'vtrace' (clipped "
         "importance-weighted targets under the learner's params, "
@@ -951,6 +978,25 @@ def main(argv=None) -> int:
         # weights-recording no-op, which the user asked for).
         preset.env_kwargs.setdefault("redraw_types", True)
 
+    if args.data_plane == "device":
+        # The data plane is the actor→learner hand-off: without actor
+        # services there is no queue to relocate, and the multi-host
+        # learner shard_maps HOST arrays into the global batch — exit
+        # with advice before any env or device work.
+        if args.async_actors <= 0:
+            raise SystemExit(
+                "--data-plane device relocates the async actor–learner "
+                "hand-off into HBM — pass --async-actors N (the lockstep "
+                "pipeline has no trajectory queue to relocate)"
+            )
+        if args.distributed:
+            raise SystemExit(
+                "--data-plane device is single-host for now: the "
+                "--distributed sync learner builds its global batch from "
+                "host arrays (make_array_from_process_local_data) — drop "
+                "--distributed or use --data-plane host"
+            )
+
     if args.distributed:
         # Every doomed flag combination exits HERE, before the blocking
         # coordinator handshake below (a misconfigured fleet member
@@ -1118,6 +1164,9 @@ def main(argv=None) -> int:
             resume=args.resume,
             async_actors=args.async_actors,
             async_correction=args.async_correction,
+            data_plane=args.data_plane,
+            plane_codec=args.data_plane_codec,
+            queue_depth=args.queue_depth,
         )
         plan = compile_cache.plan_warmup(ctx)
         if plan:
