@@ -39,7 +39,7 @@ from actor_critic_tpu.algos.metrics import aggregate_metrics
 from actor_critic_tpu.envs.jax_env import JaxEnv
 from actor_critic_tpu.models.networks import ActorCriticDiscrete, ActorCriticGaussian
 from actor_critic_tpu.ops.pallas_scan import gae_auto as gae
-from actor_critic_tpu.ops.returns import normalize_advantages
+from actor_critic_tpu.ops.returns import LOG_RATIO_CAP, normalize_advantages
 from actor_critic_tpu.parallel import mesh as pmesh
 from actor_critic_tpu.utils import compile_cache as _compile_cache
 
@@ -157,7 +157,11 @@ def ppo_loss(
         adv = normalize_advantages(adv, axis_name)
 
     log_ratio = log_prob - batch.log_prob_old
-    ratio = jnp.exp(log_ratio)
+    # LOG_RATIO_CAP (ISSUE 14): an unbounded ratio exp overflows to inf
+    # under policy drift and inf × 0 advantage is nan — clipping the
+    # RATIO two lines down is too late (the inf already happened). The
+    # cap is bit-identical for every in-range ratio.
+    ratio = jnp.exp(jnp.minimum(log_ratio, LOG_RATIO_CAP))
     surr1 = ratio * adv
     surr2 = jnp.clip(ratio, 1.0 - clip_eps, 1.0 + clip_eps) * adv
     pg_loss = -jnp.mean(jnp.minimum(surr1, surr2))

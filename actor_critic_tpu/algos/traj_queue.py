@@ -60,6 +60,8 @@ from typing import Any, Callable, NamedTuple, Optional
 
 import numpy as np
 
+from actor_critic_tpu.utils import numguard
+
 
 class TrajBlock(NamedTuple):
     """One queued trajectory block: fixed-shape numpy arrays plus the
@@ -374,6 +376,16 @@ class PolicyPublisher:
         self._version = int(version)
 
     def publish(self, params: Any, version: int) -> None:
+        # Finiteness gate (ISSUE 14): published behavior params drive
+        # EVERY actor's next blocks — a nan/inf publish poisons each
+        # collected trajectory and, through the importance ratios, the
+        # learner itself. The refusal raises OUT of the learner loop
+        # (a diverged learner must halt loudly AT the publish boundary,
+        # not train on); what the gate guarantees is containment — the
+        # poisoned tree is never installed, so the snapshot actors and
+        # any post-mortem reader see is the last good one.
+        numguard.check_finite(params, "behavior-params publish",
+                              name="params")
         snapshot = _snapshot_frozen(params)  # copy OUTSIDE the lock
         with self._cv:
             self._params = snapshot

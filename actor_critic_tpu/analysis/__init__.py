@@ -1,33 +1,51 @@
 """jaxlint: repo-wide JAX correctness analyzer (ISSUE 5, extended with
-concurrency passes + the racesan runtime sanitizer in ISSUE 7).
+concurrency passes + racesan in ISSUE 7, distributed passes + fleetsan
+in ISSUE 12, and numerics passes + numsan in ISSUE 14).
 
 AST-based static analysis over this repo's JAX code — pure stdlib
 `ast`, no new dependencies, and (except the `warmup-registry` pass,
-which validates against the live registry) no imports of the code it
-scans. Nine registered passes, each grounded in a failure this codebase
+which validates against the live registry, and the numerics passes'
+optional `jax.eval_shape` grounding) no imports of the code it scans.
+Fifteen registered passes, each grounded in a failure this codebase
 actually hit or observes at runtime:
 
-    donation-aliasing   donated jit args fed restore-aliased/still-live
-                        buffers (the PR 4 glibc heap corruption)
-    tracer-leak         Python if/while/assert/bool() on traced values
-    prng-reuse          one PRNG key consumed twice without split
-    recompile-hazard    jit built in loops; shape-/len()-derived scalars
-                        at jitted call sites (the PR 3 recompile storms)
-    host-sync           device syncs inside hot collection loops
-    warmup-registry     jax.jit entry points without AOT warmup planners
-                        (ISSUE 4's lint, folded in)
-    lock-discipline     compound writes to cross-thread shared state
-                        outside a lock (the PR 6 span-stack corruption;
-                        thread model in analysis/thread_model.py)
-    publish-aliasing    ndarray views of recycled slots crossing thread
-                        channels / aliased past release (the PR 6
-                        zero-copy queue race)
-    check-then-act      unlocked read-test-write windows on shared
-                        flags/counters
+    donation-aliasing     donated jit args fed restore-aliased/still-
+                          live buffers (the PR 4 glibc heap corruption)
+    tracer-leak           Python if/while/assert/bool() on traced values
+    prng-reuse            one PRNG key consumed twice without split
+    recompile-hazard      jit built in loops; shape-/len()-derived
+                          scalars at jitted call sites
+    host-sync             device syncs inside hot collection loops
+    warmup-registry       jax.jit entry points without AOT warmup
+                          planners (ISSUE 4's lint, folded in)
+    lock-discipline       compound writes to cross-thread shared state
+                          outside a lock (thread_model.py)
+    publish-aliasing      ndarray views of recycled slots crossing
+                          thread channels / aliased past release
+    check-then-act        unlocked read-test-write windows on shared
+                          flags/counters
+    collective-discipline undeclared axis names; collectives gated on
+                          process-local state (process_model.py)
+    mailbox-protocol      gossip-mailbox write→fsync→rename discipline,
+                          torn-read tolerance, per-peer clocks
+    rank-affinity         shared artifact paths unparameterized by
+                          process identity in per-rank scopes
+    precision-discipline  device float64; silent bf16/f32 mixing;
+                          low-precision reductions without an fp32
+                          accumulator; codec decode dtype forks
+                          (dtype_model.py)
+    nonfinite-hazard      unguarded log/sqrt/arctanh/division, exp of
+                          unbounded log-ratios, bare-constant scale
+                          seeds (the PR 8 class)
+    sink-guard            json.dumps(allow_nan=False) writers and
+                          commit points (checkpoint/mailbox/publish/
+                          swap) without a finiteness gate
 
-Runtime companion: `analysis/racesan.py` — seeded cooperative-schedule
-exerciser + write-after-publish poisoner (`scripts/racesan.py`,
-tier-1's quick profile).
+Runtime companions, each gating tier-1 under its own timeout:
+`analysis/racesan.py` (seeded cooperative-schedule race exerciser),
+`analysis/fleetsan.py` (seeded multi-process chaos), and
+`analysis/numsan.py` (seeded NaN/Inf/saturation fault injection over
+the real update/codec/publish/checkpoint objects).
 
 CLI: `python scripts/jaxlint.py` (tier-1-gated via
 tests/test_jaxlint.py and scripts/tier1.sh). Per-line suppression:

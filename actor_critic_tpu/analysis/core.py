@@ -367,6 +367,7 @@ def _ensure_builtin_checks() -> None:
         distributed,
         donation,
         host_sync,
+        numerics,
         prng,
         recompile,
         tracer_leak,

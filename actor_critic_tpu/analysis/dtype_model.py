@@ -1,0 +1,661 @@
+"""Whole-repo dtype-flow model for the numerics passes (ISSUE 14).
+
+PRs 5/7/12 each gave the analysis layer a dimension (JAX correctness →
+threads → processes) by pairing a derived MODEL with the passes that
+consult it; this module is the numerics dimension's model, the sibling
+of `thread_model.py`/`process_model.py`. From `ast` alone it derives:
+
+- **Per-function dtype environments** — name → dtype token, propagated
+  in statement order through `astype`, dtype-carrying constructors
+  (`jnp.zeros(shape, jnp.bfloat16)`, `dtype=` kwargs), elementwise
+  dtype-preserving calls (`clip`/`where`/`maximum`/reductions), binops
+  under a jax-promotion lattice, and python float literals (WEAK-typed:
+  `0.5 * bf16_x` stays bf16 — weak scalars must never read as f32
+  mixing).
+- **Guard facts** — whether an expression is provably guarded against
+  the non-finite producing classes: positive-floored for `log`/division
+  (eps-add, `clip(lo>0)`, `maximum(·, eps)`, `_EPS`-named floors, the
+  `where`-select idiom), non-negative for `sqrt` (`var`/`square`/`x*x`
+  producers), bounded for `exp`/`arctanh` (`clip`/`minimum` caps),
+  resolved one assignment hop through the local environment.
+- **Sink inventory** — `json.dumps(..., allow_nan=False)` call sites
+  and the known-fragile commit-point defs (`write_params`, `publish`,
+  `swap`, `save` taking a params/state tree) together with whether a
+  finiteness gate (`check_finite`/`isfinite`/`nonfinite*`) is present
+  in the body — the facts the `sink-guard` pass consumes.
+
+**eval_shape grounding** (`grounded_return_dtypes`): the one
+non-AST-only fact source, mirroring the warmup-registry pass's
+exception: when the scanned tree is the live repo, the model probes the
+REAL codec/return-math functions with canonical abstract arg trees
+through `jax.eval_shape` (trace-only — no compile, milliseconds) and
+records their measured output dtypes, e.g. that `quantize.decode`
+returns float32 for every codec kind EXCEPT `raw` (which passes the
+storage dtype through). The precision pass uses this to report codec
+dtype forks as measured facts rather than AST guesses; import/probe
+failures degrade to AST-only silently (the lint must run anywhere).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from actor_critic_tpu.analysis.core import ModuleInfo, target_names
+
+# ---------------------------------------------------------------------------
+# dtype lattice
+# ---------------------------------------------------------------------------
+
+FLOAT_TOKENS = ("f64", "f32", "bf16", "f16")
+LOW_PRECISION = ("bf16", "f16")
+
+_TOKEN_BY_NAME = {
+    "float64": "f64", "double": "f64",
+    "float32": "f32", "single": "f32",
+    "bfloat16": "bf16",
+    "float16": "f16", "half": "f16",
+    "int8": "i8", "int16": "i16", "int32": "i32", "int64": "i64",
+    "uint8": "u8", "uint32": "u32",
+    "bool_": "bool", "bool": "bool",
+}
+
+_ARRAY_MODULES = ("numpy", "jax.numpy", "ml_dtypes")
+
+# Constructors whose result dtype is the dtype argument (positional
+# index of the dtype arg, when it has one).
+_CONSTRUCTORS = {
+    "zeros": 1, "ones": 1, "empty": 1, "arange": None,
+    "array": 1, "asarray": 1, "full": 2,
+    "zeros_like": None, "ones_like": None, "full_like": None,
+}
+
+# Elementwise/reshaping calls that preserve their first array operand's
+# dtype (reductions included — `jnp.sum` of a bf16 operand ACCUMULATES
+# in bf16 unless dtype= overrides, which is exactly the hazard the
+# precision pass flags).
+_PRESERVING = {
+    "clip", "abs", "maximum", "minimum", "round", "nan_to_num",
+    "negative", "transpose", "reshape", "squeeze", "expand_dims",
+    "sum", "mean", "max", "min", "prod", "var", "std",
+    "dot", "matmul", "tanh_like",
+}
+
+# where(cond, x, y): result promotes x and y.
+_SELECTS = {"where"}
+
+
+def promote(a: Optional[str], b: Optional[str]) -> Optional[str]:
+    """Jax-style promotion over the token lattice (weak python scalars
+    preserve the array operand's dtype). None = not statically known."""
+    if a is None or b is None:
+        return None
+    if a == b:
+        return a
+    weak = {"pyfloat", "pyint"}
+    if a in weak and b in weak:
+        return "pyfloat" if "pyfloat" in (a, b) else "pyint"
+    if a in weak:
+        return b if (a == "pyint" or b in FLOAT_TOKENS) else None
+    if b in weak:
+        return a if (b == "pyint" or a in FLOAT_TOKENS) else None
+    if a in FLOAT_TOKENS and b in FLOAT_TOKENS:
+        if "f64" in (a, b):
+            return "f64"
+        if "f32" in (a, b):
+            return "f32"
+        # bf16 × f16 promotes to f32 (neither is a superset)
+        return "f32" if {a, b} == {"bf16", "f16"} else a
+    if a in FLOAT_TOKENS:
+        return a
+    if b in FLOAT_TOKENS:
+        return b
+    return None  # int×int details are irrelevant to these passes
+
+
+def _call_name(node: ast.Call) -> Optional[str]:
+    if isinstance(node.func, ast.Name):
+        return node.func.id
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    return None
+
+
+def dtype_token(mod: ModuleInfo, expr: Optional[ast.AST]) -> Optional[str]:
+    """The dtype a dtype-position expression denotes: `jnp.bfloat16`,
+    `np.float32`, `"bfloat16"`, a module constant bound to either, or a
+    `jnp.dtype(...)` wrapper. None when not statically resolvable (a
+    parameter, an IfExp — the repo's `bf16_compute` selection is
+    DELIBERATELY unresolvable: both arms are possible)."""
+    if expr is None:
+        return None
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return _TOKEN_BY_NAME.get(expr.value)
+    if isinstance(expr, ast.Attribute):
+        dotted = mod.dotted(expr)
+        if dotted is None:
+            return None
+        head, _, tail = dotted.rpartition(".")
+        if head in _ARRAY_MODULES or head.endswith(".numpy"):
+            return _TOKEN_BY_NAME.get(tail)
+        return None
+    if isinstance(expr, ast.Call) and _call_name(expr) == "dtype":
+        return dtype_token(mod, expr.args[0]) if expr.args else None
+    if isinstance(expr, ast.Name):
+        binding = _module_const(mod, expr.id)
+        if binding is not None:
+            return dtype_token(mod, binding)
+    return None
+
+
+def _module_const(mod: ModuleInfo, name: str) -> Optional[ast.AST]:
+    for stmt in mod.tree.body:
+        if isinstance(stmt, ast.Assign):
+            if any(name in target_names(t) for t in stmt.targets):
+                return stmt.value
+    return None
+
+
+def _dtype_arg(mod: ModuleInfo, call: ast.Call) -> Optional[str]:
+    """The resolved dtype= (kwarg or positional) of a constructor/
+    reduction call, None when absent/unresolvable."""
+    for kw in call.keywords:
+        if kw.arg == "dtype":
+            return dtype_token(mod, kw.value)
+    name = _call_name(call)
+    pos = _CONSTRUCTORS.get(name or "")
+    if pos is not None and len(call.args) > pos:
+        return dtype_token(mod, call.args[pos])
+    return None
+
+
+def _is_array_api(mod: ModuleInfo, call: ast.Call) -> bool:
+    """Whether the call targets the numpy / jax.numpy namespace (either
+    directly or via the jnp/np aliases)."""
+    if not isinstance(call.func, ast.Attribute):
+        return False
+    base = call.func.value
+    dotted = mod.dotted(base) if not isinstance(base, ast.Call) else None
+    return dotted in ("numpy", "jax.numpy") or (
+        dotted is not None and dotted.endswith(".numpy")
+    )
+
+
+class DtypeEnv:
+    """One scope's name → dtype-token environment, in statement order
+    to fixpoint (2 passes cover the chains these passes flag)."""
+
+    def __init__(self, mod: ModuleInfo, scope: ast.AST):
+        self.mod = mod
+        self.scope = scope
+        self.names: dict[str, Optional[str]] = {}
+        for _ in range(2):
+            for node in ast.walk(scope):
+                if isinstance(node, ast.Assign):
+                    token = self.expr_dtype(node.value)
+                    if token is None:
+                        continue
+                    for tgt in node.targets:
+                        for name in target_names(tgt):
+                            self.names[name] = token
+                elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                    token = self.expr_dtype(node.value)
+                    if token is not None and isinstance(node.target, ast.Name):
+                        self.names[node.target.id] = token
+
+    def expr_dtype(self, expr: ast.AST) -> Optional[str]:
+        """Statically-known dtype token of an expression, else None."""
+        if isinstance(expr, ast.Constant):
+            if isinstance(expr.value, bool):
+                return "bool"
+            if isinstance(expr.value, float):
+                return "pyfloat"
+            if isinstance(expr.value, int):
+                return "pyint"
+            return None
+        if isinstance(expr, ast.Name):
+            return self.names.get(expr.id)
+        if isinstance(expr, ast.UnaryOp):
+            return self.expr_dtype(expr.operand)
+        if isinstance(expr, ast.BinOp):
+            return promote(
+                self.expr_dtype(expr.left), self.expr_dtype(expr.right)
+            )
+        if isinstance(expr, ast.Call):
+            name = _call_name(expr)
+            if name == "astype":
+                return dtype_token(
+                    self.mod, expr.args[0] if expr.args else None
+                )
+            explicit = _dtype_arg(self.mod, expr)
+            if explicit is not None:
+                return explicit
+            if name in _CONSTRUCTORS and _is_array_api(self.mod, expr):
+                return None  # dtype defaulted/unresolved: unknown
+            if name in _SELECTS and len(expr.args) >= 3:
+                return promote(
+                    self.expr_dtype(expr.args[1]),
+                    self.expr_dtype(expr.args[2]),
+                )
+            if name in _PRESERVING and expr.args:
+                return self.expr_dtype(expr.args[0])
+            return None
+        return None
+
+
+# ---------------------------------------------------------------------------
+# guard facts (nonfinite-hazard's provability layer)
+# ---------------------------------------------------------------------------
+
+def _is_eps_name(node: ast.AST) -> bool:
+    name = None
+    if isinstance(node, ast.Name):
+        name = node.id
+    elif isinstance(node, ast.Attribute):
+        name = node.attr
+    return name is not None and "eps" in name.lower()
+
+
+def _small_positive_const(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant) and isinstance(
+        node.value, (int, float)
+    ) and not isinstance(node.value, bool):
+        return 0 < float(node.value) <= 1.0
+    return False
+
+
+def _positive_const(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant) and isinstance(
+        node.value, (int, float)
+    ) and not isinstance(node.value, bool):
+        return float(node.value) > 0
+    return False
+
+
+# Calls whose result is non-negative by construction (sqrt guards).
+_NONNEG_CALLS = {"var", "square", "abs", "softplus", "relu", "exp"}
+# Calls that bound their operand (exp/arctanh guards).
+_BOUNDING_CALLS = {"clip", "minimum", "maximum", "tanh", "log_softmax",
+                   "log_sigmoid", "nan_to_num"}
+
+
+class GuardFacts:
+    """Per-scope guard analysis: which expressions are provably safe
+    operands for log / sqrt / exp / arctanh / division."""
+
+    def __init__(self, mod: ModuleInfo, scope: ast.AST):
+        self.mod = mod
+        self.scope = scope
+
+    def _latest_binding(
+        self, name: str, before: int
+    ) -> Optional[ast.AST]:
+        latest, latest_line = None, -1
+        for node in ast.walk(self.scope):
+            if not isinstance(node, ast.Assign):
+                continue
+            if node.lineno >= before:
+                continue
+            if any(name in target_names(t) for t in node.targets):
+                if node.lineno > latest_line:
+                    latest, latest_line = node.value, node.lineno
+        return latest
+
+    def _resolve(self, expr: ast.AST, depth: int) -> ast.AST:
+        if depth > 0 and isinstance(expr, ast.Name):
+            bound = self._latest_binding(expr.id, expr.lineno)
+            if bound is not None:
+                return bound
+        return expr
+
+    def positive_floored(self, expr: ast.AST, depth: int = 2) -> bool:
+        """Provably bounded away from 0/negative: `x + eps`,
+        `clip(x, lo>0, ...)`, `maximum(x, eps)`, an eps-name, a positive
+        constant, or a name assigned from one of those."""
+        expr = self._resolve(expr, depth)
+        if _positive_const(expr) or _is_eps_name(expr):
+            return True
+        if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+            return any(
+                _is_eps_name(s) or _small_positive_const(s)
+                for s in (expr.left, expr.right)
+            ) or any(
+                self.positive_floored(s, depth - 1)
+                for s in (expr.left, expr.right)
+            )
+        if isinstance(expr, ast.Call):
+            name = _call_name(expr)
+            if name == "clip" and len(expr.args) >= 2:
+                lo = expr.args[1]
+                return _positive_const(lo) or _is_eps_name(lo)
+            if name in ("sum", "mean"):
+                # log-sum-exp: a sum/mean OVER exp terms is positive
+                # (the max-shifted spelling guarantees a 1.0 term).
+                operand = expr.args[0] if expr.args else (
+                    expr.func.value
+                    if isinstance(expr.func, ast.Attribute)
+                    else None
+                )
+                if isinstance(operand, ast.Call) and _call_name(
+                    operand
+                ) == "exp":
+                    return True
+            if name in ("maximum", "max") and len(expr.args) >= 2:
+                return any(
+                    _positive_const(a) or _is_eps_name(a)
+                    or self.positive_floored(a, depth - 1)
+                    for a in expr.args[:2]
+                )
+            if name in ("softplus", "exp"):
+                return True  # strictly positive by construction
+            if name in ("asarray", "array", "float32", "float64",
+                        "abs", "nan_to_num"):
+                # wrappers: look through to the payload (abs alone does
+                # NOT floor away from zero — only counts when its
+                # operand does, e.g. abs(x) + eps handled above)
+                if name == "abs":
+                    return False
+                return bool(expr.args) and self.positive_floored(
+                    expr.args[0], depth - 1
+                )
+        if isinstance(expr, ast.IfExp):
+            return self.positive_floored(
+                expr.body, depth - 1
+            ) and self.positive_floored(expr.orelse, depth - 1)
+        return False
+
+    def nonnegative(self, expr: ast.AST, depth: int = 2) -> bool:
+        """Provably >= 0 (the sqrt contract): var/square/abs/x**2/x*x
+        producers, non-negative constants, or floored expressions."""
+        expr = self._resolve(expr, depth)
+        if self.positive_floored(expr, 0):
+            return True
+        if isinstance(expr, ast.Constant) and isinstance(
+            expr.value, (int, float)
+        ) and not isinstance(expr.value, bool):
+            return float(expr.value) >= 0
+        if isinstance(expr, ast.BinOp):
+            if isinstance(expr.op, ast.Pow) and isinstance(
+                expr.right, ast.Constant
+            ) and expr.right.value == 2:
+                return True
+            if isinstance(expr.op, ast.Mult) and ast.dump(
+                expr.left
+            ) == ast.dump(expr.right):
+                return True
+            if isinstance(expr.op, ast.Add):
+                return all(
+                    self.nonnegative(s, depth - 1)
+                    for s in (expr.left, expr.right)
+                )
+        if isinstance(expr, ast.Call):
+            name = _call_name(expr)
+            if name in _NONNEG_CALLS:
+                return True
+            if name in ("maximum",) and expr.args:
+                return any(
+                    self.nonnegative(a, depth - 1) for a in expr.args[:2]
+                )
+            if name == "clip" and len(expr.args) >= 2:
+                lo = expr.args[1]
+                return isinstance(lo, ast.Constant) and isinstance(
+                    lo.value, (int, float)
+                ) and float(lo.value) >= 0
+        return False
+
+    def bounded(self, expr: ast.AST, depth: int = 2) -> bool:
+        """Provably range-bounded (the exp/arctanh contract): wrapped in
+        clip/minimum (or tanh for arctanh's inverse), or a name assigned
+        from one."""
+        expr = self._resolve(expr, depth)
+        if isinstance(expr, ast.Call):
+            name = _call_name(expr)
+            if name in _BOUNDING_CALLS:
+                return True
+        if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Sub):
+            # The max-shift idiom: `x - x.max(...)` is bounded above by
+            # zero — the stable softmax/logsumexp prelude.
+            right = expr.right
+            if isinstance(right, ast.Call) and _call_name(right) in (
+                "max", "amax"
+            ):
+                return True
+        if isinstance(expr, ast.BinOp) and isinstance(
+            expr.op, (ast.Mult, ast.Add, ast.Sub)
+        ):
+            # A scaled/shifted bounded value stays bounded when the
+            # non-constant side is.
+            sides = [expr.left, expr.right]
+            consts = [s for s in sides if isinstance(s, ast.Constant)]
+            if consts:
+                other = sides[0] if sides[1] in consts else sides[1]
+                return self.bounded(other, depth - 1)
+        return False
+
+    def log_diff(self, expr: ast.AST, depth: int = 2) -> bool:
+        """Whether the expression is an (unbounded) log-ratio: a
+        subtraction either side of which is `log`-named — the PPO /
+        V-trace importance-ratio shape whose exp overflows when the
+        behavior and target policies drift apart."""
+        expr = self._resolve(expr, depth)
+        if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Sub):
+            def mentions_log(side: ast.AST) -> bool:
+                for sub in ast.walk(side):
+                    name = None
+                    if isinstance(sub, ast.Name):
+                        name = sub.id
+                    elif isinstance(sub, ast.Attribute):
+                        name = sub.attr
+                    if name is None:
+                        continue
+                    low = name.lower()
+                    # "logits" are NOT log-probs: exp(x - x.max()) of a
+                    # logit shift is the stable-softmax idiom.
+                    if "log" in low and "logit" not in low:
+                        return True
+                return False
+
+            return mentions_log(expr.left) or mentions_log(expr.right)
+        return False
+
+
+# ---------------------------------------------------------------------------
+# sink inventory (sink-guard's facts)
+# ---------------------------------------------------------------------------
+
+SINK_DEF_NAMES = {"write_params", "publish", "swap", "save"}
+_TREE_PARAM_NAMES = {"params", "state", "snapshot", "tree", "payload"}
+_GATE_FRAGMENTS = ("check_finite", "isfinite", "nonfinite",
+                   "assert_finite")
+
+
+def dumps_sites(mod: ModuleInfo) -> list[ast.Call]:
+    """`json.dumps(..., allow_nan=False)` calls — the writer shape that
+    raises (and silently drops the row) on the first non-finite value."""
+    out = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if mod.dotted(node.func) != "json.dumps":
+            continue
+        for kw in node.keywords:
+            if kw.arg == "allow_nan" and isinstance(
+                kw.value, ast.Constant
+            ) and kw.value.value is False:
+                out.append(node)
+    return out
+
+
+def sink_defs(mod: ModuleInfo) -> list[tuple[ast.AST, bool]]:
+    """(def node, has_finiteness_gate) for every module-level function /
+    method named like a fragile commit point (`write_params`, `publish`,
+    `swap`, `save`) that takes a params/state tree. Nested defs are
+    excluded (racesan/fleetsan build scripted stand-ins inline — those
+    are exercisers, not commit points)."""
+    out: list[tuple[ast.AST, bool]] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name not in SINK_DEF_NAMES:
+            continue
+        parent = mod.parent(node)
+        if isinstance(parent, ast.ClassDef):
+            if not isinstance(mod.parent(parent), ast.Module):
+                continue
+        elif not isinstance(parent, ast.Module):
+            continue
+        args = node.args
+        names = {
+            a.arg
+            for a in args.posonlyargs + args.args + args.kwonlyargs
+        }
+        if not (names & _TREE_PARAM_NAMES):
+            continue
+        gated = any(
+            isinstance(sub, ast.Call)
+            and any(
+                frag in (_call_name(sub) or "")
+                for frag in _GATE_FRAGMENTS
+            )
+            for sub in ast.walk(node)
+        )
+        out.append((node, gated))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the repo-wide model
+# ---------------------------------------------------------------------------
+
+class DtypeModel:
+    """Derived once per lint run (the `_SHARED` idiom the concurrency /
+    distributed passes use); the three numerics checks consult it."""
+
+    def __init__(self, modules: list[ModuleInfo]):
+        self._modules = modules
+        self._envs: dict[int, DtypeEnv] = {}
+        self._guards: dict[int, GuardFacts] = {}
+
+    def env(self, mod: ModuleInfo, scope: ast.AST) -> DtypeEnv:
+        key = id(scope)
+        if key not in self._envs:
+            self._envs[key] = DtypeEnv(mod, scope)
+        return self._envs[key]
+
+    def guards(self, mod: ModuleInfo, scope: ast.AST) -> GuardFacts:
+        key = id(scope)
+        if key not in self._guards:
+            self._guards[key] = GuardFacts(mod, scope)
+        return self._guards[key]
+
+
+# ---------------------------------------------------------------------------
+# eval_shape grounding (lazy; tolerated to fail anywhere)
+# ---------------------------------------------------------------------------
+
+_GROUNDED: Optional[dict[str, str]] = None
+
+
+def _token_of(dtype) -> Optional[str]:
+    return _TOKEN_BY_NAME.get(str(dtype))
+
+
+def grounded_return_dtypes() -> dict[str, str]:
+    """Measured output dtypes of the live codec/return-math functions,
+    probed with canonical abstract arg trees through `jax.eval_shape`
+    (trace-only; no compile, no device). Keys are
+    '<module>.<function>[<variant>]'. Empty when jax or the live package
+    is unavailable — callers must degrade to AST-only facts. Cached per
+    process (one grounding per lint run)."""
+    global _GROUNDED
+    if _GROUNDED is not None:
+        return _GROUNDED
+    out: dict[str, str] = {}
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from actor_critic_tpu.replay import quantize
+
+        def sds(shape, dtype):
+            return jax.ShapeDtypeStruct(shape, dtype)
+
+        for kind in quantize.KINDS:
+            # `raw` probes with the uint8 pixel-obs storage — the case
+            # that makes the decode dtype genuinely fork on the codec
+            # (every non-raw kind decodes to float32; raw passes the
+            # storage dtype through untouched).
+            store = quantize.storage_dtype(
+                kind, jnp.uint8 if kind == "raw" else jnp.float32
+            )
+            stats = quantize.QuantStats(
+                mean=sds((), jnp.float32),
+                scale=sds((), jnp.float32),
+                count=sds((), jnp.int32),
+            )
+            try:
+                dec = jax.eval_shape(
+                    lambda s, q, k=kind: quantize.decode(k, s, q),
+                    stats, sds((4,), store),
+                )
+                token = _token_of(dec.dtype)
+                if token:
+                    out[f"quantize.decode[{kind}]"] = token
+                enc = jax.eval_shape(
+                    lambda s, x, k=kind, d=store: quantize.encode(
+                        k, s, x, d
+                    ),
+                    stats, sds((4,), jnp.float32),
+                )
+                token = _token_of(enc.dtype)
+                if token:
+                    out[f"quantize.encode[{kind}]"] = token
+            except Exception:
+                continue  # one probe failing must not lose the rest
+        try:
+            from actor_critic_tpu.ops import returns as _returns
+
+            adv = jax.eval_shape(
+                lambda r, v, d, b: _returns.gae(r, v, d, b, 0.99, 0.95),
+                sds((8, 2), jnp.float32), sds((8, 2), jnp.float32),
+                sds((8, 2), jnp.float32), sds((2,), jnp.float32),
+            )
+            leaves = jax.tree.leaves(adv)
+            if leaves:
+                token = _token_of(leaves[0].dtype)
+                if token:
+                    out["returns.gae"] = token
+        except Exception:
+            pass
+    except Exception:
+        out = {}
+    _GROUNDED = out
+    return out
+
+
+def codec_fork_evidence(fn_name: str) -> Optional[str]:
+    """When grounding is available and the named codec function's
+    measured output dtypes genuinely fork across kinds, a short
+    evidence string for the finding message; None otherwise."""
+    grounded = grounded_return_dtypes()
+    seen = {
+        key.split("[", 1)[1].rstrip("]"): tok
+        for key, tok in grounded.items()
+        if key.startswith(f"{fn_name}[")
+    }
+    if len(set(seen.values())) > 1:
+        pairs = ", ".join(f"{k}→{v}" for k, v in sorted(seen.items()))
+        return f"measured via jax.eval_shape: {pairs}"
+    return None
+
+
+def iter_scopes(mod: ModuleInfo) -> Iterable[ast.AST]:
+    """Top-level functions plus methods of top-level classes — the
+    statement-ordered units the numerics passes analyze."""
+    for node in mod.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield sub

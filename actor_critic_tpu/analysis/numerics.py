@@ -1,0 +1,578 @@
+"""Numerics passes: precision-discipline, nonfinite-hazard, sink-guard
+(ISSUE 14 tentpole, static half).
+
+The fourth analysis dimension (JAX correctness → threads → processes →
+NUMERICS), gating the ROADMAP's bf16/Pallas kernel direction: low-
+precision compute paths only land safely once the repo can prove where
+precision changes, where non-finites can be born, and where they would
+escape into durable/visible state. Each pass is grounded in a failure
+class this codebase hit or is one edit away from:
+
+- **precision-discipline** — silent dtype changes. (a) float64 on the
+  device namespace (CPU-silent, TPU-fatal: jax demotes or errors, and
+  an x64 path doubles every buffer). (b) bf16/f16 × f32 arithmetic
+  without an explicit astype: promotion silently discards the
+  low-precision intent (the bf16 path quietly computes in f32, so the
+  measured speedup is noise) or, reversed, quietly truncates. (c)
+  reductions over bf16/f16 operands without an fp32 accumulator
+  (`dtype=jnp.float32`): `jnp.sum` accumulates IN the operand dtype,
+  and a [4096]-element bf16 sum has ~8 bits of mantissa left — the
+  bf16-accumulator revert class. (d) codec decode paths whose output
+  dtype forks on the codec kind (measured through `jax.eval_shape` when
+  the live package is importable) — callers must normalize or every
+  downstream op's dtype depends on a config string.
+- **nonfinite-hazard** — where NaN/Inf are born. `log`/`sqrt`/
+  `arctanh`/division at sites whose operands are not provably guarded
+  (the model recognizes this repo's eps-add, `clip`, `maximum`-floor,
+  `where`-select and `_EPS` idioms and non-negative producers);
+  `exp` of an unbounded log-ratio (the PPO/V-trace importance-ratio
+  shape — behavior/target drift overflows it to inf, and inf × 0
+  advantage is NaN); and fresh `scale` seeds from bare constants (the
+  PR 8 class: a `1.0` seed destroys int8 resolution, a `0.0` seed
+  divides by zero — the `_EPS`-floor seed is the sanctioned idiom).
+- **sink-guard** — where non-finites escape. `json.dumps(...,
+  allow_nan=False)` raises on the first NaN and the writer drops the
+  row (the telemetry crash class — route through
+  `utils.numguard.safe_json_row`); commit-point defs (`write_params`,
+  `publish`, `swap`, `save` taking a params/state tree) must carry a
+  finiteness gate (`numguard.check_finite`) so a poisoned tree is
+  refused before it becomes durable (checkpoint), fleet-visible
+  (mailbox), or client-visible (gateway swap).
+
+Runtime companion: `analysis/numsan.py` poisons real trees through the
+REAL update/codec/publish/checkpoint objects and asserts the guards
+these passes require statically actually fire (`scripts/numsan.py`,
+tier-1's quick profile between fleetsan and pytest).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from actor_critic_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    register_check,
+)
+from actor_critic_tpu.analysis.dtype_model import (
+    LOW_PRECISION,
+    DtypeModel,
+    _call_name,
+    codec_fork_evidence,
+    dumps_sites,
+    dtype_token,
+    iter_scopes,
+    sink_defs,
+)
+
+PRECISION_DISCIPLINE = "precision-discipline"
+NONFINITE_HAZARD = "nonfinite-hazard"
+SINK_GUARD = "sink-guard"
+
+# Single-entry shared-model cache (the concurrency/distributed passes'
+# `_SHARED` idiom): three registered checks, one DtypeModel per run.
+_SHARED: dict = {}
+
+
+def _shared_model(modules: list[ModuleInfo]) -> DtypeModel:
+    key = tuple(id(m) for m in modules)
+    entry = _SHARED.get("entry")
+    if entry is not None and entry[0] == key:
+        return entry[1]
+    model = DtypeModel(modules)
+    _SHARED["entry"] = (key, model, list(modules))
+    return model
+
+
+_ARITH_OPS = (ast.Add, ast.Sub, ast.Mult, ast.Div, ast.MatMult, ast.Pow)
+_ACCUMULATING = {"sum", "mean", "var", "std", "prod", "dot", "matmul"}
+# Reductions that hit exactly zero on degenerate input (a constant
+# batch, an all-false mask, zeroed weights). max/min and wall-clock
+# differences are deliberately absent — host timing quotients are not
+# this hazard class.
+_REDUCERS = {"sum", "mean", "var", "std", "norm", "count_nonzero"}
+_LOG_CALLS = {"log", "log2", "log10"}
+
+
+def _bare_names(expr: ast.AST) -> set[str]:
+    """Bare (non-attribute-base) Name loads in an expression: `x` in
+    `f(x)` counts, `cfg` in `cfg.init_alpha` does not — attribute reads
+    are out-of-scope provenance the model never resolves (assumption
+    shared with the thread model)."""
+    attr_bases = {
+        id(sub.value)
+        for sub in ast.walk(expr)
+        if isinstance(sub, ast.Attribute)
+    }
+    return {
+        sub.id
+        for sub in ast.walk(expr)
+        if isinstance(sub, ast.Name)
+        and isinstance(sub.ctx, ast.Load)
+        and id(sub) not in attr_bases
+    }
+
+
+def _opaque(mod: ModuleInfo, scope: ast.AST, expr: ast.AST) -> bool:
+    """Attribute/constant-only provenance: nothing in the expression is
+    a locally-visible value, so guardedness cannot be judged here —
+    stay silent (the flagging passes only fire on in-scope evidence)."""
+    return not _bare_names(expr)
+
+
+# ---------------------------------------------------------------------------
+# precision-discipline
+# ---------------------------------------------------------------------------
+
+
+@register_check(
+    PRECISION_DISCIPLINE,
+    "device float64; silent bf16/f16-with-f32 arithmetic; reductions "
+    "over low-precision operands without an fp32 accumulator; codec "
+    "decode dtypes forking on the codec kind",
+    scope="repo",
+)
+def check_precision_discipline(
+    modules: list[ModuleInfo],
+) -> list[Finding]:
+    model = _shared_model(modules)
+    findings: list[Finding] = []
+    for mod in modules:
+        findings.extend(_f64_findings(mod))
+        for scope in iter_scopes(mod):
+            env = model.env(mod, scope)
+            for node in ast.walk(scope):
+                if isinstance(node, ast.BinOp) and isinstance(
+                    node.op, _ARITH_OPS
+                ):
+                    left = env.expr_dtype(node.left)
+                    right = env.expr_dtype(node.right)
+                    pair = {left, right}
+                    if pair & set(LOW_PRECISION) and pair & {"f32", "f64"}:
+                        findings.append(
+                            Finding(
+                                PRECISION_DISCIPLINE, mod.relpath,
+                                node.lineno, node.col_offset,
+                                f"mixed-precision arithmetic: {left} "
+                                f"with {right} promotes silently — the "
+                                "low-precision side either upcasts "
+                                "(the bf16 compute path quietly runs "
+                                "in f32 and the measured speedup is "
+                                "noise) or the result truncates on the "
+                                "next narrow store; make the intent "
+                                "explicit with .astype at this site",
+                                mod.enclosing_function(node),
+                            )
+                        )
+                if isinstance(node, ast.Call):
+                    findings.extend(
+                        _accumulator_findings(mod, env, node)
+                    )
+            findings.extend(_fork_findings(mod, model, scope))
+    findings.sort(key=lambda f: (f.path, f.line, f.col))
+    return findings
+
+
+def _f64_findings(mod: ModuleInfo) -> list[Finding]:
+    """Device-namespace float64: jnp constructors with a float64 dtype
+    and .astype(jnp.float64). Host-side numpy float64 (the env pools'
+    Welford normalizers, gymnasium-native obs) is deliberate and out of
+    scope."""
+    out: list[Finding] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        flagged = False
+        name = _call_name(node)
+        if name == "astype" and node.args:
+            if mod.dotted(node.args[0]) == "jax.numpy.float64":
+                flagged = True
+        elif isinstance(node.func, ast.Attribute):
+            base = mod.dotted(node.func.value)
+            if base == "jax.numpy":
+                for kw in node.keywords:
+                    if kw.arg == "dtype" and dtype_token(
+                        mod, kw.value
+                    ) == "f64":
+                        flagged = True
+                from actor_critic_tpu.analysis.dtype_model import (
+                    _CONSTRUCTORS,
+                )
+
+                pos = _CONSTRUCTORS.get(name or "")
+                if pos is not None and len(node.args) > pos and (
+                    dtype_token(mod, node.args[pos]) == "f64"
+                ):
+                    flagged = True
+        if flagged:
+            out.append(
+                Finding(
+                    PRECISION_DISCIPLINE, mod.relpath,
+                    node.lineno, node.col_offset,
+                    "float64 on the device namespace: without "
+                    "jax_enable_x64 this silently demotes to f32 (the "
+                    "annotation lies), and WITH it every touched "
+                    "buffer doubles and TPUs fall off the fast path — "
+                    "keep f64 on host numpy (the Welford-normalizer "
+                    "idiom) and device arrays at f32 or below",
+                    mod.enclosing_function(node),
+                )
+            )
+    return out
+
+
+def _accumulator_findings(
+    mod: ModuleInfo, env, node: ast.Call
+) -> list[Finding]:
+    name = _call_name(node)
+    if name not in _ACCUMULATING:
+        return []
+    if any(kw.arg == "dtype" for kw in node.keywords):
+        return []  # explicit accumulator: the sanctioned idiom
+    operand: Optional[ast.AST] = None
+    if node.args:
+        operand = node.args[0]
+    elif isinstance(node.func, ast.Attribute):
+        operand = node.func.value  # x.sum() method spelling
+    if operand is None:
+        return []
+    token = env.expr_dtype(operand)
+    if token not in LOW_PRECISION:
+        return []
+    return [
+        Finding(
+            PRECISION_DISCIPLINE, mod.relpath,
+            node.lineno, node.col_offset,
+            f"`{name}` accumulates IN its {token} operand dtype: a "
+            "long reduction leaves ~8 mantissa bits by the end (the "
+            "bf16-accumulator class) and the loss/advantage built on "
+            "it is quantization noise; pass dtype=jnp.float32 (XLA "
+            "still reads the narrow operand — the accumulator is the "
+            "only thing widened)",
+            mod.enclosing_function(node),
+        )
+    ]
+
+
+_FORK_PARAMS = {"kind", "codec", "mode"}
+
+
+def _fork_findings(
+    mod: ModuleInfo, model: DtypeModel, scope: ast.AST
+) -> list[Finding]:
+    if not isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return []
+    args = scope.args
+    params = {
+        a.arg for a in args.posonlyargs + args.args + args.kwonlyargs
+    }
+    if not (params & _FORK_PARAMS):
+        return []
+    env = model.env(mod, scope)
+    known: set[str] = set()
+    passthrough = False
+    returns = [
+        n for n in ast.walk(scope)
+        if isinstance(n, ast.Return) and n.value is not None
+    ]
+    for ret in returns:
+        value = ret.value
+        if isinstance(value, ast.Call) and _call_name(value) in (
+            "asarray", "array"
+        ) and value.args and not value.keywords and len(value.args) == 1:
+            value = value.args[0]  # dtype-preserving wrapper
+        if isinstance(value, ast.Name) and value.id in params:
+            passthrough = True
+            continue
+        token = env.expr_dtype(ret.value)
+        if token is not None and token not in ("pyfloat", "pyint"):
+            known.add(token)
+    fork = len(known) > 1 or (known and passthrough)
+    if not fork:
+        return []
+    evidence = codec_fork_evidence(f"quantize.{scope.name}")
+    detail = f" ({evidence})" if evidence else ""
+    kinds = ", ".join(sorted(known)) + (
+        " + kind-dependent passthrough" if passthrough else ""
+    )
+    return [
+        Finding(
+            PRECISION_DISCIPLINE, mod.relpath,
+            scope.lineno, scope.col_offset,
+            f"`{scope.name}`'s return dtype forks on its codec/kind "
+            f"argument ({kinds}){detail}: every downstream op's dtype "
+            "now depends on a config string — callers must normalize "
+            "the decode output (or the fork must be documented and "
+            "audited at this def)",
+            scope.name,
+        )
+    ]
+
+
+# ---------------------------------------------------------------------------
+# nonfinite-hazard
+# ---------------------------------------------------------------------------
+
+
+@register_check(
+    NONFINITE_HAZARD,
+    "unguarded log/sqrt/arctanh/division operands, exp of unbounded "
+    "log-ratios (the PPO/V-trace surrogate), and scale seeds from bare "
+    "constants instead of the _EPS floor (the PR 8 class)",
+    scope="repo",
+)
+def check_nonfinite_hazard(modules: list[ModuleInfo]) -> list[Finding]:
+    model = _shared_model(modules)
+    findings: list[Finding] = []
+    for mod in modules:
+        for scope in iter_scopes(mod):
+            guards = model.guards(mod, scope)
+            for node in ast.walk(scope):
+                if isinstance(node, ast.Call):
+                    findings.extend(_op_findings(mod, scope, guards, node))
+                elif isinstance(node, ast.BinOp) and isinstance(
+                    node.op, ast.Div
+                ):
+                    findings.extend(
+                        _division_findings(mod, scope, guards, node)
+                    )
+        findings.extend(_scale_seed_findings(mod))
+    findings.sort(key=lambda f: (f.path, f.line, f.col))
+    return findings
+
+
+_MATH_NAMESPACES = ("jax.numpy", "numpy", "math", "jax.nn", "jax.lax")
+
+
+def _math_call(mod: ModuleInfo, node: ast.Call) -> Optional[str]:
+    if not isinstance(node.func, ast.Attribute):
+        return None
+    base = mod.dotted(node.func.value)
+    if base in _MATH_NAMESPACES or (base or "").endswith(".numpy"):
+        return node.func.attr
+    return None
+
+
+def _op_findings(mod, scope, guards, node: ast.Call) -> list[Finding]:
+    name = _math_call(mod, node)
+    if name is None or not node.args:
+        return []
+    arg = node.args[0]
+    ctx = mod.enclosing_function(node)
+    if name in _LOG_CALLS:
+        if guards.positive_floored(arg) or _opaque(mod, scope, arg):
+            return []
+        return [Finding(
+            NONFINITE_HAZARD, mod.relpath, node.lineno, node.col_offset,
+            f"`{name}` of an operand not provably floored away from "
+            "zero: one zero/negative element is -inf/nan in the loss "
+            "and every guard downstream of it dies at once; floor the "
+            "operand (`+ _EPS`, `clip(lo=eps)`, `maximum(x, eps)` — "
+            "the repo idioms this pass recognizes)",
+            ctx,
+        )]
+    if name == "sqrt":
+        if guards.nonnegative(arg) or _opaque(mod, scope, arg):
+            return []
+        return [Finding(
+            NONFINITE_HAZARD, mod.relpath, node.lineno, node.col_offset,
+            "`sqrt` of an operand not provably non-negative: one "
+            "negative element (a variance estimate gone slightly "
+            "below zero in low precision) is nan; produce it from "
+            "`var`/`square`/`abs` or floor it (`maximum(x, 0.0)`)",
+            ctx,
+        )]
+    if name in ("arctanh", "atanh"):
+        if guards.bounded(arg) or _opaque(mod, scope, arg):
+            return []
+        return [Finding(
+            NONFINITE_HAZARD, mod.relpath, node.lineno, node.col_offset,
+            "`arctanh` of an unclipped operand: a squashed action "
+            "stored at exactly ±1 (f32 rounding of tanh at modest "
+            "pre-activations does this) evaluates to ±inf and the "
+            "log_prob of that sample poisons the whole batch — clip "
+            "to ±(1 - 1e-6) first (the TanhGaussian.log_prob idiom)",
+            ctx,
+        )]
+    if name == "exp":
+        if guards.log_diff(arg) and not guards.bounded(arg):
+            return [Finding(
+                NONFINITE_HAZARD, mod.relpath,
+                node.lineno, node.col_offset,
+                "`exp` of an unbounded log-ratio (the importance-"
+                "ratio shape): when behavior and target policies "
+                "drift, the ratio overflows to inf and inf × 0 "
+                "advantage is nan — cap the log-ratio first "
+                "(`jnp.minimum(log_ratio, CAP)`; clipping the RATIO "
+                "after exp is too late, the inf already happened)",
+                ctx,
+            )]
+    return []
+
+
+def _division_findings(mod, scope, guards, node: ast.BinOp) -> list[Finding]:
+    denom = node.right
+    resolved = guards._resolve(denom, 1)
+    risky = isinstance(resolved, ast.Call) and (
+        _call_name(resolved) in _REDUCERS
+    )
+    if not risky or guards.positive_floored(resolved):
+        return []
+    if _conditionally_guarded(mod, node, denom):
+        return []
+    return [Finding(
+        NONFINITE_HAZARD, mod.relpath, node.lineno, node.col_offset,
+        "division by an unfloored reduction/difference: a constant "
+        "batch (or an empty mask) makes the denominator exactly zero "
+        "and the quotient inf/nan; floor it (`+ _EPS` or "
+        "`maximum(d, eps)` — the normalize_advantages idiom)",
+        mod.enclosing_function(node),
+    )]
+
+
+def _conditionally_guarded(
+    mod: ModuleInfo, node: ast.AST, denom: ast.AST
+) -> bool:
+    """Whether the division sits inside an `if`/ternary whose test
+    mentions its denominator — the host-side `x / w if w > 0 else 0.0`
+    idiom (the in-jit equivalent is the `where`-select the guard facts
+    already recognize)."""
+    names = _bare_names(denom)
+    if not names:
+        return False
+    for anc in mod.ancestors(node):
+        if isinstance(anc, (ast.IfExp, ast.If)):
+            if names & _bare_names(anc.test):
+                return True
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            break
+    return False
+
+
+_SCALE_CTORS = {"zeros", "ones", "full", "zeros_like", "ones_like",
+                "full_like"}
+
+
+def _bad_scale_seed(mod: ModuleInfo, value: ast.AST) -> Optional[str]:
+    """Why a scale-seed expression is hazardous, or None when it is
+    fine (the `_EPS`-floor fill, a non-constructor value)."""
+    if not isinstance(value, ast.Call):
+        return None
+    name = _call_name(value)
+    if name not in _SCALE_CTORS:
+        return None
+    if name in ("zeros", "zeros_like"):
+        return "a 0.0 seed divides the first encode by zero"
+    if name in ("ones", "ones_like"):
+        return (
+            "a 1.0 seed permanently floors the quantization step at "
+            "1/127 (the running max only grows) — the PR 8 bug"
+        )
+    fill = None
+    if name == "full" and len(value.args) >= 2:
+        fill = value.args[1]
+    elif name == "full_like" and len(value.args) >= 2:
+        fill = value.args[1]
+    for kw in value.keywords:
+        if kw.arg == "fill_value":
+            fill = kw.value
+    if fill is None:
+        return None
+    from actor_critic_tpu.analysis.dtype_model import _is_eps_name
+
+    if _is_eps_name(fill):
+        return None  # the sanctioned _EPS-floor seed
+    if isinstance(fill, ast.Constant) and isinstance(
+        fill.value, (int, float)
+    ) and not isinstance(fill.value, bool):
+        v = float(fill.value)
+        if v == 0.0:
+            return "a 0.0 seed divides the first encode by zero"
+        if v >= 1e-3:
+            return (
+                f"a {v!r} seed permanently floors the quantization "
+                "step (the running max only grows) — the PR 8 bug"
+            )
+    return None
+
+
+def _scale_seed_findings(mod: ModuleInfo) -> list[Finding]:
+    out: list[Finding] = []
+
+    def scaleish(name: str) -> bool:
+        low = name.lower()
+        return "scale" in low or low.endswith("std")
+
+    for node in ast.walk(mod.tree):
+        sites: list[tuple[str, ast.AST, ast.AST]] = []
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                for name in (
+                    [tgt.id] if isinstance(tgt, ast.Name) else []
+                ):
+                    if scaleish(name):
+                        sites.append((name, node.value, node))
+        elif isinstance(node, ast.Call):
+            for kw in node.keywords:
+                if kw.arg and scaleish(kw.arg):
+                    sites.append((kw.arg, kw.value, kw.value))
+        for name, value, anchor in sites:
+            why = _bad_scale_seed(mod, value)
+            if why is None:
+                continue
+            lineno = getattr(anchor, "lineno", node.lineno)
+            col = getattr(anchor, "col_offset", node.col_offset)
+            out.append(Finding(
+                NONFINITE_HAZARD, mod.relpath, lineno, col,
+                f"`{name}` seeded from a bare constant: {why}; seed "
+                "at the _EPS floor (`full(shape, _EPS)`) like "
+                "quantize.init_stats",
+                mod.enclosing_function(node),
+            ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# sink-guard
+# ---------------------------------------------------------------------------
+
+
+@register_check(
+    SINK_GUARD,
+    "json.dumps(allow_nan=False) writers (one NaN drops the row) and "
+    "commit-point defs (write_params/publish/swap/save) without a "
+    "finiteness gate — non-finite trees escaping into durable/"
+    "fleet-visible/client-visible state",
+    scope="repo",
+)
+def check_sink_guard(modules: list[ModuleInfo]) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod in modules:
+        for node in dumps_sites(mod):
+            findings.append(Finding(
+                SINK_GUARD, mod.relpath, node.lineno, node.col_offset,
+                "json.dumps(allow_nan=False) raises on the first "
+                "non-finite value and this writer drops the whole row "
+                "— a NaN loss gauge silently ends telemetry for the "
+                "rest of the run (the ISSUE 14 sampler crash class); "
+                "route through utils.numguard.safe_json_row (non-"
+                "finite → null, offending key reported once)",
+                mod.enclosing_function(node),
+            ))
+        for def_node, gated in sink_defs(mod):
+            if gated:
+                continue
+            findings.append(Finding(
+                SINK_GUARD, mod.relpath,
+                def_node.lineno, def_node.col_offset,
+                f"commit point `{def_node.name}` has no finiteness "
+                "gate: a nan/inf tree flowing through here becomes "
+                "durable (checkpoint), fleet-visible (mailbox "
+                "publish), or client-visible (gateway swap) — call "
+                "utils.numguard.check_finite before the commit so "
+                "the previous good snapshot stays in place",
+                def_node.name,
+            ))
+    findings.sort(key=lambda f: (f.path, f.line, f.col))
+    return findings

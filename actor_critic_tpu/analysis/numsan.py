@@ -1,0 +1,683 @@
+"""numsan: deterministic NaN/Inf/saturation fault sanitizer (ISSUE 14
+runtime half).
+
+racesan made THREAD interleavings seeded and replayable, fleetsan
+lifted that to PROCESSES; this module applies the same contract to the
+NUMERICS dimension. Each seeded schedule poisons EXACTLY ONE designated
+leaf element — rewards, observations, params (the post-update tree a
+divergence produces), quant stats, or a published snapshot — with one
+fault from the poison menu:
+
+    nan        quiet NaN
+    inf/-inf   ±infinity
+    denormal   an f32 subnormal (~1e-42): must be TOLERATED everywhere
+               (proves the guards do not over-fire)
+    saturate   an int8/f16-saturating magnitude (3.7e5): codecs must
+               clip to the representable range, never wrap or overflow
+
+inside the REAL objects — `ppo.make_host_update_step` (the actual
+jitted update program), the `quantize`/`data_plane.codecs` codec pair,
+`PolicyPublisher`/`write_params`/`read_params`/`PolicyStore.swap`, and
+a real orbax `Checkpointer` — and asserts the stack's NAMED response:
+
+- **divergence event** — a nonfinite reward/obs poison must surface as
+  a non-finite loss that fires `DivergenceMonitor`'s `non_finite_loss`
+  (the telemetry forensic record);
+- **checkpoint refusal** — `Checkpointer.save` of a poisoned state
+  raises `NonFiniteError` and the previous step stays latest/restorable;
+- **publish/mailbox/swap rejection** — `PolicyPublisher.publish`,
+  `multihost.write_params`, and `PolicyStore.swap` refuse the snapshot
+  and the previous good one stays visible;
+- **codec saturation** — int8 codecs emit exactly ±127 (bool8: {0,1},
+  f16: ±65504) for saturating/infinite inputs, encode NaN to the
+  deterministic midpoint, and the numpy mirror stays bit-identical to
+  the device codec under poison.
+
+A failed assertion raises `NumSanError` (the sanitizer detecting a
+missing/reverted guard); a clean schedule appends to `report["trace"]`,
+which is bit-identical per seed (same seed → same poisons, same leaf
+positions, same observed values — replay a named seed to reproduce).
+**Reverted-guard modes** prove the detectors work: `revert="publish"` /
+`revert="checkpoint"` no-op `numguard.check_finite` (the one seam every
+production gate routes through) and numsan must then CATCH the poison
+on the far side of the sink; `revert="codec-wrap"` runs the pre-fix
+encoder (`round(x).astype(int8)` — wraps) against the saturation
+checker. All three are caught deterministically on every schedule and
+regression-tested.
+
+`quick_profile` is the fixed-seed sweep `scripts/tier1.sh` runs between
+fleetsan and pytest, under its own timeout.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+import tempfile
+from typing import Iterable, Optional
+
+import numpy as np
+
+from actor_critic_tpu.utils import numguard
+
+POISONS = ("nan", "inf", "-inf", "denormal", "saturate")
+NONFINITE = ("nan", "inf", "-inf")
+_VALUES = {
+    "nan": float("nan"),
+    "inf": float("inf"),
+    "-inf": float("-inf"),
+    "denormal": 1e-42,
+    "saturate": 3.7e5,
+}
+
+
+class NumSanError(RuntimeError):
+    """A guard failed to block (or tolerate) a poison — or a reverted
+    guard's leak was detected (the sanitizer working)."""
+
+
+def _flat_float_leaves(tree, path=""):
+    """[(path, array)] of float leaves, sorted by path — the stable
+    enumeration the seeded leaf choice indexes into."""
+    out = []
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            out.extend(_flat_float_leaves(tree[k], f"{path}/{k}"))
+    elif isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            out.extend(_flat_float_leaves(v, f"{path}[{i}]"))
+    elif hasattr(tree, "dtype") and np.issubdtype(
+        np.dtype(tree.dtype), np.floating
+    ):
+        out.append((path, tree))
+    return out
+
+
+def _poison_tree(tree, rng: random.Random, poison: str):
+    """Poison ONE element of ONE float leaf in a (mutable-numpy) tree;
+    returns (leaf_path, flat_index). The tree must hold writable numpy
+    arrays."""
+    leaves = _flat_float_leaves(tree)
+    if not leaves:
+        raise ValueError("no float leaves to poison")
+    path, arr = leaves[rng.randrange(len(leaves))]
+    idx = rng.randrange(max(arr.size, 1))
+    arr.reshape(-1)[idx] = _VALUES[poison]
+    return path, idx
+
+
+class _guards_disabled:
+    """Context manager that no-ops `numguard.check_finite` — the
+    reverted-guard mode. Every production gate routes through this one
+    module attribute, so one seam reverts them all."""
+
+    def __enter__(self):
+        self._orig = numguard.check_finite
+        numguard.check_finite = lambda *a, **k: None
+        return self
+
+    def __exit__(self, *exc):
+        numguard.check_finite = self._orig
+
+
+# ---------------------------------------------------------------------------
+# update exerciser: the real jitted PPO update + DivergenceMonitor
+# ---------------------------------------------------------------------------
+
+_UPDATE_FIXTURE = None
+
+
+def _update_fixture():
+    """One tiny REAL host-PPO update program, compiled once per process
+    and shared by every schedule (the poison varies, the program does
+    not — exactly the production shape)."""
+    global _UPDATE_FIXTURE
+    if _UPDATE_FIXTURE is not None:
+        return _UPDATE_FIXTURE
+    import jax
+
+    from actor_critic_tpu.algos import ppo
+    from actor_critic_tpu.envs.jax_env import EnvSpec
+
+    spec = EnvSpec(
+        obs_shape=(4,), action_dim=2, discrete=True,
+        obs_dtype=np.float32, can_truncate=True,
+    )
+    cfg = ppo.PPOConfig(
+        num_envs=2, rollout_steps=4, epochs=1, num_minibatches=1,
+        hidden=(8,),
+    )
+    key = jax.random.key(0)
+    params, opt_state = ppo.init_host_params(spec, cfg, key)
+    update = ppo.make_host_update_step(spec, cfg)
+    _UPDATE_FIXTURE = (cfg, params, opt_state, update, key)
+    return _UPDATE_FIXTURE
+
+
+def _synth_block(cfg, nprng: np.random.Generator) -> dict:
+    T, E = cfg.rollout_steps, cfg.num_envs
+    return {
+        "obs": nprng.normal(size=(T, E, 4)).astype(np.float32),
+        "action": nprng.integers(0, 2, (T, E)),
+        "log_prob": (nprng.normal(size=(T, E)) * 0.1 - 0.69).astype(
+            np.float32
+        ),
+        "value": nprng.normal(size=(T, E)).astype(np.float32),
+        "reward": np.ones((T, E), np.float32),
+        "done": np.zeros((T, E), np.float32),
+        "terminated": np.zeros((T, E), np.float32),
+        "final_obs": nprng.normal(size=(T, E, 4)).astype(np.float32),
+        "last_obs": nprng.normal(size=(E, 4)).astype(np.float32),
+    }
+
+
+def exercise_update(seed: int, rounds: int = 2) -> dict:
+    """Seeded poisons (rewards/obs) through the REAL update program:
+    nonfinite poisons must surface as a non-finite loss that fires the
+    DivergenceMonitor's `non_finite_loss`; denormal/saturate poisons
+    must leave the loss finite and the monitor quiet."""
+    import jax
+
+    from actor_critic_tpu.telemetry.health import DivergenceMonitor
+
+    cfg, params, opt_state, update, key = _update_fixture()
+    rng = random.Random(seed)
+    report = {
+        "seed": seed, "scenario": "update", "trace": [],
+        "divergence_events": 0, "violations": 0,
+    }
+    for round_ in range(rounds):
+        block = _synth_block(cfg, np.random.default_rng(seed * 31 + round_))
+        target = ("reward", "obs")[rng.randrange(2)]
+        # Per-target poison menus: an ±inf OBSERVATION is squashed
+        # finite by the tanh torso (tanh(±inf) = ±1 — measured, and
+        # worth knowing: the network itself is an inf-but-not-nan
+        # guard), so only nan survives the forward pass from obs;
+        # rewards flow linearly through GAE and carry all three.
+        menu = POISONS if target == "reward" else (
+            "nan", "denormal", "saturate"
+        )
+        poison = menu[rng.randrange(len(menu))]
+        _, idx = _poison_tree({target: block[target]}, rng, poison)
+        _p, _o, metrics = update(
+            params, opt_state, block["obs"], block["action"],
+            block["log_prob"], block["value"], block["reward"],
+            block["done"], block["terminated"], block["final_obs"],
+            block["last_obs"], key,
+        )
+        loss = float(jax.device_get(metrics["loss"]))
+        events: list = []
+        monitor = DivergenceMonitor(
+            lambda kind, **f: events.append((kind, f))
+        )
+        monitor.observe(round_, {"loss": loss})
+        fired = [
+            f for kind, f in events
+            if kind == "divergence" and f.get("reason") == "non_finite_loss"
+        ]
+        if poison in NONFINITE:
+            if math.isfinite(loss):
+                report["violations"] += 1
+                raise NumSanError(
+                    f"seed {seed}: {poison} poison of {target}[{idx}] "
+                    f"vanished — the loss came out finite ({loss!r}); "
+                    "the update program is masking non-finites instead "
+                    "of surfacing them to the DivergenceMonitor"
+                )
+            if not fired:
+                report["violations"] += 1
+                raise NumSanError(
+                    f"seed {seed}: non-finite loss {loss!r} did NOT "
+                    "fire DivergenceMonitor non_finite_loss — the "
+                    "divergence guard is reverted/blind"
+                )
+            report["divergence_events"] += 1
+        else:
+            if not math.isfinite(loss):
+                report["violations"] += 1
+                raise NumSanError(
+                    f"seed {seed}: tolerated poison {poison} of "
+                    f"{target}[{idx}] made the loss non-finite "
+                    f"({loss!r}) — denormal/large-but-finite inputs "
+                    "must train through"
+                )
+            if fired:
+                report["violations"] += 1
+                raise NumSanError(
+                    f"seed {seed}: DivergenceMonitor fired on a finite "
+                    f"loss {loss!r} — the guard over-fires"
+                )
+        report["trace"].append(
+            (round_, target, poison, idx, repr(loss),
+             "divergence" if fired else "clean")
+        )
+    return report
+
+
+# ---------------------------------------------------------------------------
+# publish exerciser: PolicyPublisher + file mailbox + PolicyStore.swap
+# ---------------------------------------------------------------------------
+
+
+class _StubEngine:
+    max_rows = 8
+
+    def prepare_params(self, params):
+        out = {k: np.array(v) for k, v in params.items()}
+        for v in out.values():
+            v.flags.writeable = False
+        return out
+
+    def act(self, params, obs):
+        return np.asarray(obs)[:, 0] * params["w"].flat[0]
+
+
+def _params_tree(fill: float = 1.0) -> dict:
+    return {
+        "w": np.full((3, 2), fill, np.float32),
+        "b": np.full((2,), fill, np.float32),
+    }
+
+
+def exercise_publish(seed: int, revert: bool = False) -> dict:
+    """Seeded poisons against the three publish-shaped guards, driving
+    the REAL objects: `PolicyPublisher.publish`, `write_params` (with a
+    `read_params` read-back of the mailbox file), and
+    `PolicyStore.swap`. Nonfinite → all three refuse and the previous
+    snapshot stays visible; denormal → all three accept (no
+    over-firing). With `revert=True` the gates are no-op'd and the
+    checker must CATCH the poison on the far side of each sink."""
+    from actor_critic_tpu.algos.traj_queue import PolicyPublisher
+    from actor_critic_tpu.parallel.multihost import (
+        read_params,
+        write_params,
+    )
+    from actor_critic_tpu.serving.policy_store import PolicyStore
+
+    rng = random.Random(seed)
+    # Reverted-guard mode draws from the nonfinite menu only: every
+    # schedule must detect the leak (a denormal leaks nothing).
+    menu = NONFINITE if revert else (NONFINITE + ("denormal",))
+    poison = menu[rng.randrange(len(menu))]
+    report = {
+        "seed": seed, "scenario": "publish", "poison": poison,
+        "trace": [], "rejections": 0, "violations": 0,
+    }
+    good = _params_tree(0.5)
+    poisoned = _params_tree(0.5)
+    path, idx = _poison_tree(poisoned, rng, poison)
+
+    publisher = PolicyPublisher(good, version=1)
+    store = PolicyStore()
+    store.register("default", _StubEngine(), good, version=1)
+    with tempfile.TemporaryDirectory(prefix="numsan_") as mailbox:
+        write_params(mailbox, 0, 1, good)
+
+        def attempt(name, fn):
+            """Run one poisoned commit; returns 'rejected'/'accepted'."""
+            try:
+                fn()
+            except numguard.NonFiniteError:
+                report["rejections"] += 1
+                return "rejected"
+            return "accepted"
+
+        sinks = [
+            ("publish", lambda: publisher.publish(poisoned, 2)),
+            ("write_params", lambda: write_params(
+                mailbox, 0, 2, poisoned
+            )),
+            ("swap", lambda: store.swap("default", poisoned, version=2)),
+        ]
+        if revert:
+            with _guards_disabled():
+                for name, fn in sinks:
+                    outcome = attempt(name, fn)
+                    report["trace"].append((name, poison, path, idx, outcome))
+            # The detector: with the gates reverted, a nonfinite poison
+            # must now be CAUGHT on the far side of each sink.
+            if poison in NONFINITE:
+                leaked = []
+                if numguard.nonfinite_leaves(publisher.get()[1]):
+                    leaked.append("publisher")
+                out = read_params(mailbox, 0, good)
+                if out is not None and numguard.nonfinite_leaves(out[1]):
+                    leaked.append("mailbox")
+                if numguard.nonfinite_leaves(
+                    dict(store.get("default").params)
+                ):
+                    leaked.append("store")
+                if leaked:
+                    report["violations"] += 1
+                    raise NumSanError(
+                        f"seed {seed}: REVERTED GUARD DETECTED — "
+                        f"{poison} poison at {path}[{idx}] reached "
+                        f"{'/'.join(leaked)} with check_finite no-op'd "
+                        "(the production gates are the only thing "
+                        "standing between a diverged learner and the "
+                        "fleet/clients)"
+                    )
+            return report
+        for name, fn in sinks:
+            outcome = attempt(name, fn)
+            report["trace"].append((name, poison, path, idx, outcome))
+            if poison in NONFINITE and outcome != "rejected":
+                report["violations"] += 1
+                raise NumSanError(
+                    f"seed {seed}: {name} ACCEPTED a {poison}-poisoned "
+                    f"tree ({path}[{idx}]) — the finiteness gate is "
+                    "missing/reverted"
+                )
+            if poison == "denormal" and outcome != "accepted":
+                report["violations"] += 1
+                raise NumSanError(
+                    f"seed {seed}: {name} rejected a denormal — the "
+                    "gate over-fires (only nan/±inf may refuse)"
+                )
+        # After a refusal the previous good snapshots must still be
+        # visible everywhere (denormal legitimately published v2 — the
+        # invariant there is just that nothing non-finite is stored).
+        version, params = publisher.get()
+        if numguard.nonfinite_leaves(params) or (
+            poison in NONFINITE and version != 1
+        ):
+            raise NumSanError(
+                f"seed {seed}: publisher lost its good snapshot"
+            )
+        out = read_params(mailbox, 0, good)
+        if poison in NONFINITE and (
+            out is None or out[0] != 1
+            or numguard.nonfinite_leaves(out[1])
+        ):
+            raise NumSanError(
+                f"seed {seed}: mailbox lost its good snapshot"
+            )
+        handle = store.get("default")
+        if poison in NONFINITE and handle.version != 1:
+            raise NumSanError(
+                f"seed {seed}: store swapped despite the refusal"
+            )
+    return report
+
+
+# ---------------------------------------------------------------------------
+# checkpoint exerciser: a real orbax Checkpointer (quant stats ride too)
+# ---------------------------------------------------------------------------
+
+
+def exercise_checkpoint(seed: int, revert: bool = False) -> dict:
+    """Seeded poisons against the checkpoint commit gate: a REAL
+    `Checkpointer` saves a finite state at step 0; the poisoned state
+    (params OR the quant-stats leaves riding the same tree) must refuse
+    at step 1 with step 0 still latest and restorable. `revert=True`
+    no-ops the gate and the checker must detect the poisoned commit in
+    the restored tree."""
+    from actor_critic_tpu.utils.checkpoint import Checkpointer
+
+    rng = random.Random(seed)
+    menu = NONFINITE if revert else (NONFINITE + ("denormal",))
+    poison = menu[rng.randrange(len(menu))]
+    report = {
+        "seed": seed, "scenario": "checkpoint", "poison": poison,
+        "trace": [], "refusals": 0, "violations": 0,
+    }
+    state = {
+        "params": _params_tree(0.25),
+        "quant_stats": {
+            "mean": np.zeros((4,), np.float32),
+            "scale": np.full((4,), 1e-6, np.float32),
+        },
+    }
+    with tempfile.TemporaryDirectory(prefix="numsan_ckpt_") as root:
+        with Checkpointer(root, max_to_keep=2) as ckpt:
+            ckpt.save(0, state, force=True)
+            ckpt.wait()
+            path, idx = _poison_tree(state, rng, poison)
+            outcome = "accepted"
+            if revert:
+                with _guards_disabled():
+                    ckpt.save(1, state, force=True)
+                    ckpt.wait()
+            else:
+                try:
+                    ckpt.save(1, state, force=True)
+                    ckpt.wait()
+                except numguard.NonFiniteError:
+                    outcome = "refused"
+                    report["refusals"] += 1
+            report["trace"].append((poison, path, idx, outcome))
+            latest = ckpt.latest_step()
+            template = {
+                "params": _params_tree(0.0),
+                "quant_stats": {
+                    "mean": np.zeros((4,), np.float32),
+                    "scale": np.zeros((4,), np.float32),
+                },
+            }
+            restored = ckpt.restore(template, latest)
+            bad = numguard.nonfinite_leaves(
+                {k: np.asarray(v) for k, v in
+                 {"p": restored["params"]["w"],
+                  "s": restored["quant_stats"]["scale"],
+                  "m": restored["quant_stats"]["mean"],
+                  "b": restored["params"]["b"]}.items()}
+            )
+            if revert and poison in NONFINITE:
+                if latest == 1 and bad:
+                    report["violations"] += 1
+                    raise NumSanError(
+                        f"seed {seed}: REVERTED GUARD DETECTED — "
+                        f"{poison} poison at {path}[{idx}] COMMITTED "
+                        "at step 1 and restores poisoned (every "
+                        "future resume now inherits it)"
+                    )
+                return report
+            if poison in NONFINITE:
+                if outcome != "refused":
+                    report["violations"] += 1
+                    raise NumSanError(
+                        f"seed {seed}: checkpoint COMMITTED a {poison}-"
+                        f"poisoned state ({path}[{idx}]) — the commit "
+                        "gate is missing/reverted"
+                    )
+                if latest != 0 or bad:
+                    report["violations"] += 1
+                    raise NumSanError(
+                        f"seed {seed}: refusal did not preserve the "
+                        f"previous good checkpoint (latest={latest})"
+                    )
+            else:
+                if outcome != "accepted" or latest != 1:
+                    report["violations"] += 1
+                    raise NumSanError(
+                        f"seed {seed}: checkpoint refused a denormal — "
+                        "the gate over-fires"
+                    )
+    return report
+
+
+# ---------------------------------------------------------------------------
+# codec exerciser: saturation semantics, host mirror == device
+# ---------------------------------------------------------------------------
+
+_I8_KINDS = ("i8", "i8_unit", "bool8")
+
+
+def exercise_codec(seed: int, revert: bool = False) -> dict:
+    """Seeded poisons through the REAL codec pair: int8 codecs must
+    saturate (±127; bool8 {0,1}) on inf/saturating magnitudes and
+    encode NaN to the deterministic midpoint; f16 clips to ±65504
+    instead of overflowing to inf; and the numpy mirror must stay
+    BIT-IDENTICAL to the device codec under poison (the
+    host-encode == device-decode contract must not fork on garbage).
+    `revert=True` runs the pre-fix wrap encoder against the checker."""
+    import jax.numpy as jnp
+
+    from actor_critic_tpu.data_plane import codecs as np_codecs
+    from actor_critic_tpu.replay import quantize
+
+    rng = random.Random(seed)
+    # Reverted-codec mode pins the saturating poison: the wrap is then
+    # detected on every schedule (inf→int8 casts are platform-defined).
+    poison = "saturate" if revert else POISONS[rng.randrange(len(POISONS))]
+    report = {
+        "seed": seed, "scenario": "codec", "poison": poison,
+        "trace": [], "saturations": 0, "violations": 0,
+    }
+    nprng = np.random.default_rng(seed)
+    batch = (nprng.normal(size=(8,)) * 0.3).astype(np.float32)
+    idx = rng.randrange(batch.size)
+    batch[idx] = _VALUES[poison]
+    np_stats = {
+        "mean": np.float32(0.1), "scale": np.float32(2.0),
+        "count": np.int32(4096),
+    }
+
+    if revert:
+        # The REVERTED (pre-fix) bool8 encoder: round-then-cast WRAPS
+        # out-of-range magnitudes instead of saturating.
+        q = np.round(batch).astype(np.int8)
+        if poison in ("saturate", "inf") and not (
+            0 <= int(q[idx]) <= 1
+        ):
+            report["violations"] += 1
+            raise NumSanError(
+                f"seed {seed}: REVERTED CODEC DETECTED — bool8 "
+                f"round-then-cast wrapped a {poison} flag to "
+                f"{int(q[idx])} (valid range {{0, 1}}); the narrowing "
+                "cast must clip first"
+            )
+        return report
+
+    for kind in _I8_KINDS + ("f16",):
+        jstats = quantize.QuantStats(
+            mean=jnp.asarray(np_stats["mean"]),
+            scale=jnp.asarray(np_stats["scale"]),
+            count=jnp.asarray(np_stats["count"]),
+        )
+        host = np_codecs.np_encode(kind, np_stats, batch)
+        dev = np.asarray(quantize.encode(
+            kind, jstats, jnp.asarray(batch),
+            quantize.storage_dtype(kind, jnp.float32),
+        ))
+        same = host.dtype == dev.dtype and (
+            np.array_equal(host, dev, equal_nan=True)
+            if np.issubdtype(host.dtype, np.floating)
+            else np.array_equal(host, dev)
+        )
+        if not same:
+            report["violations"] += 1
+            raise NumSanError(
+                f"seed {seed}: host/device codec mismatch for {kind} "
+                f"under {poison} poison — the mirror contract forked "
+                "on garbage input"
+            )
+        v = host[idx]
+        ok = True
+        if kind in ("i8", "i8_unit"):
+            bound = 127
+            if poison == "nan":
+                # nan_to_num → midpoint: 0 for i8_unit, the mean band
+                # for i8 (z == 0 after the scrub)
+                ok = int(v) == (
+                    0 if kind == "i8_unit"
+                    else int(np.round(0.0))
+                )
+            elif poison in ("inf", "saturate"):
+                ok = int(v) == bound
+                report["saturations"] += ok
+            elif poison == "-inf":
+                ok = int(v) == -bound
+                report["saturations"] += ok
+            else:
+                ok = -bound <= int(v) <= bound
+        elif kind == "bool8":
+            if poison in ("inf", "saturate"):
+                ok = int(v) == 1
+                report["saturations"] += ok
+            elif poison in ("nan", "-inf", "denormal"):
+                ok = int(v) == 0
+            if not (0 <= int(min(host)) and int(max(host)) <= 1):
+                ok = False
+        else:  # f16
+            if poison == "nan":
+                ok = bool(np.isnan(v))  # deterministic propagation
+            else:
+                f16_max = float(np.finfo(np.float16).max)
+                ok = bool(np.isfinite(v)) and abs(float(v)) <= f16_max
+                if poison in ("inf", "saturate"):
+                    report["saturations"] += ok
+        if not ok:
+            report["violations"] += 1
+            raise NumSanError(
+                f"seed {seed}: codec {kind} mishandled {poison} at "
+                f"[{idx}]: encoded {v!r} — saturation contract "
+                "violated (wrap/overflow instead of clip)"
+            )
+        decoded = np_codecs.np_decode(kind, np_stats, host)
+        dec_ok = (
+            bool(np.isnan(decoded[idx]))
+            if (kind == "f16" and poison == "nan")
+            else bool(np.all(np.isfinite(decoded)))
+        )
+        if not dec_ok:
+            report["violations"] += 1
+            raise NumSanError(
+                f"seed {seed}: codec {kind} decode re-introduced a "
+                f"non-finite under {poison}"
+            )
+        report["trace"].append((kind, poison, idx, repr(v)))
+    return report
+
+
+# ---------------------------------------------------------------------------
+# sweep + the tier-1 quick profile
+# ---------------------------------------------------------------------------
+
+
+def exercise_sweep(seeds: Iterable[int], scenario) -> dict:
+    reports = [scenario(seed) for seed in seeds]
+    return {
+        "schedules": len(reports),
+        "divergence_events": sum(
+            r.get("divergence_events", 0) for r in reports
+        ),
+        "rejections": sum(r.get("rejections", 0) for r in reports),
+        "refusals": sum(r.get("refusals", 0) for r in reports),
+        "saturations": sum(r.get("saturations", 0) for r in reports),
+        "violations": sum(r.get("violations", 0) for r in reports),
+    }
+
+
+def quick_profile(schedules: int = 16, seed0: int = 0) -> dict:
+    """The tier-1 fast profile: `schedules` seeded fault schedules split
+    across the four exercisers — every guard class must both FIRE on
+    nonfinite poisons and stay QUIET on tolerated ones. The update
+    program compiles once per process; everything else is
+    tmpfs/numpy-speed."""
+    n = max(schedules // 4, 1)
+    update = exercise_sweep(
+        range(seed0, seed0 + n), lambda s: exercise_update(s)
+    )
+    publish = exercise_sweep(
+        range(seed0, seed0 + n), lambda s: exercise_publish(s)
+    )
+    checkpoint = exercise_sweep(
+        range(seed0, seed0 + n), lambda s: exercise_checkpoint(s)
+    )
+    codec = exercise_sweep(
+        range(seed0, seed0 + (schedules - 3 * n)),
+        lambda s: exercise_codec(s),
+    )
+    return {
+        "schedules": sum(
+            x["schedules"] for x in (update, publish, checkpoint, codec)
+        ),
+        "update": update,
+        "publish": publish,
+        "checkpoint": checkpoint,
+        "codec": codec,
+        "violations": sum(
+            x["violations"] for x in (update, publish, checkpoint, codec)
+        ),
+    }

@@ -147,24 +147,45 @@ def np_update_stats(
     }
 
 
+# jaxlint: disable=precision-discipline (audited fork: numpy twin of
+# quantize.encode — same storage-dtype-forks-on-kind contract, same
+# ring-allocated-with-the-same-kind consumer guarantee)
 def np_encode(kind: str, stats: dict, x: np.ndarray) -> np.ndarray:
     """One host leaf → its stored representation (numpy twin of
-    quantize.encode; the device decodes with the same stats)."""
+    quantize.encode; the device decodes with the same stats).
+
+    Saturates exactly like the device codec (see `quantize.encode`):
+    out-of-range values clip to the representable range before the
+    narrowing cast (an unclipped float→int8 cast WRAPS; float16
+    overflows to inf); NaN narrows deterministically through nan_to_num
+    on the int8 paths and propagates verbatim through f16 — identity
+    for every finite in-range value, so the host-encode ==
+    device-encode bit-exactness contract is unchanged."""
     if kind == "raw":
         return np.asarray(x)
     if kind == "f16":
-        return np.asarray(x, np.float16)
+        f16_max = float(np.finfo(np.float16).max)
+        return np.clip(x, -f16_max, f16_max).astype(np.float16)
     if kind == "bool8":
-        return np.round(x).astype(np.int8)
+        return np.round(
+            np.clip(np.nan_to_num(x), 0.0, 1.0)
+        ).astype(np.int8)
     if kind == "i8_unit":
-        q = np.clip(np.asarray(x, np.float32), -1.0, 1.0) * 127.0
+        q = np.clip(
+            np.nan_to_num(np.asarray(x, np.float32)), -1.0, 1.0
+        ) * 127.0
         return np.round(q).astype(np.int8)
     if kind == "i8":
         z = (np.asarray(x, np.float32) - stats["mean"]) / stats["scale"]
-        return np.round(np.clip(z, -1.0, 1.0) * 127.0).astype(np.int8)
+        return np.round(
+            np.clip(np.nan_to_num(z), -1.0, 1.0) * 127.0
+        ).astype(np.int8)
     raise ValueError(f"unknown codec kind {kind!r}; valid: {quantize.KINDS}")
 
 
+# jaxlint: disable=precision-discipline (audited fork: numpy twin of
+# quantize.decode — raw passes the storage dtype through by design,
+# uint8 pixel obs must reach the torso un-floated)
 def np_decode(kind: str, stats: dict, q: np.ndarray) -> np.ndarray:
     """Numpy twin of quantize.decode (tests cross-check it against the
     device decode; the trainers only ever decode on device)."""

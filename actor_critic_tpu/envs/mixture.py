@@ -291,8 +291,16 @@ def make_mixture(
 
     def reset(key: jax.Array):
         key, tkey = jax.random.split(key)
+        # Guarded normalization (ISSUE 14, nonfinite-hazard): an
+        # all-zero weight vector (a curriculum stage zeroing every
+        # type) would make the draw probabilities 0/0 = nan — and a
+        # bare denominator floor would silently bias every draw to
+        # type 0; degrade to a UNIFORM draw instead (visible, unbiased).
+        # Bit-identical for any real (positive-sum) weight vector.
+        s = jnp.sum(init_w)
         type_id = jax.random.choice(
-            tkey, n, p=init_w / jnp.sum(init_w)
+            tkey, n,
+            p=jnp.where(s > 0, init_w / jnp.maximum(s, 1e-6), 1.0 / n),
         )
         return _fresh(key, type_id, init_w)
 
@@ -321,8 +329,14 @@ def make_mixture(
         # equivalence contract); only a genuine type change swaps in
         # the mixture-keyed reset.
         key, tkey, rkey = jax.random.split(state.key, 3)
+        # Same guarded normalization as reset(): uniform on a zeroed
+        # weight vector, bit-identical otherwise.
+        ws = jnp.sum(state.weights)
         drawn = jax.random.choice(
-            tkey, n, p=state.weights / jnp.sum(state.weights)
+            tkey, n,
+            p=jnp.where(
+                ws > 0, state.weights / jnp.maximum(ws, 1e-6), 1.0 / n
+            ),
         ).astype(jnp.int32)
         new_type = jnp.where(done > 0, drawn, state.type_id)
         changed = (done > 0) & (new_type != state.type_id)

@@ -181,7 +181,12 @@ def _vtrace_kernel(
     def body(i, carry):
         acc, v_next, vs_next = carry
         t = T - 1 - i
-        raw_rho = jnp.exp(tlp_ref[pl.ds(t, 1), :] - blp_ref[pl.ds(t, 1), :])
+        # Same LOG_RATIO_CAP as the lax reference — the kernel/fallback
+        # parity contract requires the capped ratio on both sides.
+        raw_rho = jnp.exp(jnp.minimum(
+            tlp_ref[pl.ds(t, 1), :] - blp_ref[pl.ds(t, 1), :],
+            _returns.LOG_RATIO_CAP,
+        ))
         rho = jnp.minimum(rho_bar, raw_rho)
         # c clips the RAW ratio (independent of rho_bar) — matters when
         # c_bar > rho_bar (golden: ops/returns.vtrace).

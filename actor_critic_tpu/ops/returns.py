@@ -23,6 +23,15 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+# Cap on log importance ratios before exp (ISSUE 14, nonfinite-hazard):
+# exp(20) ≈ 4.9e8 — far above any ratio the ρ̄/c̄/PPO clips keep, far
+# below f32 overflow. Without it, behavior/target drift overflows the
+# ratio to inf and `inf × 0` advantage is nan — which no downstream
+# `minimum(ρ̄, ·)` can repair (the clip happens AFTER the inf is born).
+# Bit-identical for every in-range ratio, so golden/parity tests and
+# the Pallas kernel (which applies the same cap) are unchanged.
+LOG_RATIO_CAP = 20.0
+
 
 def discounted_returns(
     rewards: jax.Array,
@@ -120,7 +129,9 @@ def vtrace(
     """
     dones = dones.astype(rewards.dtype)
     discounts = gamma * (1.0 - dones)
-    rhos = jnp.exp(target_log_probs - behaviour_log_probs)
+    rhos = jnp.exp(
+        jnp.minimum(target_log_probs - behaviour_log_probs, LOG_RATIO_CAP)
+    )
     clipped_rhos = jnp.minimum(rho_bar, rhos)
     cs = lam * jnp.minimum(c_bar, rhos)
 

@@ -64,6 +64,7 @@ import numpy as np
 
 from actor_critic_tpu.algos.traj_queue import _snapshot_frozen
 from actor_critic_tpu.parallel.mesh import DP_AXIS, multihost_init, shard_map
+from actor_critic_tpu.utils import numguard
 
 
 def distributed_init(
@@ -206,6 +207,12 @@ def write_params(mailbox_dir: str, rank: int, version: int, params: Any) -> str:
     os.makedirs(os.path.dirname(path), exist_ok=True)
     leaves = jax.tree.leaves(params)
     payload = {f"leaf{i}": np.asarray(v) for i, v in enumerate(leaves)}
+    # Finiteness gate (ISSUE 14): a nan/inf snapshot published here
+    # diffuses through the gossip ring to EVERY peer within world-1
+    # rounds and poisons each learner's mix_params — the one place a
+    # single host's divergence becomes a fleet-wide one. Refuse the
+    # publish; the mailbox keeps this host's previous good snapshot.
+    numguard.check_finite(payload, "mailbox publish", name="params")
     payload["version"] = np.asarray(int(version), np.int64)
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "wb") as f:

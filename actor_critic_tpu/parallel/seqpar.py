@@ -43,6 +43,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from actor_critic_tpu.ops import returns
 from actor_critic_tpu.parallel import mesh as mesh_lib
 
 SP_AXIS = "sp"
@@ -155,7 +156,13 @@ def seqpar_vtrace(
     itself, since vs_next_first = y_in + v_halo)."""
     dones = dones.astype(rewards.dtype)
     discounts = gamma * (1.0 - dones)
-    rhos = jnp.exp(target_log_probs - behaviour_log_probs)
+    # Same LOG_RATIO_CAP as ops.returns.vtrace — the gathered-equality
+    # contract requires the capped ratio on both sides.
+    rhos = jnp.exp(
+        jnp.minimum(
+            target_log_probs - behaviour_log_probs, returns.LOG_RATIO_CAP
+        )
+    )
     clipped_rhos = jnp.minimum(rho_bar, rhos)
     cs = lam * jnp.minimum(c_bar, rhos)
 

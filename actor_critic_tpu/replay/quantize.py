@@ -190,24 +190,54 @@ def update_stats(
     return QuantStats(mean=mean, scale=scale, count=count)
 
 
+# jaxlint: disable=precision-discipline (audited fork: the STORAGE
+# dtype forking on the codec is this function's contract — the ring
+# allocates per-leaf storage via storage_dtype with the SAME kind, so
+# no consumer ever sees a surprise dtype)
 def encode(kind: str, stats: QuantStats, x, store_dtype) -> jax.Array:
     """One leaf batch → its stored representation (pure; the caller
-    scatters the result into the donated ring)."""
+    scatters the result into the donated ring).
+
+    Saturating by construction (ISSUE 14, asserted by numsan's
+    saturating-magnitude poisoner): out-of-range values clip to the
+    codec's representable range BEFORE the narrowing cast — a float→int8
+    cast of an unclipped value is implementation-defined and WRAPS on
+    CPU (a 1e6 flag became a negative one), and a float32→float16 cast
+    of |x| > 65504 overflows to inf, injecting the very non-finite the
+    guards exist to keep out. For the int8 codecs a NaN input narrows
+    deterministically to the range midpoint via nan_to_num (identity
+    for every finite value, so all parity/roundtrip bounds are
+    unchanged); the f16 codec stores NaN VERBATIM — deterministic
+    propagation for the downstream divergence/commit gates to own,
+    never a silent random int.
+    The numpy mirror (`data_plane/codecs.np_encode`) applies the SAME
+    rule so host-encode == device-encode stays bit-exact."""
     if kind == "raw":
         return x.astype(store_dtype)
     if kind == "f16":
-        return x.astype(jnp.float16)
+        f16_max = float(jnp.finfo(jnp.float16).max)
+        return jnp.clip(x, -f16_max, f16_max).astype(jnp.float16)
     if kind == "bool8":
-        return jnp.round(x).astype(jnp.int8)
+        return jnp.round(
+            jnp.clip(jnp.nan_to_num(x), 0.0, 1.0)
+        ).astype(jnp.int8)
     if kind == "i8_unit":
-        q = jnp.clip(x.astype(jnp.float32), -1.0, 1.0) * 127.0
+        q = jnp.clip(
+            jnp.nan_to_num(x.astype(jnp.float32)), -1.0, 1.0
+        ) * 127.0
         return jnp.round(q).astype(jnp.int8)
     if kind == "i8":
         z = (x.astype(jnp.float32) - stats.mean) / stats.scale
-        return jnp.round(jnp.clip(z, -1.0, 1.0) * 127.0).astype(jnp.int8)
+        return jnp.round(
+            jnp.clip(jnp.nan_to_num(z), -1.0, 1.0) * 127.0
+        ).astype(jnp.int8)
     raise ValueError(f"unknown codec kind {kind!r}; valid: {KINDS}")
 
 
+# jaxlint: disable=precision-discipline (audited fork: every quantized
+# kind decodes to float32; `raw` alone passes the storage dtype through
+# BY DESIGN — uint8 pixel obs must reach the encoder torso un-floated,
+# and the buffer's all-raw default must be a bitwise no-op)
 def decode(kind: str, stats: QuantStats, q) -> jax.Array:
     """Stored representation → float32 (identity for `raw`)."""
     if kind == "raw":

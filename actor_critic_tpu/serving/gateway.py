@@ -51,6 +51,7 @@ from actor_critic_tpu.serving.batcher import (
 )
 from actor_critic_tpu.serving.policy_store import PolicyStore, UnknownPolicy
 from actor_critic_tpu.telemetry import sampler as _sampler
+from actor_critic_tpu.utils.numguard import NonFiniteError
 
 
 def standalone_metrics(batcher: MicroBatcher) -> str:
@@ -343,6 +344,11 @@ class ServeGateway:
             return 404, {"error": str(e)}
         except FileNotFoundError as e:
             return 400, {"error": f"checkpoint restore failed: {e}"}
+        except NonFiniteError as e:
+            # The ISSUE 14 swap gate refusing a nan/inf checkpoint is a
+            # deliberate 4xx (the client named bad input; the previous
+            # policy version keeps serving), not a 500 server fault.
+            return 422, {"error": str(e)}
         return 200, {"policy": handle.policy_id, "version": handle.version}
 
     def healthz(self) -> tuple[int, dict]:

@@ -22,6 +22,8 @@ import dataclasses
 import threading
 from typing import Any, Optional
 
+from actor_critic_tpu.utils import numguard
+
 
 class UnknownPolicy(KeyError):
     """Request named a policy id that is not resident."""
@@ -81,8 +83,18 @@ class PolicyStore:
         """Hot-swap a resident policy's params (default: bump its
         version by one). Preparation (device placement + uncommit) runs
         OUTSIDE the lock — a multi-MB restore must not block the
-        dispatcher's get() — then the handle is replaced atomically."""
+        dispatcher's get() — then the handle is replaced atomically.
+
+        Non-finite params refuse to install (`NonFiniteError`,
+        ISSUE 14): a poisoned handle would serve nan actions to every
+        client of the gateway from the next dispatch on. The refusal
+        leaves the previous handle resident — in-flight and future
+        requests keep acting on the last good version. The gate runs
+        AFTER the handle resolution so an unknown policy id still
+        surfaces as UnknownPolicy (a 404, not a misdirected 422), and
+        the cheap lookup precedes the full-tree sweep."""
         old = self.get(policy_id)
+        numguard.check_finite(params, "policy swap", name="params")
         prepared = old.engine.prepare_params(params) if prepare else params
         with self._lock:
             # Re-read under the lock: concurrent swaps must version off

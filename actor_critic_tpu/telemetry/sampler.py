@@ -20,11 +20,12 @@ allocator query), so the cadence costs the training loop nothing.
 
 from __future__ import annotations
 
-import json
 import sys
 import threading
 import time
 from typing import IO, Callable, Optional
+
+from actor_critic_tpu.utils.numguard import safe_json_row
 
 _PAGE = 4096
 try:
@@ -189,7 +190,13 @@ class ResourceSampler:
 
     def _emit(self) -> None:
         try:
-            self._fh.write(json.dumps(sample_row(), allow_nan=False) + "\n")
+            # safe_json_row, not json.dumps(allow_nan=False): one NaN
+            # gauge (a diverged loss ridden into a registered gauge)
+            # would otherwise raise ValueError on EVERY tick and
+            # silently end resource sampling for the rest of the run —
+            # the ISSUE 14 telemetry crash class. Non-finite values
+            # serialize as null and the key is reported once on stderr.
+            self._fh.write(safe_json_row(sample_row()) + "\n")
         except (OSError, ValueError):
             # OSError (disk full) would otherwise kill the daemon thread
             # and silently end sampling for the rest of the run; skip

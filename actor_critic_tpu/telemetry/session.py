@@ -19,7 +19,6 @@ the most recently entered phase anywhere in the process.
 
 from __future__ import annotations
 
-import json
 import os
 import threading
 import time
@@ -34,6 +33,7 @@ from actor_critic_tpu.telemetry.sampler import (
     ensure_compile_listener,
 )
 from actor_critic_tpu.telemetry.spans import SpanTracer
+from actor_critic_tpu.utils.numguard import safe_json_row
 
 _SESSION: Optional["TelemetrySession"] = None
 
@@ -287,9 +287,13 @@ class TelemetrySession:
     def event(self, kind: str, **fields) -> None:
         row = {"ts": round(time.time(), 3), "kind": kind, **fields}
         try:
-            line = json.dumps(row, allow_nan=False, default=str) + "\n"
+            # safe_json_row: a non-finite event field (a NaN loss in a
+            # divergence event's payload!) becomes null instead of the
+            # WHOLE event vanishing — losing exactly the forensic row
+            # the run needed (ISSUE 14).
+            line = safe_json_row(row, default=str) + "\n"
         except (TypeError, ValueError):
-            return  # non-finite / unserializable field; never raise
+            return  # unserializable field; never raise
         # Bounded acquire, not `with`: the watchdog thread calls this
         # from the stall path while the training thread may be wedged
         # INSIDE an events write (hung filesystem — the very stall class

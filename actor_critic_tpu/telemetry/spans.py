@@ -14,11 +14,12 @@ unix epoch so wall-clock can be recovered.
 
 from __future__ import annotations
 
-import json
 import os
 import threading
 import time
 from typing import IO, Optional
+
+from actor_critic_tpu.utils.numguard import safe_json_row
 
 # Canonical phase-span vocabulary. Every `telemetry.span(...)` /
 # `complete_span(...)` / `instant(...)` name in the codebase must come
@@ -67,7 +68,10 @@ class SpanTracer:
 
     def _write(self, evt: dict) -> None:
         try:
-            line = json.dumps(evt, allow_nan=False)
+            # safe_json_row: a non-finite span arg (e.g. a NaN metric
+            # riding an `update` span) serializes as null instead of
+            # ValueError-dropping the whole event (ISSUE 14).
+            line = safe_json_row(evt)
             with self._lock:
                 self._fh.write(line + "\n")
         except (OSError, ValueError):
@@ -152,9 +156,7 @@ class SpanTracer:
         per record would be real hot-loop overhead."""
         try:
             lines = [
-                json.dumps(
-                    self._foreign_evt(*item), allow_nan=False
-                )
+                safe_json_row(self._foreign_evt(*item))
                 for item in items
             ]
             if not lines:

@@ -1,9 +1,13 @@
-from actor_critic_tpu.utils.checkpoint import (
-    Checkpointer,
-    checkpointed_train,
-    resume_or_init,
-)
+"""Shared utilities. The checkpoint re-exports resolve LAZILY (PEP 562):
+`utils.checkpoint` pulls jax + orbax at import, and the jax-free modules
+(`serving/policy_store.py`, `algos/traj_queue.py` — racesan's
+queue/publisher exercisers depend on that) import siblings like
+`utils.numguard` through this package, which must not cost them the
+whole jax stack."""
+
 from actor_critic_tpu.utils.logging import JsonlLogger
+
+_CHECKPOINT_EXPORTS = ("Checkpointer", "checkpointed_train", "resume_or_init")
 
 __all__ = [
     "Checkpointer",
@@ -11,3 +15,13 @@ __all__ = [
     "checkpointed_train",
     "resume_or_init",
 ]
+
+
+def __getattr__(name):
+    if name in _CHECKPOINT_EXPORTS:
+        from actor_critic_tpu.utils import checkpoint
+
+        return getattr(checkpoint, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}"
+    )

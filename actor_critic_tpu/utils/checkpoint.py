@@ -26,6 +26,8 @@ import jax
 import jax.numpy as jnp
 import orbax.checkpoint as ocp
 
+from actor_critic_tpu.utils import numguard
+
 
 def _is_typed_key(x) -> bool:
     return hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jax.dtypes.prng_key)
@@ -144,7 +146,17 @@ class Checkpointer:
         Metrics ride along as a JSON item so a resume that finds nothing
         left to run can still report the run's final metrics instead of
         an empty dict (see `checkpointed_train`).
+
+        Non-finite STATE refuses to commit (`NonFiniteError`, ISSUE 14):
+        a NaN-poisoned params tree written to disk is inherited by every
+        future resume — the previous good checkpoint must stay the
+        latest instead. The gate sweeps packed (plain-array) leaves, so
+        typed PRNG keys cost nothing; metrics may legitimately carry a
+        non-finite loss (that IS the forensic record of a divergence)
+        and are never refused.
         """
+        packed = pack_keys(state)
+        numguard.check_finite(packed, "checkpoint commit", name="state")
         m = {k: float(v) for k, v in (metrics or {}).items()}
         # The item is named `run_metrics` because newer orbax reserves
         # the bare name `metrics` for its own best-checkpoint tracking
@@ -152,7 +164,7 @@ class Checkpointer:
         return self._mgr.save(
             step,
             args=ocp.args.Composite(
-                state=ocp.args.StandardSave(pack_keys(state)),
+                state=ocp.args.StandardSave(packed),
                 run_metrics=ocp.args.JsonSave(m),
             ),
             force=force,

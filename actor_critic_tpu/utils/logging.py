@@ -16,12 +16,12 @@ hard-depends on TF).
 
 from __future__ import annotations
 
-import json
 import os
 import time
 from typing import IO, Optional
 
 from actor_critic_tpu.utils.cadence import finite_or_none
+from actor_critic_tpu.utils.numguard import safe_json_row
 
 
 class JsonlLogger:
@@ -53,6 +53,11 @@ class JsonlLogger:
             "wall_s": round(time.time() - self._t0, 3),
         }
         for k, v in {**metrics, **extra}.items():
+            if isinstance(v, (dict, list, tuple)):
+                # Structured extras pass through as JSON containers;
+                # safe_json_row scrubs any non-finite floats inside.
+                row[k] = v
+                continue
             try:
                 float(v)
             except (TypeError, ValueError):
@@ -62,7 +67,10 @@ class JsonlLogger:
                 # valid strict JSON) via the shared scrub.
                 row[k] = finite_or_none(v)
         if self._fh is not None:
-            self._fh.write(json.dumps(row, allow_nan=False) + "\n")
+            # Belt (finite_or_none above) AND suspenders: extra values
+            # injected through **extra can nest containers the scrub
+            # above never saw; safe_json_row keeps the row serializable.
+            self._fh.write(safe_json_row(row) + "\n")
         if self._echo:
             short = ", ".join(
                 f"{k}={v:.4g}" if isinstance(v, float) else f"{k}={v}"

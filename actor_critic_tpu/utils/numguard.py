@@ -1,0 +1,197 @@
+"""Numerics guards: finite-tree gates + NaN-safe JSON (ISSUE 14).
+
+One non-finite value defeats every durability mechanism this repo has:
+`json.dumps(..., allow_nan=False)` raises and the telemetry row is
+silently dropped (the sampler/spans/session crash class), a checkpoint
+commits poisoned params that every resume inherits, a published
+snapshot diffuses NaN through the PR 9 gossip ring to the whole fleet,
+and a gateway swap serves it to clients. This module is the ONE home of
+the two counter-measures:
+
+- **Finite-tree gates** (`check_finite` / `nonfinite_leaves`): a
+  numpy-only sweep over a pytree's inexact leaves that names WHERE the
+  poison sits (`params['w'][3]: nan`). The fragile sinks call it at
+  their commit point — `Checkpointer.save`, `multihost.write_params`,
+  `PolicyPublisher.publish`, `PolicyStore.swap` — so a poisoned tree is
+  refused BEFORE it becomes durable/visible and the previous good
+  snapshot stays in place. Integer/bool leaves are skipped without
+  conversion (no device transfer, no false positives); denormals and
+  merely-huge values pass (the gate refuses only nan/±inf — numsan's
+  denormal poisoner exists to prove the gate does NOT over-fire).
+
+- **NaN-safe JSON** (`safe_json_row`): strict-JSON serialization that
+  maps non-finite floats to `null` instead of raising, and reports each
+  offending key ONCE per process on stderr (a NaN loss gauge must not
+  silently end resource sampling for the rest of the run — nor spam one
+  line per 5 s tick). Every telemetry writer routes through here.
+
+`analysis/numsan.py` poisons real trees against these gates (and
+monkeypatches `check_finite` to a no-op to prove its detectors catch a
+reverted gate); the `sink-guard` jaxlint pass statically requires the
+gates' presence at the sink definitions.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import sys
+import threading
+
+import numpy as np
+
+
+class NonFiniteError(ValueError):
+    """A finite-tree gate refused a tree carrying nan/±inf leaves."""
+
+
+def _classify(v: float) -> str:
+    if math.isnan(v):
+        return "nan"
+    return "inf" if v > 0 else "-inf"
+
+
+def _walk(tree, path: str, out: list) -> None:
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            _walk(v, f"{path}[{k!r}]", out)
+        return
+    if isinstance(tree, (list, tuple)):
+        fields = getattr(type(tree), "_fields", None)
+        for i, v in enumerate(tree):
+            key = fields[i] if fields else i
+            _walk(v, f"{path}.{key}" if fields else f"{path}[{i}]", out)
+        return
+    if isinstance(tree, (bool, int, str, bytes)) or tree is None:
+        return
+    if isinstance(tree, float):
+        if not math.isfinite(tree):
+            out.append((path, _classify(tree)))
+        return
+    dtype = getattr(tree, "dtype", None)
+    if dtype is None:
+        return
+    # Integer/bool/key leaves cannot be non-finite: skip them before
+    # np.asarray so a device-resident int ring never pays a transfer.
+    # Unclassifiable dtypes (typed PRNG keys reaching here unpacked,
+    # future extended dtypes) are skipped rather than crashing the
+    # commit the gate protects.
+    try:
+        if not np.issubdtype(np.dtype(dtype), np.inexact):
+            return
+        arr = np.asarray(tree)
+        finite = np.isfinite(arr)
+    except TypeError:
+        return
+    if bool(np.all(finite)):
+        return
+    flat = arr.reshape(-1)
+    bad = np.flatnonzero(~finite.reshape(-1))
+    # First few positions are enough to localize the poison; the full
+    # index list of a poisoned replay ring would be the real spam.
+    for idx in bad[:3]:
+        out.append((f"{path}[{int(idx)}]", _classify(float(flat[idx]))))
+    if bad.size > 3:
+        out.append((f"{path}", f"... {int(bad.size) - 3} more"))
+
+
+def nonfinite_leaves(tree, name: str = "tree") -> list[tuple[str, str]]:
+    """[(path, 'nan'|'inf'|'-inf'), ...] for every non-finite element of
+    the pytree's float leaves (first few positions per leaf). Pure
+    numpy/stdlib — importable from the jax-free serving/analysis
+    modules."""
+    out: list[tuple[str, str]] = []
+    _walk(tree, name, out)
+    return out
+
+
+def check_finite(tree, what: str, name: str = "tree") -> None:
+    """The commit-point gate: raise `NonFiniteError` naming the poisoned
+    leaves when `tree` carries nan/±inf, else return silently. `what`
+    names the refusing sink for the error message ("checkpoint state",
+    "published params", ...)."""
+    bad = nonfinite_leaves(tree, name)
+    if bad:
+        detail = ", ".join(f"{p}: {k}" for p, k in bad[:6])
+        raise NonFiniteError(
+            f"{what} refused: non-finite values at {detail} — a "
+            "nan/inf tree must never become durable or visible to "
+            "peers/clients (fix the producer; see scripts/numsan.py "
+            "for the guard contract)"
+        )
+
+
+# ---------------------------------------------------------------------------
+# NaN-safe JSON rows
+# ---------------------------------------------------------------------------
+
+# Keys already reported this process (once-per-key stderr contract).
+# Module global mutated under the lock: telemetry writers call from
+# sampler/span threads concurrently.
+_reported: set[str] = set()
+_reported_lock = threading.Lock()
+
+
+def _scrub(value, key: str, bad: list):
+    if isinstance(value, bool) or value is None:
+        return value
+    if isinstance(value, (int, str)):
+        return value
+    if isinstance(value, float):
+        if math.isfinite(value):
+            return value
+        bad.append(key)
+        return None
+    if isinstance(value, dict):
+        return {k: _scrub(v, f"{key}.{k}" if key else str(k), bad)
+                for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_scrub(v, key, bad) for v in value]
+    if isinstance(value, np.floating):
+        f = float(value)
+        if math.isfinite(f):
+            return f
+        bad.append(key)
+        return None
+    if isinstance(value, np.integer):
+        return int(value)
+    if isinstance(value, np.ndarray):
+        if value.ndim == 0:
+            return _scrub(value.item(), key, bad)
+        # Small arrays riding a row (a per-type vector, a weights
+        # stage) serialize as scrubbed lists — json.dumps has no
+        # default for ndarray and a telemetry row must never crash.
+        return _scrub(value.tolist(), key, bad)
+    return value  # json.dumps's `default` (or the str fallback) handles it
+
+
+def safe_json_row(row: dict, default=None) -> str:
+    """One strict-JSON line for a telemetry/metrics row: non-finite
+    floats (python or numpy, nested) become `null` and the offending key
+    is reported ONCE per process on stderr — the row itself always
+    serializes, so one NaN gauge can never end sampling/span emission
+    for the rest of a run (the `allow_nan=False` sites this replaces
+    raised ValueError and silently dropped the whole row)."""
+    bad: list[str] = []
+    clean = _scrub(row, "", bad)
+    if bad:
+        with _reported_lock:
+            fresh = [k for k in bad if k not in _reported]
+            _reported.update(fresh)
+        for k in fresh:
+            print(
+                f"[numguard] non-finite value under key {k!r} written as "
+                "null (reported once per key; fix the producer)",
+                file=sys.stderr,
+            )
+    try:
+        # jaxlint: disable=sink-guard (this IS the one audited
+        # allow_nan=False site: every value above was just scrubbed
+        # finite)
+        return json.dumps(clean, allow_nan=False, default=default)
+    except TypeError:
+        # A foreign leaf (jax.Array, set, dataclass) with no `default`
+        # supplied: stringify rather than crash the writer — the
+        # never-take-the-run-down contract every telemetry sink keeps.
+        # jaxlint: disable=sink-guard (same audited site, str fallback)
+        return json.dumps(clean, allow_nan=False, default=str)

@@ -467,7 +467,27 @@ def bench_update_wall():
             jax.block_until_ready(out)
         return (time.perf_counter() - t0) / reps
 
-    plain_s = timeit(ppo.make_host_update_step(spec, cfg))
+    plain_update = ppo.make_host_update_step(spec, cfg)
+    plain_s = timeit(plain_update)
+
+    # Guard-overhead measurement (ISSUE 14 satellite): the SAME compiled
+    # update plus the numguard finite-gate sweep over the updated params
+    # — what a per-update gate costs. The async drivers DO pay a gate on
+    # this cadence (PolicyPublisher.publish runs check_finite once per
+    # published update); the checkpoint gate runs on the save cadence.
+    # This row prices the per-update sweep directly so the overhead is
+    # a measured number, not a guess. Trended as `update_wall.guarded_ms`.
+    from actor_critic_tpu.utils import numguard
+
+    def guarded_update(*args):
+        out = plain_update(*args)
+        numguard.check_finite(
+            jax.device_get(out[0]), "bench finite-gate", name="params"
+        )
+        return out
+
+    guarded_s = timeit(guarded_update)
+
     vtrace_s = timeit(
         ppo.make_async_update_step(spec, cfg, correction="vtrace")
     )
@@ -523,6 +543,8 @@ def bench_update_wall():
         "unit": "ms per host-PPO update ([64, 8] block, 4 epochs x 4 "
                 "minibatches, fenced)",
         "updates_per_s": round(1.0 / plain_s, 1),
+        "guarded_ms": round(guarded_s * 1e3, 2),
+        "guard_overhead_x": round(guarded_s / plain_s, 2),
         "vtrace_corrected_ms": round(vtrace_s * 1e3, 2),
         "vtrace_overhead_x": round(vtrace_s / plain_s, 2),
         "device_plane_ms": round(device_s * 1e3, 2),
@@ -808,7 +830,9 @@ def bench_scenario_fleet():
         # this shape (1/sum(1/r_i) is the series rate of stepping each
         # homogeneous fleet in turn).
         "overhead_vs_series_x": round(
-            (1.0 / sum(1.0 / r for r in per_type.values())) / mix_sps, 2
+            # audited: the rates are measured steps/s of runs that
+            # completed — strictly positive, neither division can be /0
+            (1.0 / sum(1.0 / r for r in per_type.values())) / mix_sps, 2  # jaxlint: disable=nonfinite-hazard
         ),
     }
 

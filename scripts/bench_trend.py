@@ -261,6 +261,17 @@ def scenario_sweep_cell(rec: dict | None) -> str:
     return _numeric_cell(sweep.get("peak_steps_per_s"))
 
 
+def update_wall_guarded_cell(rec: dict | None) -> str:
+    """The ISSUE 14 finite-gate overhead wall (`guarded_ms`) of the
+    update-wall record (`-` before the field existed, `?` malformed)."""
+    entry, cell = _metric_entry(rec, "update_wall")
+    if entry is None:
+        return cell
+    if "guarded_ms" not in entry:
+        return "-"
+    return _numeric_cell(entry.get("guarded_ms"))
+
+
 def data_plane_cell(rec: dict | None, plane: str) -> str:
     """One plane's consumed env-steps/s from the ISSUE 13 data-plane
     A/B record (`-` before the metric existed, `?` malformed)."""
@@ -353,6 +364,14 @@ def trend_rows(root: str) -> tuple[list[int], list[tuple[str, list[str]]]]:
             rows.append((
                 "multihost_scaling.recover_s",
                 [multihost_recover_cell(r) for r in recs],
+            ))
+        if name == "update_wall":
+            # Numerics-guard sub-row (ISSUE 14): the update wall with
+            # the per-update finite-gate on, so the guard overhead
+            # trends as a measured number next to the wall it taxes.
+            rows.append((
+                "update_wall.guarded_ms",
+                [update_wall_guarded_cell(r) for r in recs],
             ))
         if name == "scenario_fleet":
             # Scenario-universe sub-rows (ISSUE 11): the heterogeneous

@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""numsan: deterministic NaN/Inf/saturation fault sanitizer for the
+training-serving stack (ISSUE 14).
+
+    python scripts/numsan.py                       # quick profile
+    python scripts/numsan.py --schedules 64        # wider sweep
+    python scripts/numsan.py --scenario publish --revert
+                                                   # reproduce a
+                                                   # reverted publish
+                                                   # gate (exit 1)
+    python scripts/numsan.py --scenario checkpoint --revert
+                                                   # reverted commit
+                                                   # gate (exit 1)
+    python scripts/numsan.py --scenario codec --revert
+                                                   # pre-fix wrapping
+                                                   # encoder (exit 1)
+    python scripts/numsan.py --json                # machine output
+
+Exit codes (scripts/tier1.sh runs the quick profile between fleetsan
+and pytest, under its own timeout):
+    0  clean: every poisoned schedule was blocked by its named guard
+       (divergence event, checkpoint refusal, publish/mailbox/swap
+       rejection, codec saturation) and no guard over-fired on the
+       tolerated poisons
+    1  violation: a poison crossed a guard — or a reverted-guard mode
+       was detected (the sanitizer working)
+    2  crash: unexpected error (a broken exerciser, not a detection)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[1].strip())
+    p.add_argument(
+        "--schedules", type=int, default=16,
+        help="seeded fault schedules to sweep (default 16, the tier-1 "
+        "quick profile: split across update/publish/checkpoint/codec)",
+    )
+    p.add_argument(
+        "--seed0", type=int, default=0,
+        help="first seed of the sweep (fixed seeds keep tier-1 "
+        "deterministic; a violation names its seed for bit-identical "
+        "replay)",
+    )
+    p.add_argument(
+        "--scenario",
+        choices=("all", "update", "publish", "checkpoint", "codec"),
+        default="all",
+        help="which unit to exercise (default: the quick profile; "
+        "'update' drives the real jitted PPO update + "
+        "DivergenceMonitor, 'publish' the PolicyPublisher/mailbox/"
+        "PolicyStore gates, 'checkpoint' a real orbax commit, 'codec' "
+        "the int8/f16 saturation contract)",
+    )
+    p.add_argument(
+        "--revert", action="store_true",
+        help="reverted-guard mode (expected exit 1): no-op the "
+        "check_finite gates (publish/checkpoint) or run the pre-fix "
+        "wrapping encoder (codec) — numsan must detect the leak on "
+        "every schedule",
+    )
+    p.add_argument("--json", action="store_true", help="machine output")
+    args = p.parse_args(argv)
+
+    from actor_critic_tpu.analysis import numsan
+
+    if args.revert and args.scenario in ("all", "update"):
+        print(
+            "numsan: error: --revert needs --scenario "
+            "publish|checkpoint|codec (the update scenario's guard is "
+            "the DivergenceMonitor itself)",
+            file=sys.stderr,
+        )
+        return 2
+
+    try:
+        if args.scenario == "all":
+            out = numsan.quick_profile(
+                schedules=args.schedules, seed0=args.seed0
+            )
+        else:
+            scenario = {
+                "update": lambda s: numsan.exercise_update(s),
+                "publish": lambda s: numsan.exercise_publish(
+                    s, revert=args.revert
+                ),
+                "checkpoint": lambda s: numsan.exercise_checkpoint(
+                    s, revert=args.revert
+                ),
+                "codec": lambda s: numsan.exercise_codec(
+                    s, revert=args.revert
+                ),
+            }[args.scenario]
+            out = numsan.exercise_sweep(
+                range(args.seed0, args.seed0 + args.schedules), scenario
+            )
+    except numsan.NumSanError as e:
+        # A detection names its seed: rerun that single seed to replay
+        # the poison schedule bit-identically.
+        print(f"numsan: VIOLATION DETECTED: {e}", file=sys.stderr)
+        return 1
+    except Exception as e:
+        print(f"numsan: error: {type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(json.dumps(out, indent=2, default=str))
+    else:
+        print(
+            f"numsan: {out.get('schedules', 0)} fault schedule(s) "
+            "clean — every poison blocked by its named guard"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

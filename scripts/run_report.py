@@ -607,6 +607,22 @@ def static_findings() -> list[str]:
             "check-then-act) — `python scripts/racesan.py` exercises "
             "the queue/publisher units under deterministic schedules",
         ]
+    num = [
+        f for f in new
+        if f.get("check")
+        in ("precision-discipline", "nonfinite-hazard", "sink-guard")
+    ]
+    if num:
+        # Numerics row (ISSUE 14): a run being diagnosed for a NaN loss
+        # or silent precision drift should surface "the tree has
+        # unaudited numerics hazards" before the per-finding list.
+        out += [
+            f"- **numerics**: {len(num)} of these are precision/"
+            "non-finite hazards (precision-discipline / "
+            "nonfinite-hazard / sink-guard) — `python scripts/"
+            "numsan.py` poisons the real update/codec/publish/"
+            "checkpoint objects under deterministic schedules",
+        ]
     dist = [
         f for f in new
         if f.get("check")

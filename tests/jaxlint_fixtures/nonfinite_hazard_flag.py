@@ -1,0 +1,41 @@
+"""nonfinite-hazard flag fixture: every hazard class fires.
+
+Parsed (never imported) by tests/test_jaxlint.py.
+"""
+
+import jax.numpy as jnp
+
+
+def unguarded_log(x):
+    # one zero/negative element is -inf/nan in the loss
+    return jnp.log(x)
+
+
+def unguarded_sqrt(v):
+    # a variance estimate slightly below zero is nan
+    return jnp.sqrt(v)
+
+
+def unguarded_squashed_log_prob(action):
+    # arctanh of a stored squashed action at exactly ±1 is ±inf
+    pre_tanh = jnp.arctanh(action)
+    return -0.5 * pre_tanh * pre_tanh
+
+
+def unguarded_ratio(log_prob, old_log_prob, adv):
+    # the PPO/V-trace surrogate shape: policy drift overflows to inf,
+    # inf × 0 advantage is nan
+    ratio = jnp.exp(log_prob - old_log_prob)
+    return ratio * adv
+
+
+def fresh_scale_seed(shape):
+    # the PR 8 class: a 1.0 seed floors the quantization step forever
+    scale = jnp.ones(shape)
+    return {"mean": jnp.zeros(shape), "scale": scale}
+
+
+def unfloored_normalize(x):
+    total = jnp.sum(x)
+    # a constant batch makes the denominator exactly zero
+    return x / total

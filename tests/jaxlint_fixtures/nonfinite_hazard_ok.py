@@ -1,0 +1,50 @@
+"""nonfinite-hazard near-miss fixture: each hazard class written with
+the sanctioned guard idiom — must stay completely clean.
+
+Parsed (never imported) by tests/test_jaxlint.py.
+"""
+
+import jax.numpy as jnp
+
+_EPS = 1e-6
+
+
+def floored_log(x):
+    return jnp.log(x + _EPS)
+
+
+def maximum_floored_log(x):
+    return jnp.log(jnp.maximum(x, _EPS))
+
+
+def producer_guarded_sqrt(x):
+    var = jnp.var(x)
+    return jnp.sqrt(var)
+
+
+def clipped_squashed_log_prob(action):
+    clipped = jnp.clip(action, -1.0 + 1e-6, 1.0 - 1e-6)
+    pre_tanh = jnp.arctanh(clipped)
+    return -0.5 * pre_tanh * pre_tanh
+
+
+def capped_ratio(log_prob, old_log_prob, adv):
+    ratio = jnp.exp(jnp.minimum(log_prob - old_log_prob, 20.0))
+    return ratio * adv
+
+
+def eps_scale_seed(shape):
+    # the quantize.init_stats idiom: seeded AT the _EPS floor
+    scale = jnp.full(shape, _EPS)
+    return {"mean": jnp.zeros(shape), "scale": scale}
+
+
+def floored_normalize(x):
+    total = jnp.sum(x)
+    return x / jnp.maximum(total, _EPS)
+
+
+def conditionally_guarded_rate(x):
+    total = jnp.sum(x)
+    # the host-side ternary guard idiom
+    return x / total if total > 0 else x

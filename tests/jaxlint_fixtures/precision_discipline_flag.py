@@ -1,0 +1,33 @@
+"""precision-discipline flag fixture: every hazard class fires.
+
+Parsed (never imported) by tests/test_jaxlint.py.
+"""
+
+import jax.numpy as jnp
+
+
+def device_f64(shape):
+    # float64 on the device namespace: silently demotes without x64,
+    # doubles every buffer with it.
+    return jnp.zeros(shape, jnp.float64)
+
+
+def mixed_precision(shape):
+    acts = jnp.zeros(shape, jnp.bfloat16)
+    weights = jnp.ones(shape, jnp.float32)
+    # bf16 × f32 promotes silently: the bf16 compute intent is lost.
+    return acts * weights
+
+
+def narrow_accumulator(shape):
+    acts = jnp.zeros(shape, jnp.bfloat16)
+    # the bf16-accumulator revert: sum accumulates IN bf16 (no dtype=)
+    return jnp.sum(acts)
+
+
+def decode(kind, q):
+    # return dtype forks on the codec kind: raw passes through, the
+    # rest return float32 — downstream dtypes depend on a config string
+    if kind == "raw":
+        return q
+    return q.astype(jnp.float32)

@@ -1,0 +1,40 @@
+"""precision-discipline near-miss fixture: the sanctioned idioms of
+each flagged class — must stay completely clean.
+
+Parsed (never imported) by tests/test_jaxlint.py.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def host_f64_welford(shape):
+    # float64 on HOST numpy is the sanctioned normalizer idiom.
+    return np.zeros(shape, np.float64)
+
+
+def explicit_cast(shape):
+    acts = jnp.zeros(shape, jnp.bfloat16)
+    weights = jnp.ones(shape, jnp.float32)
+    # the explicit astype states the intent: no silent promotion
+    return acts.astype(jnp.float32) * weights
+
+
+def wide_accumulator(shape):
+    acts = jnp.zeros(shape, jnp.bfloat16)
+    # fp32 accumulator over the narrow operand: the sanctioned idiom
+    return jnp.sum(acts, dtype=jnp.float32)
+
+
+def config_selected_dtype(shape, bf16_compute):
+    # the repo's bf16_compute selection: deliberately unresolvable,
+    # both arms are possible — must not read as mixing
+    dtype = jnp.bfloat16 if bf16_compute else jnp.float32
+    return jnp.zeros(shape, dtype)
+
+
+def decode(kind, q):
+    # every branch normalizes to float32: no fork on the codec kind
+    if kind == "raw":
+        return q.astype(jnp.float32)
+    return q.astype(jnp.float32)

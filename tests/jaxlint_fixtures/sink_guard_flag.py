@@ -1,0 +1,38 @@
+"""sink-guard flag fixture: fragile sinks without finiteness gates.
+
+Parsed (never imported) by tests/test_jaxlint.py.
+"""
+
+import json
+
+PARAMS_ON_DISK = {}
+
+
+def emit_row(fh, row):
+    # allow_nan=False raises on the first NaN and the row vanishes —
+    # the telemetry sampler crash class
+    fh.write(json.dumps(row, allow_nan=False) + "\n")
+
+
+def write_params(mailbox_dir, rank, version, params):
+    # ungated mailbox publish: a nan snapshot diffuses to every peer
+    PARAMS_ON_DISK[(mailbox_dir, rank)] = (version, params)
+
+
+class Publisher:
+    def publish(self, params, version):
+        # ungated behavior-params publish: every actor inherits the nan
+        self._params = (version, params)
+
+
+class Store:
+    def swap(self, policy_id, params, version=None):
+        # ungated gateway swap: clients get nan actions next dispatch
+        self._handles[policy_id] = (version, params)
+        return self._handles[policy_id]
+
+
+class Checkpointer:
+    def save(self, step, state):
+        # ungated checkpoint commit: every future resume inherits it
+        self._steps[step] = state

@@ -1,0 +1,39 @@
+"""sink-guard near-miss fixture: the same sinks carrying the
+sanctioned gates — must stay completely clean.
+
+Parsed (never imported) by tests/test_jaxlint.py.
+"""
+
+from actor_critic_tpu.utils import numguard
+from actor_critic_tpu.utils.numguard import safe_json_row
+
+PARAMS_ON_DISK = {}
+
+
+def emit_row(fh, row):
+    # non-finite floats become null; the row always serializes
+    fh.write(safe_json_row(row) + "\n")
+
+
+def write_params(mailbox_dir, rank, version, params):
+    numguard.check_finite(params, "mailbox publish")
+    PARAMS_ON_DISK[(mailbox_dir, rank)] = (version, params)
+
+
+class Publisher:
+    def publish(self, params, version):
+        numguard.check_finite(params, "behavior-params publish")
+        self._params = (version, params)
+
+
+class Store:
+    def swap(self, policy_id, params, version=None):
+        numguard.check_finite(params, "policy swap")
+        self._handles[policy_id] = (version, params)
+        return self._handles[policy_id]
+
+
+class Checkpointer:
+    def save(self, step, state):
+        numguard.check_finite(state, "checkpoint commit")
+        self._steps[step] = state

@@ -65,6 +65,42 @@ def test_trend_rows_union_and_cells(tmp_path):
     assert table["replay_sample_throughput"][1] != "-"
 
 
+def _write_guarded_rounds(root: Path):
+    """r01 before guarded_ms existed, r02 carrying it, r03 malformed
+    (guarded_ms a string), r04 the whole entry a failed subprocess."""
+    (root / "BENCH_r01.json").write_text(json.dumps({
+        "metric": "a2c", "value": 1.0,
+        "cpu_metrics": {"update_wall": {"value": 8.0}},
+    }) + "\n")
+    (root / "BENCH_r02.json").write_text(json.dumps({
+        "metric": "a2c", "value": 1.0,
+        "cpu_metrics": {"update_wall": {
+            "value": 8.1, "guarded_ms": 8.9, "guard_overhead_x": 1.1,
+        }},
+    }) + "\n")
+    (root / "BENCH_r03.json").write_text(json.dumps({
+        "metric": "a2c", "value": 1.0,
+        "cpu_metrics": {"update_wall": {
+            "value": 8.2, "guarded_ms": "oops",
+        }},
+    }) + "\n")
+    (root / "BENCH_r04.json").write_text(json.dumps({
+        "metric": "a2c", "value": 1.0,
+        "cpu_metrics": {"update_wall": {"error": "rc=1: boom"}},
+    }) + "\n")
+
+
+def test_update_wall_guarded_sub_row(tmp_path):
+    """ISSUE 14 satellite: guarded_ms trends as an update_wall sub-row
+    — '-' before the field existed, '?' where it is malformed, 'err'
+    when the whole metric subprocess failed."""
+    mod = _load()
+    _write_guarded_rounds(tmp_path)
+    _rounds, rows = mod.trend_rows(str(tmp_path))
+    table = dict(rows)
+    assert table["update_wall.guarded_ms"] == ["-", "8.9", "?", "err"]
+
+
 def _write_multihost_rounds(root: Path):
     """r01 without the metric, r02 a full multihost record, r03 a
     malformed one (sync curve not a dict), r04 an unparseable file."""

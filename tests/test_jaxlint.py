@@ -45,6 +45,9 @@ PAIRS = [
     ("collective-discipline", "collective_discipline"),
     ("mailbox-protocol", "mailbox_protocol"),
     ("rank-affinity", "rank_affinity"),
+    ("precision-discipline", "precision_discipline"),
+    ("nonfinite-hazard", "nonfinite_hazard"),
+    ("sink-guard", "sink_guard"),
 ]
 
 
@@ -430,7 +433,7 @@ def test_malformed_baseline_is_a_crash_not_a_clean_run(tmp_path):
 # ---------------------------------------------------------------------------
 
 
-def test_cli_list_checks_names_all_twelve(capsys):
+def test_cli_list_checks_names_all_fifteen(capsys):
     cli = _load_cli()
     assert cli.main(["--list-checks"]) == 0
     out = capsys.readouterr().out
@@ -439,6 +442,7 @@ def test_cli_list_checks_names_all_twelve(capsys):
         "recompile-hazard", "host-sync", "warmup-registry",
         "lock-discipline", "publish-aliasing", "check-then-act",
         "collective-discipline", "mailbox-protocol", "rank-affinity",
+        "precision-discipline", "nonfinite-hazard", "sink-guard",
     ):
         assert name in out
 
@@ -813,4 +817,121 @@ def test_global_version_clock_trips_mailbox_protocol(tmp_path):
     assert [f.check for f in flagged] == ["mailbox-protocol"]
     assert "per-peer" in flagged[0].message.lower() or (
         "PER RANK" in flagged[0].message
+    )
+
+
+# ---------------------------------------------------------------------------
+# the ISSUE 14 bug classes reproduce as findings (numerics acceptance)
+# ---------------------------------------------------------------------------
+
+# replay/quantize.init_stats as it would read with the PR 8 bug
+# re-introduced: the scale stats slot seeded at 1.0 instead of the
+# _EPS floor (the running max only grows, so the 1.0 seed permanently
+# floors the quantization step at 1/127). Reverting the fix must trip
+# nonfinite-hazard.
+_PRE_FIX_SCALE_SEED = (
+    "import jax.numpy as jnp\n"
+    "def init_stats(kind, example_leaf):\n"
+    "    shape = jnp.shape(example_leaf)\n"
+    "    mean = jnp.zeros(shape, jnp.float32)\n"
+    "    scale = jnp.full(shape, 1.0, jnp.float32)\n"
+    "    return {'mean': mean, 'scale': scale}\n"
+)
+
+
+def test_pr8_scale_seed_revert_trips_nonfinite_hazard(tmp_path):
+    flagged = _run_snippet(tmp_path, _PRE_FIX_SCALE_SEED)
+    assert [f.check for f in flagged] == ["nonfinite-hazard"]
+    assert "PR 8" in flagged[0].message
+    # the fixed quantize.py (the _EPS-floor seed) sweeps clean
+    assert (
+        analysis.analyze_paths(
+            ["actor_critic_tpu/replay/quantize.py"],
+            str(REPO),
+            checks=["nonfinite-hazard"],
+        )
+        == []
+    )
+
+
+# A bf16 compute path whose loss reduction lost its fp32 accumulator —
+# the revert the precision pass exists to catch before the ROADMAP's
+# bf16/Pallas work lands.
+_PRE_FIX_BF16_ACCUMULATOR = (
+    "import jax.numpy as jnp\n"
+    "def loss_terms(preds_f32, targets_f32):\n"
+    "    preds = preds_f32.astype(jnp.bfloat16)\n"
+    "    targets = targets_f32.astype(jnp.bfloat16)\n"
+    "    err = preds - targets\n"
+    "    return jnp.mean(err * err)\n"
+)
+
+
+def test_bf16_accumulator_revert_trips_precision_discipline(tmp_path):
+    flagged = _run_snippet(tmp_path, _PRE_FIX_BF16_ACCUMULATOR)
+    assert [f.check for f in flagged] == ["precision-discipline"]
+    assert "accumulate" in flagged[0].message.lower()
+    # the fp32-accumulator spelling is the near miss
+    fixed = _PRE_FIX_BF16_ACCUMULATOR.replace(
+        "jnp.mean(err * err)", "jnp.mean(err * err, dtype=jnp.float32)"
+    )
+    assert _run_snippet(tmp_path, fixed) == []
+
+
+# telemetry/sampler._emit as it was BEFORE the ISSUE 14 fix: the strict
+# allow_nan=False dumps — one NaN gauge raises ValueError on every tick
+# and resource sampling silently ends for the rest of the run.
+_PRE_FIX_SAMPLER = (
+    "import json\n"
+    "def emit(fh, sample_row):\n"
+    "    try:\n"
+    "        fh.write(json.dumps(sample_row(), allow_nan=False) + '\\n')\n"
+    "    except (OSError, ValueError):\n"
+    "        pass\n"
+)
+
+
+def test_sampler_nan_crash_revert_trips_sink_guard(tmp_path):
+    flagged = _run_snippet(tmp_path, _PRE_FIX_SAMPLER)
+    assert [f.check for f in flagged] == ["sink-guard"]
+    assert "safe_json_row" in flagged[0].message
+    # the fixed telemetry writers sweep clean
+    assert (
+        analysis.analyze_paths(
+            [
+                "actor_critic_tpu/telemetry/sampler.py",
+                "actor_critic_tpu/telemetry/spans.py",
+                "actor_critic_tpu/telemetry/session.py",
+                "actor_critic_tpu/utils/logging.py",
+            ],
+            str(REPO),
+            checks=["sink-guard"],
+        )
+        == []
+    )
+
+
+def test_ungated_commit_points_trip_sink_guard(tmp_path):
+    """Stripping the check_finite gate from a commit-point def (the
+    numsan reverted-guard mode, in source form) must resurface as a
+    sink-guard finding — and the real gated modules stay clean."""
+    src = (
+        "STORE = {}\n"
+        "def write_params(mailbox_dir, rank, version, params):\n"
+        "    STORE[(mailbox_dir, rank)] = (version, params)\n"
+    )
+    flagged = _run_snippet(tmp_path, src)
+    assert [f.check for f in flagged] == ["sink-guard"]
+    assert (
+        analysis.analyze_paths(
+            [
+                "actor_critic_tpu/parallel/multihost.py",
+                "actor_critic_tpu/serving/policy_store.py",
+                "actor_critic_tpu/algos/traj_queue.py",
+                "actor_critic_tpu/utils/checkpoint.py",
+            ],
+            str(REPO),
+            checks=["sink-guard"],
+        )
+        == []
     )

@@ -1,0 +1,290 @@
+"""Finiteness guards + NaN-safe JSON (ISSUE 14 satellites).
+
+Covers the `utils/numguard.py` primitives, the production gates wired
+onto them (publisher / mailbox / policy store / checkpoint), and the
+telemetry regression the ISSUE names: a NaN gauge through a LIVE
+sampler tick must emit a parseable row with the value nulled instead of
+silently dropping the row forever.
+"""
+
+import io
+import json
+
+import numpy as np
+import pytest
+
+from actor_critic_tpu.utils import numguard
+from actor_critic_tpu.utils.numguard import (
+    NonFiniteError,
+    check_finite,
+    nonfinite_leaves,
+    safe_json_row,
+)
+
+# ---------------------------------------------------------------------------
+# primitives
+# ---------------------------------------------------------------------------
+
+
+def test_nonfinite_leaves_names_paths_and_kinds():
+    tree = {
+        "w": np.array([1.0, np.nan], np.float32),
+        "nested": {"b": np.array([np.inf], np.float32)},
+        "ints": np.array([1, 2], np.int32),
+    }
+    bad = dict(nonfinite_leaves(tree, "params"))
+    assert bad["params['w'][1]"] == "nan"
+    assert bad["params['nested']['b'][0]"] == "inf"
+    assert not any("ints" in k for k in bad)
+
+
+def test_check_finite_passes_finite_denormal_and_int_trees():
+    check_finite({"w": np.ones((3,), np.float32)}, "t")
+    check_finite({"d": np.array([1e-42], np.float32)}, "t")  # denormal
+    check_finite({"i": np.arange(4)}, "t")
+    check_finite({"s": "str", "n": None, "f": 1.5}, "t")
+
+
+def test_check_finite_refuses_with_location():
+    with pytest.raises(NonFiniteError) as e:
+        check_finite(
+            {"w": np.array([0.0, -np.inf], np.float32)}, "unit test"
+        )
+    assert "unit test" in str(e.value)
+    assert "-inf" in str(e.value)
+
+
+def test_check_finite_handles_namedtuples_and_scalars():
+    from collections import namedtuple
+
+    Stats = namedtuple("Stats", "mean scale")
+    check_finite(Stats(np.zeros(2), np.full(2, 1e-6)), "t")
+    with pytest.raises(NonFiniteError):
+        check_finite(Stats(np.zeros(2), float("nan")), "t")
+
+
+# ---------------------------------------------------------------------------
+# safe_json_row
+# ---------------------------------------------------------------------------
+
+
+def test_safe_json_row_nulls_nonfinite_and_reports_once(capsys):
+    key = "test_unique_gauge_key_1"
+    line = safe_json_row({key: float("nan"), "ok": 2.0})
+    row = json.loads(line)
+    assert row[key] is None and row["ok"] == 2.0
+    first = capsys.readouterr().err
+    assert key in first
+    # second serialization of the same key: no second report
+    safe_json_row({key: float("inf")})
+    assert key not in capsys.readouterr().err
+
+
+def test_safe_json_row_handles_numpy_and_nested():
+    line = safe_json_row({
+        "np_nan": np.float32("nan"),
+        "np_int": np.int64(3),
+        "nested": {"v": [1.0, float("inf")]},
+    })
+    row = json.loads(line)
+    assert row["np_nan"] is None
+    assert row["np_int"] == 3
+    assert row["nested"]["v"][1] is None
+
+
+def test_safe_json_row_default_str_for_foreign_types():
+    class Weird:
+        def __str__(self):
+            return "weird"
+
+    row = json.loads(safe_json_row({"w": Weird()}, default=str))
+    assert "weird" in row["w"]
+
+
+# ---------------------------------------------------------------------------
+# the telemetry regression: a NaN gauge through a LIVE sampler tick
+# ---------------------------------------------------------------------------
+
+
+def test_nan_gauge_through_live_sampler_tick():
+    """The ISSUE 14 telemetry crash class: before the fix,
+    `json.dumps(..., allow_nan=False)` raised on the NaN gauge and the
+    sampler silently dropped EVERY row for the rest of the run. The row
+    must now serialize with the gauge nulled."""
+    from actor_critic_tpu.telemetry import sampler as sampler_mod
+    from actor_critic_tpu.telemetry.sampler import (
+        ResourceSampler,
+        register_gauge,
+        unregister_gauge,
+    )
+
+    key = register_gauge("nan_gauge_regression", lambda: float("nan"))
+    fh = io.StringIO()
+    s = ResourceSampler(fh, interval_s=60.0)
+    try:
+        s._emit()  # one live tick, thread never started
+    finally:
+        unregister_gauge(key)
+    lines = [ln for ln in fh.getvalue().splitlines() if ln]
+    assert lines, "the NaN gauge dropped the row (the pre-fix crash)"
+    row = json.loads(lines[-1])  # strict JSON: parses ⇒ no bare NaN
+    assert row[key] is None
+    assert "recompiles" in row
+    assert sampler_mod is not None
+
+
+def test_jsonl_logger_extra_payload_with_nonfinite(tmp_path):
+    from actor_critic_tpu.utils.logging import JsonlLogger
+
+    path = tmp_path / "m.jsonl"
+    with JsonlLogger(path) as log:
+        log.log(1, {"loss": float("nan")}, nested={"v": float("inf")})
+    row = json.loads(path.read_text().splitlines()[0])
+    assert row["loss"] is None
+    assert row["nested"] == {"v": None}
+
+
+def test_jsonl_logger_ndarray_extra_never_crashes(tmp_path):
+    """Container extras carrying ndarrays (a per-type vector riding a
+    metrics row) must serialize as scrubbed lists, and foreign leaf
+    types must stringify — the writer can never take the trainer down
+    (the review-caught regression of the container pass-through)."""
+    from actor_critic_tpu.utils.logging import JsonlLogger
+
+    path = tmp_path / "m.jsonl"
+    with JsonlLogger(path) as log:
+        log.log(
+            1, {"loss": 0.5},
+            per_type={"cartpole": np.array([1.0, np.nan], np.float32)},
+            weird={"s": {1, 2}},
+        )
+    row = json.loads(path.read_text().splitlines()[0])
+    assert row["per_type"]["cartpole"] == [1.0, None]
+    assert isinstance(row["weird"]["s"], str)
+
+
+def test_gateway_swap_of_poisoned_checkpoint_is_422(tmp_path):
+    """The ISSUE 14 swap gate surfacing through the HTTP surface: a
+    checkpoint carrying nan params must come back as a 422 refusal (a
+    client-actionable rejection; the resident policy keeps serving),
+    not the catch-all 500."""
+    from actor_critic_tpu.analysis.numsan import _guards_disabled
+    from actor_critic_tpu.serving.gateway import ServeGateway
+    from actor_critic_tpu.serving.policy_store import (
+        PolicyStore,
+        export_policy_params,
+    )
+
+    class Eng:
+        max_rows = 8
+
+        def prepare_params(self, params):
+            return {k: np.array(v) for k, v in params.items()}
+
+        def act(self, params, obs):
+            return np.asarray(obs)[:, 0]
+
+    good = {"w": np.ones((2,), np.float32)}
+    store = PolicyStore()
+    store.register("default", Eng(), good)
+    ckpt_dir = str(tmp_path / "poisoned")
+    with _guards_disabled():  # the only way a poisoned ckpt can exist
+        export_policy_params(
+            ckpt_dir, {"w": np.array([np.nan, 1.0], np.float32)}
+        )
+    gw = ServeGateway(store, port=0)
+    try:
+        status, body = gw.handle_swap(
+            {"policy": "default", "checkpoint": ckpt_dir}
+        )
+    finally:
+        gw.close()
+    assert status == 422
+    assert "refused" in body["error"]
+    assert store.get("default").version == 0  # still the good handle
+
+
+def test_session_event_keeps_nonfinite_forensics(tmp_path):
+    """A divergence event CARRYING a non-finite payload field must not
+    vanish — that row is exactly the forensic record of the failure."""
+    from actor_critic_tpu.telemetry.session import TelemetrySession
+
+    sess = TelemetrySession(
+        directory=str(tmp_path / "tele"), sample_resources=False,
+    )
+    try:
+        sess.event("divergence", metric="loss", value=float("nan"))
+    finally:
+        sess.close()
+    rows = [
+        json.loads(ln)
+        for ln in (tmp_path / "tele" / "events.jsonl")
+        .read_text().splitlines()
+        if ln
+    ]
+    div = [r for r in rows if r.get("kind") == "divergence"]
+    assert div and div[0]["value"] is None
+
+
+# ---------------------------------------------------------------------------
+# production gates (numsan drives the same ones under seeded schedules)
+# ---------------------------------------------------------------------------
+
+
+def test_policy_publisher_rejects_nonfinite_keeps_good_snapshot():
+    from actor_critic_tpu.algos.traj_queue import PolicyPublisher
+
+    good = {"w": np.ones((2,), np.float32)}
+    pub = PolicyPublisher(good, version=3)
+    with pytest.raises(NonFiniteError):
+        pub.publish({"w": np.array([1.0, np.nan], np.float32)}, 4)
+    version, params = pub.get()
+    assert version == 3
+    assert np.all(np.isfinite(params["w"]))
+
+
+def test_write_params_rejects_nonfinite_keeps_mailbox_file(tmp_path):
+    from actor_critic_tpu.parallel.multihost import (
+        read_params,
+        write_params,
+    )
+
+    good = {"w": np.ones((2, 2), np.float32)}
+    write_params(str(tmp_path), 0, 1, good)
+    with pytest.raises(NonFiniteError):
+        write_params(
+            str(tmp_path), 0, 2,
+            {"w": np.full((2, 2), np.inf, np.float32)},
+        )
+    out = read_params(str(tmp_path), 0, good)
+    assert out is not None and out[0] == 1
+    assert np.all(np.isfinite(out[1]["w"]))
+
+
+def test_checkpointer_refuses_nonfinite_commit(tmp_path):
+    from actor_critic_tpu.utils.checkpoint import Checkpointer
+
+    state = {"w": np.ones((2,), np.float32)}
+    with Checkpointer(str(tmp_path / "ck")) as ckpt:
+        ckpt.save(0, state, force=True)
+        ckpt.wait()
+        with pytest.raises(NonFiniteError):
+            ckpt.save(
+                1, {"w": np.array([np.nan, 1.0], np.float32)},
+                force=True,
+            )
+        assert ckpt.latest_step() == 0
+        restored = ckpt.restore(state, 0)
+        assert np.all(np.isfinite(np.asarray(restored["w"])))
+
+
+def test_guards_are_one_seam(monkeypatch):
+    """Every production gate routes through numguard.check_finite — the
+    seam numsan's reverted-guard mode relies on. Verify the no-op
+    monkeypatch really opens the publisher gate."""
+    from actor_critic_tpu.algos.traj_queue import PolicyPublisher
+
+    monkeypatch.setattr(numguard, "check_finite", lambda *a, **k: None)
+    pub = PolicyPublisher({"w": np.ones(1, np.float32)}, version=0)
+    pub.publish({"w": np.array([np.nan], np.float32)}, 1)  # no raise
+    assert numguard.nonfinite_leaves(pub.get()[1])

@@ -1,0 +1,175 @@
+"""Tier-1 wiring for numsan (ISSUE 14 runtime half).
+
+Mirrors test_racesan/test_fleetsan's layers: (1) the quick profile
+sweeps clean, (2) a seed replays bit-identically, (3) every reverted-
+guard mode is caught deterministically on every schedule, (4) the
+tolerated poisons (denormal, large-but-finite) never fire a guard,
+(5) the CLI's exit codes stay distinct.
+"""
+
+import importlib.util
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from actor_critic_tpu.analysis import numsan
+
+REPO = Path(__file__).parent.parent
+
+
+def _load_cli():
+    spec = importlib.util.spec_from_file_location(
+        "numsan_cli", REPO / "scripts" / "numsan.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# clean sweeps
+# ---------------------------------------------------------------------------
+
+
+def test_quick_profile_sweeps_clean():
+    out = numsan.quick_profile(schedules=8, seed0=0)
+    assert out["violations"] == 0
+    assert out["schedules"] == 8
+    # at least one guard of each publish/checkpoint shape fired across
+    # the sweep (nonfinite poisons dominate the menu)
+    assert out["publish"]["rejections"] + out["checkpoint"]["refusals"] > 0
+
+
+def test_update_poisons_fire_divergence_monitor():
+    # seeds are cheap once the tiny program is compiled; sweep enough
+    # rounds that the nonfinite poisons certainly appear
+    out = numsan.exercise_sweep(
+        range(0, 6), lambda s: numsan.exercise_update(s, rounds=2)
+    )
+    assert out["violations"] == 0
+    assert out["divergence_events"] > 0
+
+
+def test_codec_saturations_observed():
+    out = numsan.exercise_sweep(
+        range(0, 8), lambda s: numsan.exercise_codec(s)
+    )
+    assert out["violations"] == 0
+    assert out["saturations"] > 0
+
+
+# ---------------------------------------------------------------------------
+# bit-identical replay per seed
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "fn",
+    [
+        numsan.exercise_update,
+        numsan.exercise_publish,
+        numsan.exercise_checkpoint,
+        numsan.exercise_codec,
+    ],
+)
+def test_replay_is_bit_identical_per_seed(fn):
+    a, b = fn(11), fn(11)
+    assert a["trace"] == b["trace"]
+    different = fn(12)
+    # a different seed must be allowed to differ (no vacuous equality)
+    assert (different["trace"] != a["trace"]) or (
+        different.get("poison") != a.get("poison")
+    )
+
+
+# ---------------------------------------------------------------------------
+# reverted-guard modes: caught deterministically on EVERY schedule
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_reverted_publish_guard_detected(seed):
+    with pytest.raises(numsan.NumSanError, match="REVERTED GUARD"):
+        numsan.exercise_publish(seed, revert=True)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_reverted_checkpoint_guard_detected(seed):
+    with pytest.raises(numsan.NumSanError, match="REVERTED GUARD"):
+        numsan.exercise_checkpoint(seed, revert=True)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_reverted_codec_wrap_detected(seed):
+    with pytest.raises(numsan.NumSanError, match="REVERTED CODEC"):
+        numsan.exercise_codec(seed, revert=True)
+
+
+def test_revert_mode_restores_the_guard():
+    """The guards-disabled context must restore check_finite even when
+    the exerciser raises — a leaked no-op would silently disarm every
+    production gate for the rest of the process."""
+    from actor_critic_tpu.utils import numguard
+
+    orig = numguard.check_finite
+    with pytest.raises(numsan.NumSanError):
+        numsan.exercise_publish(0, revert=True)
+    assert numguard.check_finite is orig
+    with pytest.raises(numguard.NonFiniteError):
+        numguard.check_finite(
+            {"w": np.array([np.nan], np.float32)}, "post-revert"
+        )
+
+
+# ---------------------------------------------------------------------------
+# tolerance direction: denormals never fire a guard
+# ---------------------------------------------------------------------------
+
+
+def test_denormal_poisons_are_tolerated():
+    # seeds chosen so the menu draw lands on "denormal"
+    import random
+
+    hits = 0
+    for seed in range(40):
+        if random.Random(seed).randrange(4) == 3:  # the denormal slot
+            out = numsan.exercise_publish(seed)
+            assert out["poison"] == "denormal"
+            assert out["rejections"] == 0 and out["violations"] == 0
+            hits += 1
+            if hits >= 2:
+                break
+    assert hits >= 1, "no denormal seed in range — widen the sweep"
+
+
+# ---------------------------------------------------------------------------
+# CLI exit codes
+# ---------------------------------------------------------------------------
+
+
+def test_cli_exit_codes(capsys):
+    cli = _load_cli()
+    assert cli.main(["--scenario", "codec", "--schedules", "4"]) == 0
+    assert cli.main(
+        ["--scenario", "codec", "--revert", "--schedules", "2"]
+    ) == 1
+    assert cli.main(
+        ["--scenario", "publish", "--revert", "--schedules", "2"]
+    ) == 1
+    # --revert without a gated scenario is a usage crash, not a clean run
+    assert cli.main(["--revert"]) == 2
+    capsys.readouterr()
+
+
+def test_cli_json_mode(capsys):
+    import json
+
+    cli = _load_cli()
+    rc = cli.main(
+        ["--scenario", "publish", "--schedules", "3", "--json"]
+    )
+    assert rc == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["schedules"] == 3
+    assert payload["violations"] == 0
