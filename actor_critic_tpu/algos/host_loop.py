@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import time
 import warnings
+from functools import partial
 from typing import Callable, Optional
 
 import numpy as np
@@ -673,6 +674,11 @@ def off_policy_train_host(
                     buffers=buffers,
                 )
                 with telemetry.span("host_to_device"):
+                    # jaxlint: disable=transfer-discipline (deliberate:
+                    # the host plane's per-block upload — the lockstep
+                    # loop transfers each collected block once by
+                    # design; --data-plane device removes it, and
+                    # perfsan budgets the bytes)
                     traj = OffPolicyTransition(
                         obs=jnp.asarray(block["obs"]),
                         action=jnp.asarray(block["action"]),
@@ -688,11 +694,16 @@ def off_policy_train_host(
                     # finished during this collection), so the fetch doesn't
                     # wait, and the update dispatched below computes on-device
                     # while the next rollout is collected.
+                    # jaxlint: disable=transfer-discipline (deliberate:
+                    # the mirror's acting-params refresh — concrete by
+                    # the overlap argument above, so no wait)
                     host_params = jax.device_get(learner.actor_params)
                 # The jitted call returns at ENQUEUE time (async dispatch);
                 # the span measures host-side cost only — blocking here to
                 # measure device wall would cost the host/device overlap.
                 with telemetry.span("update", dispatch="async"):
+                    # jaxlint: disable=transfer-discipline (scalar
+                    # env_steps counter rides the dispatch — 4 bytes)
                     learner, metrics = ingest_update(
                         learner, traj, jnp.asarray(env_steps, jnp.int32)
                     )
@@ -703,9 +714,16 @@ def off_policy_train_host(
                     # it here would crash collection after the first eval.
                     if host_greedy is not None:
                         # Blocks on the in-flight update: eval sees CURRENT params.
+                        # jaxlint: disable=transfer-discipline (eval
+                        # cadence, not the hot collect loop)
                         ev_params = jax.device_get(learner.actor_params)
+                        # jaxlint: disable=transfer-discipline (mirror
+                        # eval — np.asarray touches no device value)
                         eval_act = lambda o: np.asarray(host_greedy(ev_params, o))  # noqa: E731
                     else:
+                        # jaxlint: disable=transfer-discipline (eval
+                        # cadence: greedy eval must hand gym concrete
+                        # host actions, once per eval step)
                         eval_act = lambda o: np.asarray(  # noqa: E731
                             greedy(learner.actor_params, jnp.asarray(o))
                         )
@@ -910,6 +928,9 @@ def off_policy_train_host_async(
                 # update's INPUT params, fetched BEFORE the donating
                 # dispatch below (concrete — the previous update
                 # finished during collection).
+                # jaxlint: disable=transfer-discipline (deliberate: the
+                # per-block behavior-params publish IS the async
+                # contract — concrete by the overlap argument above)
                 publisher.publish(
                     jax.device_get(learner.actor_params), version=it
                 )
@@ -922,6 +943,8 @@ def off_policy_train_host_async(
                     # one program — only the slot index crosses.
                     telemetry.instant("host_to_device", device_plane=True)
                     slot = np.int32(block.slot)
+                    # jaxlint: disable=transfer-discipline (scalar
+                    # env_steps counter — 4 bytes ride the dispatch)
                     steps = jnp.asarray(env_steps, jnp.int32)
                     with telemetry.span("update", dispatch="async"):
                         learner, metrics = queue.run(
@@ -939,6 +962,10 @@ def off_policy_train_host_async(
                         # jnp.array, NOT asarray: the transfer must
                         # snapshot the slot before release (the PR 6
                         # contract).
+                        # jaxlint: disable=transfer-discipline (the
+                        # host plane's per-block upload by design; the
+                        # device branch above removes it — perfsan
+                        # budgets both planes)
                         traj = OffPolicyTransition(
                             obs=jnp.array(block.arrays["obs"]),
                             action=jnp.array(block.arrays["action"]),
@@ -949,6 +976,8 @@ def off_policy_train_host_async(
                         )
                     queue.release(block)
                     with telemetry.span("update", dispatch="async"):
+                        # jaxlint: disable=transfer-discipline (scalar
+                        # env_steps counter — 4 bytes)
                         learner, metrics = ingest_update(
                             learner, traj, jnp.asarray(env_steps, jnp.int32)
                         )
@@ -966,6 +995,8 @@ def off_policy_train_host_async(
                 if eval_pool is not None and (it + 1) % eval_every == 0:
                     # Blocks on the in-flight update: eval sees CURRENT
                     # params, like the lockstep drivers.
+                    # jaxlint: disable=transfer-discipline (eval
+                    # cadence, not the per-block consume path)
                     ev_params = jax.device_get(learner.actor_params)
                     with telemetry.span("eval"):
                         extra["eval_return"] = host_evaluate(
@@ -1039,7 +1070,12 @@ def fused_train_loop(
             log_fn(1, {k: float(v) for k, v in metrics.items()})
         if num_iterations > 1:
 
-            @jax.jit
+            # donate_argnums matches jit_step above: `state` here is
+            # jit_step's freshly produced output (rebound at its call),
+            # so the scanned tail can reuse the buffers in place instead
+            # of copy-preserving the full train state for one call
+            # (found by donation-discipline, ISSUE 15).
+            @partial(jax.jit, donate_argnums=0)
             def run(state):
                 def body(s, _):
                     s, _m = step(s)

@@ -575,6 +575,9 @@ def train_host(
                 def policy_act(o):
                     nonlocal key
                     key, akey = jax.random.split(key)
+                    # jaxlint: disable=transfer-discipline (deliberate:
+                    # the non-mirror acting path uploads obs per step —
+                    # same round trip the pragma below documents)
                     action, logp, value = policy_step(params, jnp.asarray(o), akey)
                     # jaxlint: disable=host-sync (deliberate: without a
                     # numpy mirror, acting round-trips the device and the
@@ -590,6 +593,9 @@ def train_host(
             )
             key, ukey = jax.random.split(key)
             with telemetry.span("host_to_device"):
+                # jaxlint: disable=transfer-discipline (deliberate: the
+                # lockstep per-block upload — one transfer per collected
+                # block by design; perfsan budgets the bytes)
                 arrays = {k: jnp.asarray(v) for k, v in block.items()}
             extra_values = {}
             if host_policy is not None:
@@ -602,6 +608,9 @@ def train_host(
                     host_params,
                     block["final_obs"].reshape(T_ * E_, *block["final_obs"].shape[2:]),
                 ).reshape(T_, E_)
+                # jaxlint: disable=transfer-discipline (part of the
+                # same per-block upload: mirror-computed baselines ride
+                # with the block)
                 extra_values = dict(
                     final_values=jnp.asarray(fv),
                     bootstrap_value=jnp.asarray(host_value(host_params, obs)),
@@ -610,14 +619,26 @@ def train_host(
                 # before the dispatch (concrete — the previous update finished
                 # during collection — so no wait); the update dispatched below
                 # then overlaps the next rollout.
+                # jaxlint: disable=transfer-discipline (deliberate: the
+                # mirror's acting-params refresh — concrete, no wait)
                 host_params = jax.device_get(params)
             if cfg.anneal_iters > 0:
+                # jaxlint: disable=transfer-discipline (scalar anneal
+                # progress — 4 bytes ride the dispatch)
                 extra_values["progress"] = jnp.asarray(
                     min(it / cfg.anneal_iters, 1.0), jnp.float32
                 )
             # Async dispatch: the span measures host-side enqueue only
             # (fencing here would cost the rollout/update overlap).
             with telemetry.span("update", dispatch="async"):
+                # jaxlint: disable=donation-discipline,transfer-discipline
+                # (donation withheld: the overlap path's mirror and the
+                # resume template still read the input params tree
+                # around the dispatch, and flipping donation re-lowers
+                # every warmed update program — the ROADMAP kernel-level
+                # item owns that change, gated by perfsan's budgets; the
+                # jnp.asarray is the bootstrap obs riding the block
+                # upload)
                 params, opt_state, metrics = update(
                     params, opt_state,
                     arrays["obs"], arrays["action"], arrays["log_prob"],
@@ -630,9 +651,16 @@ def train_host(
                 if host_greedy is not None:
                     # device_get blocks until the in-flight update lands, so
                     # eval always sees the CURRENT params.
+                    # jaxlint: disable=transfer-discipline (eval
+                    # cadence, not the hot collect loop)
                     ev_params = jax.device_get(params)
+                    # jaxlint: disable=transfer-discipline (mirror
+                    # eval — np.asarray touches no device value)
                     eval_act = lambda o: np.asarray(host_greedy(ev_params, o))  # noqa: E731
                 else:
+                    # jaxlint: disable=transfer-discipline (eval
+                    # cadence: greedy eval must hand gym concrete host
+                    # actions, once per eval step)
                     eval_act = lambda o: np.asarray(  # noqa: E731
                         greedy(params, jnp.asarray(o))
                     )
@@ -1006,10 +1034,15 @@ def train_host_async(
                 # update's INPUT params (concrete — the previous
                 # dispatched update finished while blocks were being
                 # collected), fetched BEFORE the dispatch below.
+                # jaxlint: disable=transfer-discipline (deliberate: the
+                # per-block behavior-params publish IS the async
+                # contract — concrete by the overlap argument above)
                 publisher.publish(jax.device_get(params), version=it)
                 staleness = max(it - block.version, 0)
                 kwargs = {}
                 if cfg.anneal_iters > 0:
+                    # jaxlint: disable=transfer-discipline (scalar
+                    # anneal progress — 4 bytes ride the dispatch)
                     kwargs["progress"] = jnp.asarray(
                         min(it / cfg.anneal_iters, 1.0), jnp.float32
                     )
@@ -1046,6 +1079,10 @@ def train_host_async(
                         # that memory while the dispatched update still
                         # reads it — the transfer must snapshot the
                         # block.
+                        # jaxlint: disable=transfer-discipline (the
+                        # host plane's per-block upload by design; the
+                        # device branch above removes it — perfsan
+                        # budgets both planes)
                         arrays = {
                             k: jnp.array(v) for k, v in block.arrays.items()
                         }
@@ -1056,6 +1093,13 @@ def train_host_async(
                     with telemetry.span("update", dispatch="async"):
                         for _ in range(updates_per_block):
                             key, ukey = jax.random.split(key)
+                            # jaxlint: disable=donation-discipline
+                            # (withheld: the publisher snapshots and the
+                            # IMPACT-style surrogate reuse read the
+                            # input tree around the dispatch; flipping
+                            # donation re-lowers every warmed program —
+                            # the ROADMAP kernel-level item owns it,
+                            # gated by perfsan)
                             params, opt_state, metrics = update(
                                 params, opt_state,
                                 arrays["obs"], arrays["action"],
@@ -1080,6 +1124,8 @@ def train_host_async(
                 if eval_pool is not None and (it + 1) % eval_every == 0:
                     # Blocks on the in-flight update: eval sees CURRENT
                     # params, exactly like the lockstep drivers.
+                    # jaxlint: disable=transfer-discipline (eval
+                    # cadence, not the per-block consume path)
                     ev_params = jax.device_get(params)
                     with telemetry.span("eval"):
                         extra["eval_return"] = host_evaluate(

@@ -1,12 +1,13 @@
 """jaxlint: repo-wide JAX correctness analyzer (ISSUE 5, extended with
 concurrency passes + racesan in ISSUE 7, distributed passes + fleetsan
-in ISSUE 12, and numerics passes + numsan in ISSUE 14).
+in ISSUE 12, numerics passes + numsan in ISSUE 14, and performance
+passes + perfsan in ISSUE 15).
 
 AST-based static analysis over this repo's JAX code — pure stdlib
 `ast`, no new dependencies, and (except the `warmup-registry` pass,
 which validates against the live registry, and the numerics passes'
 optional `jax.eval_shape` grounding) no imports of the code it scans.
-Fifteen registered passes, each grounded in a failure this codebase
+Eighteen registered passes, each grounded in a failure this codebase
 actually hit or observes at runtime:
 
     donation-aliasing     donated jit args fed restore-aliased/still-
@@ -15,7 +16,6 @@ actually hit or observes at runtime:
     prng-reuse            one PRNG key consumed twice without split
     recompile-hazard      jit built in loops; shape-/len()-derived
                           scalars at jitted call sites
-    host-sync             device syncs inside hot collection loops
     warmup-registry       jax.jit entry points without AOT warmup
                           planners (ISSUE 4's lint, folded in)
     lock-discipline       compound writes to cross-thread shared state
@@ -40,12 +40,24 @@ actually hit or observes at runtime:
     sink-guard            json.dumps(allow_nan=False) writers and
                           commit points (checkpoint/mailbox/publish/
                           swap) without a finiteness gate
+    transfer-discipline   host<->device crossings inside steady-state
+                          loop bodies (ABSORBS ISSUE 5's host-sync —
+                          the old name stays resolvable as an alias;
+                          perf_model.py)
+    donation-discipline   recycled ring/replay/params buffers donate-
+                          eligible but undonated; donated-then-read
+                          alias near-misses
+    dispatch-granularity  Python reductions over device values, eager
+                          device math, and multi-program chains inside
+                          per-step loops — one fused program's work
 
 Runtime companions, each gating tier-1 under its own timeout:
 `analysis/racesan.py` (seeded cooperative-schedule race exerciser),
-`analysis/fleetsan.py` (seeded multi-process chaos), and
+`analysis/fleetsan.py` (seeded multi-process chaos),
 `analysis/numsan.py` (seeded NaN/Inf/saturation fault injection over
-the real update/codec/publish/checkpoint objects).
+the real update/codec/publish/checkpoint objects), and
+`analysis/perfsan.py` (dispatch/transfer/recompile budget metering of
+the real steady-state programs against `perf_budgets.json`).
 
 CLI: `python scripts/jaxlint.py` (tier-1-gated via
 tests/test_jaxlint.py and scripts/tier1.sh). Per-line suppression:

@@ -240,7 +240,16 @@ class ModuleInfo:
 
     def suppressed(self, lineno: int, check: str) -> bool:
         names = self._suppressions.get(lineno, ())
-        return check in names or "all" in names
+        if check in names or "all" in names:
+            return True
+        # Deprecation aliases (ISSUE 15): a `disable=host-sync`
+        # annotation written before the pass was absorbed into
+        # transfer-discipline keeps suppressing at its site.
+        return any(
+            alias in names
+            for alias, target in CHECK_ALIASES.items()
+            if target == check
+        )
 
     # -- imports -----------------------------------------------------------
 
@@ -342,6 +351,16 @@ class Check:
 
 _CHECKS: dict[str, Check] = {}
 
+# Renamed/absorbed checks stay resolvable (ISSUE 15): `--select
+# host-sync` runs transfer-discipline, and a `disable=host-sync`
+# annotation suppresses it — annotations and CI invocations written
+# against the old name cannot silently stop working.
+CHECK_ALIASES: dict[str, str] = {"host-sync": "transfer-discipline"}
+
+
+def resolve_check_name(name: str) -> str:
+    return CHECK_ALIASES.get(name, name)
+
 
 def register_check(name: str, doc: str, scope: str = "module"):
     """Decorator registering `fn(module_info) -> list[Finding]` (module
@@ -366,8 +385,8 @@ def _ensure_builtin_checks() -> None:
         concurrency,
         distributed,
         donation,
-        host_sync,
         numerics,
+        perf,
         prng,
         recompile,
         tracer_leak,
@@ -429,7 +448,15 @@ def run_checks(
     names from whatever was selected. Unknown names raise (a typo'd
     check filter must not read as a clean run)."""
     _ensure_builtin_checks()
-    selected = list(checks) if checks is not None else sorted(_CHECKS)
+    # dict.fromkeys: alias resolution can map two requested names onto
+    # one check (`--select host-sync,transfer-discipline`) — it must
+    # run once, not twice.
+    selected = (
+        list(dict.fromkeys(resolve_check_name(c) for c in checks))
+        if checks is not None
+        else sorted(_CHECKS)
+    )
+    skip = [resolve_check_name(c) for c in skip]
     unknown = [c for c in [*selected, *skip] if c not in _CHECKS]
     if unknown:
         raise AnalysisError(

@@ -1,99 +1,31 @@
-"""host-sync: device synchronization inside hot collection/step loops.
+"""host-sync: ABSORBED into transfer-discipline (ISSUE 15).
 
-The host loops stay fast by keeping dispatch ASYNC: the jitted update
-returns at enqueue time and the device computes while the host collects
-the next block. Any `.item()`, `np.asarray(device_value)`,
-`jax.block_until_ready(...)`, or `float()/int()` coercion inside the
-loop body blocks the host on the device EVERY iteration and silently
-serializes the pipeline — the regression is invisible until someone
-profiles. Deliberate sync points (the log-cadence `float()` coercions,
-the non-mirror acting path's action materialization) are real and
-documented — suppress them in place with the reason, which is exactly
-what a reviewer needs to see next to the call.
+ISSUE 5's host-sync pass flagged device syncs inside hot collection
+loops; `analysis/perf.py`'s transfer-discipline pass now owns that
+class — the same device→host sync taxonomy plus `jax.device_get` and
+the host→device upload family, over hot modules AND detected step
+loops repo-wide. This module is the deprecation shim that keeps the
+old spellings working:
 
-Scope: files whose basename is in `HOT_BASENAMES` (the step-loop owners
-the ISSUE names) plus any file carrying a `# jaxlint: hot-module`
-pragma line (how fixtures — and future hot modules — opt in). Only
-calls with a `for`/`while`/comprehension ancestor flag; straight-line
-setup code syncs once, not per step.
+- `--select host-sync` resolves to transfer-discipline
+  (`core.CHECK_ALIASES`), so CI invocations written against the old
+  name keep running the successor pass;
+- `# jaxlint: disable=host-sync` annotations keep suppressing
+  transfer-discipline findings at their sites (`ModuleInfo.suppressed`
+  consults the same alias table);
+- `HOT_BASENAMES` (the step-loop owner set) now lives in
+  `analysis/perf_model.py`; the re-export below exists only for
+  out-of-tree consumers that imported it from this module — nothing
+  in-repo does any more.
+
+Baseline entries were migrated in place (`check` rewritten to
+transfer-discipline; fingerprints re-anchor automatically because the
+check name is part of them) — run `scripts/jaxlint.py --prune-stale`
+after removing any remaining host-sync entries of your own.
 """
 
 from __future__ import annotations
 
-import ast
+from actor_critic_tpu.analysis.perf_model import HOT_BASENAMES  # noqa: F401
 
-from actor_critic_tpu.analysis.core import Finding, ModuleInfo, register_check
-
-CHECK = "host-sync"
-
-# The step-loop owners (ISSUE 5). Other modules opt in via the
-# `# jaxlint: hot-module` pragma.
-HOT_BASENAMES = {"host_loop.py", "ppo.py", "compile_cache.py"}
-
-_LOOPS = (ast.For, ast.AsyncFor, ast.While)
-_SYNC_FREE_CALLS = {"len", "round", "abs"}  # cheap host-side builtins
-
-
-def _in_loop(mod: ModuleInfo, node: ast.AST) -> bool:
-    # Real iteration only: a lone comprehension (e.g. the log-cadence
-    # `{k: float(v) ...}` coercion) runs once per CALL, not per step —
-    # it is hot only when the call site itself sits in a step loop.
-    return any(isinstance(a, _LOOPS) for a in mod.ancestors(node))
-
-
-def _sync_kind(mod: ModuleInfo, call: ast.Call) -> str | None:
-    """A description of the blocking call, or None."""
-    dotted = mod.dotted(call.func)
-    if isinstance(call.func, ast.Attribute):
-        if call.func.attr == "item" and not call.args:
-            return "`.item()`"
-        if call.func.attr == "block_until_ready":
-            return "`block_until_ready`"
-    if dotted == "jax.block_until_ready":
-        return "`jax.block_until_ready`"
-    if dotted in ("numpy.asarray", "numpy.array"):
-        return f"`{dotted.replace('numpy', 'np')}`"
-    if dotted in ("float", "int") and call.args:
-        arg = call.args[0]
-        if isinstance(arg, ast.Constant):
-            return None
-        if isinstance(arg, ast.Call):
-            inner = mod.dotted(arg.func) or ""
-            if (
-                inner.startswith("numpy.")
-                or inner.startswith("math.")
-                or inner in _SYNC_FREE_CALLS
-            ):
-                return None  # numpy/host math — no device involved
-        return f"`{dotted}()`"
-    return None
-
-
-@register_check(
-    CHECK,
-    "device sync (.item()/np.asarray/block_until_ready/float()) inside "
-    "a hot collection/step loop",
-)
-def check_host_sync(mod: ModuleInfo) -> list[Finding]:
-    basename = mod.relpath.rsplit("/", 1)[-1]
-    if basename not in HOT_BASENAMES and not mod.hot_module:
-        return []
-    findings: list[Finding] = []
-    for node in ast.walk(mod.tree):
-        if not isinstance(node, ast.Call) or not _in_loop(mod, node):
-            continue
-        kind = _sync_kind(mod, node)
-        if kind is None:
-            continue
-        findings.append(
-            Finding(
-                CHECK, mod.relpath, node.lineno, node.col_offset,
-                f"{kind} inside a hot loop blocks the host on the device "
-                "every iteration, serializing the async dispatch "
-                "pipeline — hoist it to the log cadence, keep the value "
-                "on device, or suppress with the reason if the sync is "
-                "deliberate",
-                mod.enclosing_function(node),
-            )
-        )
-    return findings
+CHECK = "host-sync"  # historical name; resolves via core.CHECK_ALIASES

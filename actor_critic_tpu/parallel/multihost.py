@@ -853,6 +853,12 @@ def train_multihost(
                     with telemetry.span("update", dispatch="async"):
                         for _ in range(updates_per_block):
                             key, ukey = jax.random.split(key)
+                            # jaxlint: disable=donation-discipline
+                            # (withheld: the replicated global-mesh
+                            # trees feed the consistency check and the
+                            # mailbox publish after the dispatch;
+                            # donation is the ROADMAP kernel-level
+                            # item's change, gated by perfsan)
                             params, opt_state, metrics = update(
                                 params, opt_state,
                                 # jaxlint: disable=host-sync (deliberate:
@@ -906,6 +912,9 @@ def train_multihost(
                         # jnp.array, NOT asarray: one copying transfer
                         # snapshots the slot (the PR 6 contract) —
                         # releasing only after it materializes.
+                        # jaxlint: disable=transfer-discipline (the
+                        # host plane's per-block upload by design —
+                        # perfsan budgets the bytes)
                         arrays = {
                             k: jax.numpy.array(v)
                             for k, v in block.arrays.items()
@@ -913,10 +922,17 @@ def train_multihost(
                         queue.release(block)
                     kwargs = {}
                     if cfg.anneal_iters > 0:
+                        # jaxlint: disable=transfer-discipline (scalar
+                        # anneal progress — 4 bytes)
                         kwargs["progress"] = jax.numpy.asarray(progress)
                     with telemetry.span("update", dispatch="async"):
                         for _ in range(updates_per_block):
                             key, ukey = jax.random.split(key)
+                            # jaxlint: disable=donation-discipline
+                            # (withheld: gossip mixes and the mailbox
+                            # publish read the input tree around the
+                            # dispatch — the ROADMAP kernel-level item
+                            # owns the donation change, perfsan-gated)
                             params, opt_state, metrics = local_update(
                                 params, opt_state,
                                 arrays["obs"], arrays["action"],
@@ -925,6 +941,9 @@ def train_multihost(
                                 arrays["terminated"], arrays["final_obs"],
                                 arrays["last_obs"], ukey, **kwargs,
                             )
+                    # jaxlint: disable=transfer-discipline (deliberate:
+                    # the gossip publish snapshot — one host fetch per
+                    # block is the mailbox contract)
                     np_params = jax.device_get(params)
                     version = it + 1
                     stop_after = (
@@ -941,6 +960,10 @@ def train_multihost(
                             np_params = mix_params(
                                 np_params, peer_params, gossip.weight
                             )
+                            # jaxlint: disable=transfer-discipline
+                            # (deliberate: re-placing the gossip-mixed
+                            # params — once per gossip round, not per
+                            # step)
                             params = jax.device_put(np_params)
                             summary["gossip_mixes"] += 1
                             summary["gossip_lag_max"] = max(

@@ -235,19 +235,31 @@ class PolicyEngine:
 
     def act(self, params, obs: np.ndarray) -> np.ndarray:
         """Dispatch one micro-batch: pad [n, *obs_shape] to its bucket,
-        run the jitted program, return the first n actions as numpy."""
+        run the jitted program, return the first n actions as numpy.
+
+        Both crossings are EXPLICIT (`jax.device_put` in,
+        `jax.device_get` out — ISSUE 15 transfer discipline): the act
+        path's transfer bytes are a serving-budget line item perfsan
+        counts, and the dispatch runs clean under
+        `jax.transfer_guard("disallow")` — an implicit coercion
+        sneaking into this path fails the sanitizer instead of silently
+        re-paying the tunnel."""
+        import jax
+
         obs = np.asarray(obs, dtype=np.dtype(self.spec.obs_dtype))
         n = obs.shape[0]
         if self.backend == "mirror":
             out = self._mirror(params, obs)
         else:
             padded, _ = compile_cache.pad_to_bucket(obs, self.buckets)
+            staged = jax.device_put(padded)
             if self.sample:
                 out = self._program(
-                    params, padded, self._key_for_flush()
+                    params, staged, self._key_for_flush()
                 )
             else:
-                out = self._program(params, padded)
+                out = self._program(params, staged)
+            out = jax.device_get(out)
         if self.dispatch_pad_s > 0.0:
             import time
 

@@ -534,12 +534,46 @@ def bench_update_wall():
         out = dev_call()
         jax.block_until_ready(out)
     device_s = (time.perf_counter() - t0) / reps
+
+    # Budget-counter actuals (ISSUE 15): the SAME dispatch/transfer
+    # meters perfsan gates tier-1 with, read on one fenced dispatch of
+    # each program — so the wall rows above travel with the structural
+    # counts that explain them (plain: 1 program, 0 transfers — the
+    # args are device-resident; device-gather: 1 program, the staged
+    # slot scalar's 4 bytes).
+    from actor_critic_tpu.analysis import perfsan as _perfsan
+
+    with _perfsan.measure() as c_plain:
+        out = plain_update(
+            params, opt_state, obs, args["action"], args["log_prob"],
+            args["value"], args["reward"], args["done"],
+            args["terminated"], obs, last_obs, key,
+        )
+        jax.block_until_ready(out)
+    # Warm the staged-slot signature first: the meter reads the C++
+    # fastpath's post_hook, which only fires on cache-hit dispatches —
+    # a cold signature would read as zero dispatches.
+    slot_dev = jax.device_put(np.int32(lease.slot))
+    out = ring.run(
+        lambda state: dev_update(params, opt_state, state, slot_dev, key)
+    )
+    jax.block_until_ready(out)
+    with _perfsan.measure() as c_dev:
+        slot_dev = jax.device_put(np.int32(lease.slot))
+        out = ring.run(
+            lambda state: dev_update(params, opt_state, state, slot_dev, key)
+        )
+        jax.block_until_ready(out)
     ring.release(lease)
     ring.close()
 
     return {
         "metric": "steady_state_update_wall",
         "value": round(plain_s * 1e3, 2),
+        "dispatches_per_block": c_plain.dispatches,
+        "transferred_bytes_per_block": c_plain.transferred_bytes,
+        "device_dispatches_per_block": c_dev.dispatches,
+        "device_transferred_bytes_per_block": c_dev.transferred_bytes,
         "unit": "ms per host-PPO update ([64, 8] block, 4 epochs x 4 "
                 "minibatches, fenced)",
         "updates_per_s": round(1.0 / plain_s, 1),
@@ -640,6 +674,28 @@ def bench_data_plane():
         "device_enqueue_per_block": acct.bytes_per_block(),
         "codec_mix": acct.codec_mix(),
     }
+    # Measured actuals from perfsan's counters (ISSUE 15): the host
+    # plane's per-block upload and the device plane's encoded enqueue,
+    # METERED rather than computed — the same dispatch/transfer seams
+    # tier-1's budget sanitizer gates, so the accounting row above and
+    # the runtime meter can never drift apart silently.
+    from actor_critic_tpu.analysis import perfsan as _perfsan
+    from actor_critic_tpu.data_plane import ring as _ring_mod
+
+    probe = {
+        name: np.zeros(
+            leaf.shape, _ring_mod.canonical_dtype(leaf.dtype)
+        )
+        for name, leaf in block_spec.items()
+    }
+    with _perfsan.measure() as c_host:
+        staged = {k: jnp.array(v) for k, v in probe.items()}
+        jax.block_until_ready(staged)
+    with _perfsan.measure() as c_enq:
+        acct.put(probe, version=0)
+    bytes_row["host_measured"] = c_host.transferred_bytes
+    bytes_row["host_upload_dispatches"] = c_host.dispatches
+    bytes_row["enqueue_measured"] = c_enq.transferred_bytes
     acct.close()
 
     # Depth-1 bitwise equivalence rides in the record: the device plane

@@ -272,6 +272,38 @@ def update_wall_guarded_cell(rec: dict | None) -> str:
     return _numeric_cell(entry.get("guarded_ms"))
 
 
+def update_wall_field_cell(rec: dict | None, field: str) -> str:
+    """A budget-counter actual of the update-wall record (ISSUE 15:
+    `dispatches_per_block` / `device_transferred_bytes_per_block`, the
+    same meters perfsan gates tier-1 with; `-` before the field
+    existed, `?` malformed)."""
+    entry, cell = _metric_entry(rec, "update_wall")
+    if entry is None:
+        return cell
+    if field not in entry:
+        return "-"
+    return _numeric_cell(entry.get(field))
+
+
+def data_plane_measured_cell(rec: dict | None, field: str) -> str:
+    """A METERED transfer actual from the data-plane record's
+    `per_block_transfer_bytes` row (ISSUE 15: `host_measured` /
+    `enqueue_measured`, counted at perfsan's device_put/jnp.array
+    seams rather than computed; `-` before the field existed, `?`
+    malformed)."""
+    entry, cell = _metric_entry(rec, "consumed_env_steps_per_s")
+    if entry is None:
+        return cell
+    bytes_row = entry.get("per_block_transfer_bytes")
+    if bytes_row is None:
+        return "-"
+    if not isinstance(bytes_row, dict):
+        return "?"
+    if field not in bytes_row:
+        return "-"
+    return _numeric_cell(bytes_row.get(field))
+
+
 def data_plane_cell(rec: dict | None, plane: str) -> str:
     """One plane's consumed env-steps/s from the ISSUE 13 data-plane
     A/B record (`-` before the metric existed, `?` malformed)."""
@@ -373,6 +405,20 @@ def trend_rows(root: str) -> tuple[list[int], list[tuple[str, list[str]]]]:
                 "update_wall.guarded_ms",
                 [update_wall_guarded_cell(r) for r in recs],
             ))
+            # Budget-counter sub-rows (ISSUE 15): dispatches and the
+            # device-gather transfer bytes per steady-state block —
+            # the same counters perfsan gates, trended so a program
+            # quietly splitting into two dispatches (or the slot
+            # scalar growing into a block re-upload) is visible next
+            # to the wall it would tax.
+            for field in (
+                "dispatches_per_block",
+                "device_transferred_bytes_per_block",
+            ):
+                rows.append((
+                    f"update_wall.{field}",
+                    [update_wall_field_cell(r, field) for r in recs],
+                ))
         if name == "scenario_fleet":
             # Scenario-universe sub-rows (ISSUE 11): the heterogeneous
             # mixture fleet's steps/s, each member type's homogeneous
@@ -417,6 +463,15 @@ def trend_rows(root: str) -> tuple[list[int], list[tuple[str, list[str]]]]:
                 "consumed_env_steps_per_s.enqueue_bytes",
                 [data_plane_bytes_cell(r) for r in recs],
             ))
+            # Metered actuals (ISSUE 15): the host plane's per-block
+            # upload and the device enqueue as perfsan's counters saw
+            # them — drift between these and the computed rows above
+            # means the accounting lied.
+            for field in ("host_measured", "enqueue_measured"):
+                rows.append((
+                    f"consumed_env_steps_per_s.{field}",
+                    [data_plane_measured_cell(r, field) for r in recs],
+                ))
     return rounds, rows
 
 

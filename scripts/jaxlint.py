@@ -5,6 +5,7 @@
     python scripts/jaxlint.py actor_critic_tpu train.py bench
     python scripts/jaxlint.py --list-checks
     python scripts/jaxlint.py --select lock-discipline,check-then-act
+    python scripts/jaxlint.py --diff HEAD             # changed files only
     python scripts/jaxlint.py --json                  # machine output
     python scripts/jaxlint.py --write-baseline        # regenerate
     python scripts/jaxlint.py --prune-stale           # drop dead entries
@@ -82,6 +83,16 @@ def main(argv=None) -> int:
         "stay fully import-free)",
     )
     p.add_argument(
+        "--diff", metavar="REF", default=None,
+        help="lint only .py files changed vs the given git ref (working "
+        "tree vs REF, e.g. --diff HEAD or --diff origin/main), "
+        "intersected with the scanned paths — the pre-commit fast "
+        "path: repo-scope checks see only the changed files, so the "
+        "whole-repo model builds are skipped (cross-file findings may "
+        "be missed; the full run stays the tier-1 gate). Exit codes "
+        "unchanged; zero changed files is a clean exit 0",
+    )
+    p.add_argument(
         "--prune-stale", action="store_true",
         help="rewrite the baseline WITHOUT the stale entries this run "
         "can see (scanned paths × selected checks) and exit 0 — stale "
@@ -119,8 +130,49 @@ def main(argv=None) -> int:
     skip = args.skip.split(",") if args.skip else ()
     baseline_path = args.baseline or analysis.default_baseline_path(REPO)
 
+    paths = list(args.paths)
+    if args.diff is not None:
+        import subprocess
+
+        try:
+            proc = subprocess.run(
+                ["git", "diff", "--name-only", args.diff, "--", "*.py"],
+                capture_output=True, text=True, cwd=REPO, check=True,
+            )
+        except (OSError, subprocess.CalledProcessError) as e:
+            detail = getattr(e, "stderr", "") or str(e)
+            print(
+                f"jaxlint: error: --diff {args.diff}: {detail.strip()}",
+                file=sys.stderr,
+            )
+            return 2
+        changed = {
+            ln.strip() for ln in proc.stdout.splitlines() if ln.strip()
+        }
+        # Intersect with the scan set: a changed file outside the
+        # requested paths (tests, scripts) stays out, exactly as in a
+        # full run over the same paths.
+        try:
+            scan_set = {
+                os.path.relpath(p, REPO).replace(os.sep, "/")
+                for p in analysis.core.iter_python_files(paths, REPO)
+            }
+        except analysis.AnalysisError as e:
+            print(f"jaxlint: error: {e}", file=sys.stderr)
+            return 2
+        paths = sorted(
+            f for f in changed
+            if f in scan_set and os.path.exists(os.path.join(REPO, f))
+        )
+        if not paths:
+            print(
+                f"jaxlint: no scanned .py files changed vs {args.diff} "
+                "— nothing to lint"
+            )
+            return 0
+
     try:
-        modules = analysis.load_modules(args.paths, REPO)
+        modules = analysis.load_modules(paths, REPO)
         findings = analysis.run_checks(modules, checks=checks, skip=skip)
         entries = (
             [] if args.no_baseline else analysis.load_baseline(baseline_path)
@@ -130,10 +182,17 @@ def main(argv=None) -> int:
         return 2
 
     scanned = {m.relpath for m in modules}
-    selected = set(checks) if checks else {
-        c.name for c in analysis.registered_checks()
-    }
-    selected -= set(skip)
+    # Alias-resolved, exactly as run_checks resolves them: `--skip
+    # host-sync` must deselect transfer-discipline HERE too, or the
+    # stale-scoping below would call its audited baseline entries
+    # stale (and --prune-stale would delete them).
+    resolve = analysis.core.resolve_check_name
+    selected = (
+        {resolve(c) for c in checks}
+        if checks
+        else {c.name for c in analysis.registered_checks()}
+    )
+    selected -= {resolve(c) for c in skip}
 
     if args.write_baseline:
         # A scoped run (path subset, --checks/--skip) regenerates only

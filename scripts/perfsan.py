@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""perfsan: dispatch/transfer budget sanitizer for the steady-state
+programs (ISSUE 15).
+
+    python scripts/perfsan.py --quick              # tier-1 profile
+    python scripts/perfsan.py --program ppo_update_device
+    python scripts/perfsan.py --revert host-gather # pre-PR-13 host
+                                                   # gather (exit 1)
+    python scripts/perfsan.py --revert uncommit    # uncommit-less swap
+                                                   # (exit 1)
+    python scripts/perfsan.py --json               # machine output
+    python scripts/perfsan.py --json --out results/perfsan_actuals.json
+
+Exit codes (scripts/tier1.sh runs --quick between numsan and pytest,
+under its own timeout):
+    0  clean: every steady-state program inside its committed
+       perf_budgets.json budget (dispatches / transfers / transferred
+       bytes / recompiles per block)
+    1  violation: a program exceeded a budget — or a reverted mode's
+       regression was detected (the sanitizer working)
+    2  crash: missing/malformed manifest, unknown program, or a broken
+       exerciser (not a detection)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[1].strip())
+    p.add_argument(
+        "--quick", action="store_true",
+        help="run every steady-state program against the committed "
+        "manifest (the tier-1 profile; also the default)",
+    )
+    p.add_argument(
+        "--program", default=None,
+        help="run ONE program (ppo_update_host / ppo_update_device / "
+        "offpolicy_ingest / serving_dispatch / mixture_fleet_step)",
+    )
+    p.add_argument(
+        "--revert", choices=("host-gather", "uncommit"), default=None,
+        help="reverted-regression mode (expected exit 1): re-introduce "
+        "the pre-PR-13 per-block host gather, or install a committed "
+        "orbax restore into the gateway without checkpoint.uncommit — "
+        "perfsan must catch either on every run",
+    )
+    p.add_argument(
+        "--manifest", default=None,
+        help="budget manifest (default: <repo>/perf_budgets.json)",
+    )
+    p.add_argument(
+        "--seed", type=int, default=0,
+        help="fixture seed (counters are structural — any seed "
+        "measures the same budgets)",
+    )
+    p.add_argument("--json", action="store_true", help="machine output")
+    p.add_argument(
+        "--out", default=None,
+        help="also write the JSON report to this path (what "
+        "scripts/run_report.py renders as the budget-actuals table)",
+    )
+    args = p.parse_args(argv)
+
+    from actor_critic_tpu.analysis import perfsan
+
+    if args.revert and args.program:
+        print(
+            "perfsan: error: --revert and --program are exclusive",
+            file=sys.stderr,
+        )
+        return 2
+
+    try:
+        if args.revert:
+            perfsan.run_reverted(args.revert, args.manifest)
+            print(
+                f"perfsan: error: reverted mode {args.revert!r} was NOT "
+                "detected — the meter is blind",
+                file=sys.stderr,
+            )
+            return 2
+        programs = perfsan.PROGRAMS
+        if args.program:
+            if args.program not in perfsan.PROGRAMS:
+                print(
+                    f"perfsan: error: unknown program {args.program!r} "
+                    f"(have: {', '.join(perfsan.PROGRAMS)})",
+                    file=sys.stderr,
+                )
+                return 2
+            programs = (args.program,)
+        out = perfsan.quick_profile(
+            manifest_path=args.manifest, seed=args.seed,
+            programs=programs,
+        )
+    except perfsan.ManifestError as e:
+        print(f"perfsan: error: {e}", file=sys.stderr)
+        return 2
+    except perfsan.PerfSanError as e:
+        print(f"perfsan: VIOLATION DETECTED: {e}", file=sys.stderr)
+        return 1
+    except Exception as e:
+        print(
+            f"perfsan: error: {type(e).__name__}: {e}", file=sys.stderr
+        )
+        return 2
+
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(out, f, indent=2)
+            f.write("\n")
+    if args.json:
+        print(json.dumps(out, indent=2))
+    else:
+        for name, entry in out["programs"].items():
+            a = entry["actuals"]
+            print(
+                f"perfsan: {name}: {a['dispatches']} dispatch(es), "
+                f"{a['transfers']} transfer(s), "
+                f"{a['transferred_bytes']} B, "
+                f"{a['recompiles']} recompile(s) per block — within "
+                "budget"
+            )
+        print(
+            f"perfsan: {len(out['programs'])} steady-state program(s) "
+            "green against perf_budgets.json"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

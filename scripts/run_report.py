@@ -623,6 +623,25 @@ def static_findings() -> list[str]:
             "numsan.py` poisons the real update/codec/publish/"
             "checkpoint objects under deterministic schedules",
         ]
+    perf = [
+        f for f in new
+        if f.get("check")
+        in (
+            "transfer-discipline", "donation-discipline",
+            "dispatch-granularity",
+        )
+    ]
+    if perf:
+        # Performance row (ISSUE 15): a crossing/undonated-buffer/
+        # granularity hazard in a steady-state body is a silent
+        # throughput regression — a run being diagnosed for "it got
+        # slower" should see it before the per-finding list.
+        out += [
+            f"- **performance**: {len(perf)} of these are steady-state "
+            "perf hazards (transfer-discipline / donation-discipline "
+            "/ dispatch-granularity) — `python scripts/perfsan.py` "
+            "meters the real programs against perf_budgets.json",
+        ]
     dist = [
         f for f in new
         if f.get("check")
@@ -652,6 +671,64 @@ def static_findings() -> list[str]:
             f"{'y' if len(stale) == 1 else 'ies'} (flagged lines changed "
             "— rerun `scripts/jaxlint.py --write-baseline` after review)"
         )
+    return out
+
+
+def perf_budget_table() -> list[str]:
+    """Markdown lines for the "Perf budgets" section (ISSUE 15):
+    the committed `perf_budgets.json` manifest rendered as a table,
+    with measured actuals joined when a `perfsan_actuals.json` report
+    sits next to it (written by `scripts/perfsan.py --quick
+    --out perfsan_actuals.json`). Empty when no manifest is present —
+    reports must render in any checkout."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    manifest_path = os.path.join(repo, "perf_budgets.json")
+    if not os.path.exists(manifest_path):
+        return []
+    try:
+        with open(manifest_path, encoding="utf-8") as f:
+            programs = json.load(f)["programs"]
+    except (OSError, ValueError, KeyError, TypeError):
+        return [f"*(malformed manifest: `{manifest_path}`)*"]
+    actuals: dict = {}
+    actuals_path = os.path.join(repo, "perfsan_actuals.json")
+    if os.path.exists(actuals_path):
+        try:
+            with open(actuals_path, encoding="utf-8") as f:
+                actuals = json.load(f).get("programs") or {}
+        except (OSError, ValueError, AttributeError):
+            actuals = {}
+    fields = (
+        ("max_dispatches_per_block", "dispatches"),
+        ("max_transfers_per_block", "transfers"),
+        ("max_transferred_bytes_per_block", "transferred_bytes"),
+        ("max_recompiles", "recompiles"),
+    )
+    out = [
+        "per steady-state block, budget (measured) — `python "
+        "scripts/perfsan.py --quick` gates these in tier-1:",
+        "",
+        "| program | dispatches | transfers | bytes | recompiles |",
+        "|---|---|---|---|---|",
+    ]
+    for name in sorted(programs):
+        budget = programs[name]
+        if not isinstance(budget, dict):
+            cells = ["?"] * len(fields)
+        else:
+            # A "<program>.enqueue" manifest row's actuals ride the
+            # parent program's report as `enqueue_actuals`.
+            if name.endswith(".enqueue"):
+                parent = actuals.get(name.rsplit(".", 1)[0]) or {}
+                measured = parent.get("enqueue_actuals") or {}
+            else:
+                measured = (actuals.get(name) or {}).get("actuals") or {}
+            cells = []
+            for bkey, akey in fields:
+                b = budget.get(bkey, "-")
+                a = measured.get(akey)
+                cells.append(f"{b} ({a})" if a is not None else f"{b}")
+        out.append(f"| `{name}` | " + " | ".join(map(str, cells)) + " |")
     return out
 
 
@@ -768,6 +845,12 @@ def render(
         # Only when the tree actually carries findings: a clean tree
         # must not grow a no-op section in every report.
         lines += ["## Static findings", ""] + statics + [""]
+    budgets = perf_budget_table()
+    if budgets:
+        # Rendered whenever the committed manifest is present
+        # (ISSUE 15): the budget table is a contract summary, not a
+        # finding — it belongs in every report of this repo.
+        lines += ["## Perf budgets", ""] + budgets + [""]
     if metrics_path is None:
         cand = os.path.join(telemetry_dir, "metrics.jsonl")
         metrics_path = cand if os.path.exists(cand) else None

@@ -44,6 +44,17 @@ t0=$(date +%s)
 timeout -k 5 240 env JAX_PLATFORMS=cpu python scripts/numsan.py --schedules 16 || exit $?
 echo "tier1: numsan wall $(( $(date +%s) - t0 ))s"
 t0=$(date +%s)
+# Performance budget sanitizer quick profile (ISSUE 15): the five
+# steady-state programs (async PPO update host+device plane, off-policy
+# ingest, serving dispatch, mixture fleet step) measured for
+# dispatches/transfers/transferred-bytes/recompiles per block against
+# the committed perf_budgets.json — a stray host round-trip, an extra
+# dispatch, or a recompiling swap fails here before any test runs. Own
+# timeout like the other sanitizers (exit 1 = budget violation
+# detected, 2 = exerciser/manifest crash).
+timeout -k 5 300 env JAX_PLATFORMS=cpu python scripts/perfsan.py --quick || exit $?
+echo "tier1: perfsan wall $(( $(date +%s) - t0 ))s"
+t0=$(date +%s)
 # Multi-process CPU smoke (ISSUE 9): a 2-process jax.distributed local
 # cluster must come up against a localhost coordinator, train a few
 # blocks through the global-mesh learner, and agree bit-exactly on the

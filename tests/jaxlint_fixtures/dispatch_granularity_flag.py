@@ -1,0 +1,32 @@
+"""jaxlint fixture (MUST FLAG dispatch-granularity): per-step work
+dispatched as many tiny programs — a Python reduction over device
+values, an eager jnp op in the step loop, and a two-program
+gather/update chain one fused program should absorb. Parsed only —
+never imported."""
+
+import jax
+import jax.numpy as jnp
+
+step = jax.jit(lambda s, b: s)
+gather = jax.jit(lambda s, i: s)
+
+
+def python_reduction(states, blocks):
+    for b in blocks:
+        total = sum(jnp.sum(s) for s in states)  # one dispatch per element
+        metrics = step(total, b)
+    return metrics
+
+
+def eager_per_step(state, blocks):
+    for b in blocks:
+        scaled = jnp.multiply(b, 0.5)  # its own XLA program every step
+        metrics = step(state, scaled)
+    return metrics
+
+
+def two_program_chain(state, slots, key):
+    for slot in slots:
+        block = gather(state, slot)  # program 1 ...
+        metrics = step(state, block)  # ... program 2, every iteration
+    return metrics

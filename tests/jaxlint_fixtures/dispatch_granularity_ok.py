@@ -1,0 +1,36 @@
+"""jaxlint fixture (near miss, must NOT flag): the same work folded
+into the programs — the reduction runs in-jit, the eager op moved
+inside the step, and the gather/update chain fused into ONE program
+(the ppo.make_device_update_step shape). Parsed only — never
+imported."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def make_fused_step(codecs):
+    """Gather + scale + reduce + update inside one jitted program."""
+
+    @partial(jax.jit, donate_argnums=0)
+    def fused(state, slot):
+        block = state.storage[slot]
+        scaled = jnp.multiply(block, 0.5)
+        total = jnp.sum(scaled)
+        return state, total
+
+    return fused
+
+
+def consume(state, slots, codecs):
+    fused = make_fused_step(codecs)
+    for slot in slots:
+        state, metrics = fused(state, slot)  # one program per iteration
+    return state, metrics
+
+
+def log_cadence_reduction(states, fused, state, slots):
+    for slot in slots:
+        state, metrics = fused(state, slot)
+    return sum(float(m) for m in [metrics])  # once, after the loop

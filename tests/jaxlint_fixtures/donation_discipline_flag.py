@@ -1,0 +1,32 @@
+"""jaxlint fixture (MUST FLAG donation-discipline): a recycled buffer
+through an undonated compiled program, and a donated-then-read ALIAS
+near-miss the donation-aliasing pass cannot see. Parsed only — never
+imported."""
+
+import jax
+
+
+def make_update_step(cfg):
+    """Factory shape (the repo's convention): the jit lives here, the
+    dispatch loop lives in the caller — and donation was forgotten."""
+
+    def update(state, block):
+        return state
+
+    return jax.jit(update)
+
+
+def learner_loop(cfg, state, blocks):
+    update = make_update_step(cfg)
+    for block in blocks:
+        # recycled every iteration (result rebinds the argument) but
+        # the program copy-preserves the input instead of reusing it
+        state = update(state, block)
+    return state
+
+
+def stale_view(step_fn, state, block):
+    step = jax.jit(step_fn, donate_argnums=0)
+    quant = state["quant"]  # alias INTO the donated tree ...
+    state = step(state, block)  # ... donated (and properly rebound)
+    return state, quant  # ... but the view reads the reused buffer

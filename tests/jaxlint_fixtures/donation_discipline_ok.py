@@ -1,0 +1,38 @@
+"""jaxlint fixture (near miss, must NOT flag): the same recycled shape
+WITH donation, and the alias re-derived from the donating call's
+result. Parsed only — never imported."""
+
+import jax
+
+
+def make_update_step(cfg):
+    def update(state, block):
+        return state
+
+    return jax.jit(update, donate_argnums=0)
+
+
+def learner_loop(cfg, state, blocks):
+    update = make_update_step(cfg)
+    for block in blocks:
+        state = update(state, block)  # donated AND rebound: in-place
+    return state
+
+
+def fresh_view(step_fn, state, block):
+    step = jax.jit(step_fn, donate_argnums=0)
+    state = step(state, block)
+    quant = state["quant"]  # derived from the NEW binding
+    return state, quant
+
+
+def read_before_donation(step_fn, state, block):
+    step = jax.jit(step_fn, donate_argnums=0)
+    quant = state["quant"]
+    digest = sum_host(quant)  # alias consumed BEFORE the donation ...
+    state = step(state, block)
+    return state, digest  # ... only the host digest survives
+
+
+def sum_host(tree):
+    return tree

@@ -1,0 +1,38 @@
+# jaxlint: hot-module
+"""jaxlint fixture (MUST FLAG transfer-discipline): host<->device
+crossings inside steady-state loop bodies — the host-sync sync family
+(absorbed by this pass, ISSUE 15) plus the device_get/upload kinds it
+added. Parsed only — never imported."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def collect(pool, act, obs, steps, jit_update, state):
+    for _ in range(steps):
+        action = np.asarray(act(obs))  # device→host copy per step
+        out = pool.step(action)
+        state, metrics = jit_update(state, out)
+        loss = float(metrics["loss"])  # sync per step
+        jax.block_until_ready(state)  # hard fence per step
+    return state, loss
+
+
+def consume(queue, update, params, opt_state, key, n):
+    """The pre-PR-13 host-gather learner shape: every consumed block is
+    fetched to host and re-uploaded inside the steady-state loop."""
+    for _ in range(n):
+        block = queue.get()
+        host = jax.device_get(block.arrays)  # device→host gather per block
+        arrays = {k: jnp.array(v) for k, v in host.items()}  # re-upload
+        params, opt_state, _ = update(params, opt_state, arrays, key)
+    return params, opt_state
+
+
+def restage(run, state, blocks):
+    for b in blocks:
+        staged = jax.device_put(b)  # host→device upload per iteration
+        state = run(state, staged)
+    return state

@@ -1,0 +1,38 @@
+# jaxlint: hot-module
+"""jaxlint fixture (near miss, must NOT flag): same hot module shapes,
+but values stay on device inside the loops and the coercions/uploads
+happen once outside them. Parsed only — never imported."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def collect(pool, act, obs, steps, jit_update, state):
+    for _ in range(steps):
+        action = act(obs)  # mirror/device path: no materialization
+        out = pool.step(action)
+        state, metrics = jit_update(state, out)
+    history = {k: float(v) for k, v in metrics.items()}  # once, post-loop
+    block = jnp.asarray(np.zeros((steps, 4)))  # host→device, not in a loop
+    return state, history, block
+
+
+def consume(ring, update, params, opt_state, key, n):
+    """The device-plane consume: only the slot index scalar rides the
+    dispatch — the steady-state loop touches no host arrays."""
+    for _ in range(n):
+        lease = ring.get()
+        params, opt_state, _ = ring.run(
+            lambda state: update(params, opt_state, state, lease.slot, key)
+        )
+        ring.release(lease)
+    out = jax.device_get(params)  # once, after the loop
+    return params, opt_state, out
+
+
+def restage(run, state, blocks_staged):
+    for b in blocks_staged:  # staged ONCE by the caller — resident
+        state = run(state, b)
+    return state

@@ -101,6 +101,49 @@ def test_update_wall_guarded_sub_row(tmp_path):
     assert table["update_wall.guarded_ms"] == ["-", "8.9", "?", "err"]
 
 
+def _write_budget_counter_rounds(root: Path):
+    """r01 before the counters existed, r02 carrying them, r03
+    malformed (a counter is a string), r04 a failed subprocess."""
+    (root / "BENCH_r01.json").write_text(json.dumps({
+        "metric": "a2c", "value": 1.0,
+        "cpu_metrics": {"update_wall": {"value": 8.0}},
+    }) + "\n")
+    (root / "BENCH_r02.json").write_text(json.dumps({
+        "metric": "a2c", "value": 1.0,
+        "cpu_metrics": {"update_wall": {
+            "value": 8.1, "dispatches_per_block": 1,
+            "device_transferred_bytes_per_block": 4,
+        }},
+    }) + "\n")
+    (root / "BENCH_r03.json").write_text(json.dumps({
+        "metric": "a2c", "value": 1.0,
+        "cpu_metrics": {"update_wall": {
+            "value": 8.2, "dispatches_per_block": "oops",
+            "device_transferred_bytes_per_block": None,
+        }},
+    }) + "\n")
+    (root / "BENCH_r04.json").write_text(json.dumps({
+        "metric": "a2c", "value": 1.0,
+        "cpu_metrics": {"update_wall": {"error": "rc=1: boom"}},
+    }) + "\n")
+
+
+def test_update_wall_budget_counter_sub_rows(tmp_path):
+    """ISSUE 15 satellite: the perfsan dispatch/transfer actuals trend
+    as update_wall sub-rows — '-' before the fields existed, '?' where
+    malformed, 'err' when the whole metric subprocess failed."""
+    mod = _load()
+    _write_budget_counter_rounds(tmp_path)
+    _rounds, rows = mod.trend_rows(str(tmp_path))
+    table = dict(rows)
+    assert table["update_wall.dispatches_per_block"] == [
+        "-", "1", "?", "err",
+    ]
+    assert table["update_wall.device_transferred_bytes_per_block"] == [
+        "-", "4", "?", "err",
+    ]
+
+
 def _write_multihost_rounds(root: Path):
     """r01 without the metric, r02 a full multihost record, r03 a
     malformed one (sync curve not a dict), r04 an unparseable file."""
@@ -326,6 +369,8 @@ def _write_data_plane_rounds(root: Path):
                     "host_per_consumed_block": 7232,
                     "device_per_consumed_block": 0,
                     "device_enqueue_per_block": 2960,
+                    "host_measured": 7232,
+                    "enqueue_measured": "oops",
                 },
             },
         },
@@ -359,10 +404,20 @@ def test_data_plane_sub_rows(tmp_path):
     assert table["consumed_env_steps_per_s.enqueue_bytes"] == [
         "-", "2960", "?", "?",
     ]
+    # ISSUE 15: the METERED actuals trend too — '-' before the fields
+    # existed, '?' where a counter is malformed.
+    assert table["consumed_env_steps_per_s.host_measured"] == [
+        "-", "7232", "?", "?",
+    ]
+    assert table["consumed_env_steps_per_s.enqueue_measured"] == [
+        "-", "?", "?", "?",
+    ]
     labels = [label for label, _ in rows]
     main = labels.index("consumed_env_steps_per_s")
-    assert labels[main + 1 : main + 4] == [
+    assert labels[main + 1 : main + 6] == [
         "consumed_env_steps_per_s.host",
         "consumed_env_steps_per_s.device",
         "consumed_env_steps_per_s.enqueue_bytes",
+        "consumed_env_steps_per_s.host_measured",
+        "consumed_env_steps_per_s.enqueue_measured",
     ]

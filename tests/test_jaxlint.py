@@ -38,7 +38,9 @@ PAIRS = [
     ("tracer-leak", "tracer_leak"),
     ("prng-reuse", "prng_reuse"),
     ("recompile-hazard", "recompile_hazard"),
-    ("host-sync", "host_sync"),
+    ("transfer-discipline", "transfer_discipline"),
+    ("donation-discipline", "donation_discipline"),
+    ("dispatch-granularity", "dispatch_granularity"),
     ("lock-discipline", "lock_discipline"),
     ("publish-aliasing", "publish_aliasing"),
     ("check-then-act", "check_then_act"),
@@ -182,7 +184,8 @@ def test_suppression_standalone_line_covers_next_code_line(tmp_path):
 def test_suppression_is_per_check(tmp_path):
     # Disabling a DIFFERENT check must not hide the finding.
     still = _run_snippet(
-        tmp_path, _SNIPPET.format(pragma="  # jaxlint: disable=host-sync")
+        tmp_path,
+        _SNIPPET.format(pragma="  # jaxlint: disable=transfer-discipline"),
     )
     assert len(still) == 1 and still[0].check == "prng-reuse"
     assert (
@@ -250,7 +253,7 @@ def test_hot_module_pragma_in_docstring_does_not_opt_in(tmp_path):
     assert _run_snippet(tmp_path, doc + body) == []
     # ... while a real comment pragma does opt in
     flagged = _run_snippet(tmp_path, "# jaxlint: hot-module\n" + body)
-    assert [f.check for f in flagged] == ["host-sync"]
+    assert [f.check for f in flagged] == ["transfer-discipline"]
 
 
 def test_partial_scan_reports_no_stale_exemptions(capsys):
@@ -323,7 +326,7 @@ def test_standalone_pragma_covers_multiline_statement(tmp_path):
         "import numpy as np\n"
         "def collect(act, obs, steps):\n"
         "    for _ in range(steps):\n"
-        "        # jaxlint: disable=host-sync (fixture reason)\n"
+        "        # jaxlint: disable=transfer-discipline (fixture reason)\n"
         "        obs = (\n"
         "            np.asarray(act(obs))\n"  # finding anchors HERE
         "        )\n"
@@ -337,13 +340,13 @@ def test_standalone_pragma_does_not_disable_a_whole_block(tmp_path):
         "# jaxlint: hot-module\n"
         "import numpy as np\n"
         "def collect(act, obs, steps, flag):\n"
-        "    # jaxlint: disable=host-sync (must cover the header only)\n"
+        "    # jaxlint: disable=transfer-discipline (header only)\n"
         "    for _ in range(steps):\n"
         "        obs = np.asarray(act(obs))\n"
         "    return obs\n"
     )
     flagged = _run_snippet(tmp_path, src)
-    assert [f.check for f in flagged] == ["host-sync"]
+    assert [f.check for f in flagged] == ["transfer-discipline"]
 
 
 def test_quoted_pragma_in_comment_does_not_suppress(tmp_path):
@@ -357,7 +360,23 @@ def test_quoted_pragma_in_comment_does_not_suppress(tmp_path):
         "    return obs\n"
     )
     flagged = _run_snippet(tmp_path, src)
-    assert [f.check for f in flagged] == ["host-sync"]
+    assert [f.check for f in flagged] == ["transfer-discipline"]
+
+
+def test_legacy_host_sync_pragma_still_suppresses(tmp_path):
+    """The deprecation alias (ISSUE 15): annotations written against
+    the absorbed host-sync name keep suppressing transfer-discipline
+    at their sites."""
+    src = (
+        "# jaxlint: hot-module\n"
+        "import numpy as np\n"
+        "def collect(act, obs, steps):\n"
+        "    for _ in range(steps):\n"
+        "        # jaxlint: disable=host-sync (legacy annotation)\n"
+        "        obs = np.asarray(act(obs))\n"
+        "    return obs\n"
+    )
+    assert _run_snippet(tmp_path, src) == []
 
 
 def test_stale_warnings_are_check_scoped(capsys):
@@ -433,18 +452,46 @@ def test_malformed_baseline_is_a_crash_not_a_clean_run(tmp_path):
 # ---------------------------------------------------------------------------
 
 
-def test_cli_list_checks_names_all_fifteen(capsys):
+def test_cli_list_checks_names_all_eighteen(capsys):
     cli = _load_cli()
     assert cli.main(["--list-checks"]) == 0
     out = capsys.readouterr().out
     for name in (
         "donation-aliasing", "tracer-leak", "prng-reuse",
-        "recompile-hazard", "host-sync", "warmup-registry",
+        "recompile-hazard", "transfer-discipline", "warmup-registry",
         "lock-discipline", "publish-aliasing", "check-then-act",
         "collective-discipline", "mailbox-protocol", "rank-affinity",
         "precision-discipline", "nonfinite-hazard", "sink-guard",
+        "donation-discipline", "dispatch-granularity",
     ):
         assert name in out
+    # absorbed: no registered check is NAMED host-sync any more (the
+    # docs column may still mention it as the absorbed predecessor)
+    assert not any(
+        line.startswith("host-sync") for line in out.splitlines()
+    )
+
+
+def test_select_host_sync_alias_resolves(capsys):
+    """`--select host-sync` must run transfer-discipline (the
+    deprecation alias), not crash as an unknown check."""
+    cli = _load_cli()
+    rc = cli.main(
+        [
+            str(FIXTURES / "transfer_discipline_flag.py"),
+            "--no-baseline", "--select", "host-sync",
+        ]
+    )
+    capsys.readouterr()
+    assert rc == 1  # the flag fixture's findings surface through the alias
+    rc = cli.main(
+        [
+            str(FIXTURES / "prng_reuse_flag.py"),
+            "--no-baseline", "--select", "host-sync",
+        ]
+    )
+    capsys.readouterr()
+    assert rc == 0  # alias selects ONLY the successor check
 
 
 def test_cli_exit_codes_distinguish_findings_from_crashes(
@@ -584,6 +631,68 @@ def test_prune_stale_refuses_no_baseline(tmp_path, capsys):
     capsys.readouterr()
     assert rc == 2
     assert analysis.load_baseline(str(bl))[0]["reason"] == "audited"
+
+
+# ---------------------------------------------------------------------------
+# --diff mode (ISSUE 15 satellite): lint only files changed vs a ref
+# ---------------------------------------------------------------------------
+
+
+def _scratch_repo(tmp_path):
+    """A throwaway git repo the CLI's REPO global is redirected into —
+    the only way to make --diff deterministic regardless of the real
+    working tree's state."""
+    import subprocess
+
+    root = tmp_path / "scratch"
+    root.mkdir()
+    git = ["git", "-C", str(root), "-c", "user.email=t@t",
+           "-c", "user.name=t"]
+    subprocess.run([*git[:3], "init", "-q"], check=True)
+    (root / "clean.py").write_text("x = 1\n")
+    (root / "hot.py").write_text("y = 2\n")
+    subprocess.run([*git, "add", "-A"], check=True)
+    subprocess.run([*git, "commit", "-qm", "seed"], check=True)
+    return root
+
+
+def test_diff_mode_lints_only_changed_files(tmp_path, capsys):
+    cli = _load_cli()
+    root = _scratch_repo(tmp_path)
+    old_repo = cli.REPO
+    cli.REPO = str(root)
+    try:
+        # nothing changed -> clean exit 0 without scanning anything
+        rc = cli.main(["clean.py", "hot.py", "--no-baseline",
+                       "--diff", "HEAD"])
+        out = capsys.readouterr().out
+        assert rc == 0 and "nothing to lint" in out
+        # introduce a finding in ONE file: only it is linted
+        (root / "hot.py").write_text(
+            "import jax\n"
+            "def f(seed):\n"
+            "    key = jax.random.key(seed)\n"
+            "    a = jax.random.normal(key, (2,))\n"
+            "    b = jax.random.uniform(key, (2,))\n"
+            "    return a + b\n"
+        )
+        rc = cli.main(["clean.py", "hot.py", "--no-baseline",
+                       "--diff", "HEAD", "--json",
+                       "--skip", "warmup-registry"])
+        payload = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert {f["path"] for f in payload["new"]} == {"hot.py"}
+        # a changed file OUTSIDE the scanned paths stays out
+        rc = cli.main(["clean.py", "--no-baseline", "--diff", "HEAD"])
+        out = capsys.readouterr().out
+        assert rc == 0 and "nothing to lint" in out
+        # exit codes unchanged: a bad ref is a crash, not a clean run
+        rc = cli.main(["clean.py", "--no-baseline",
+                       "--diff", "no-such-ref"])
+        capsys.readouterr()
+        assert rc == 2
+    finally:
+        cli.REPO = old_repo
 
 
 # ---------------------------------------------------------------------------
@@ -906,6 +1015,124 @@ def test_sampler_nan_crash_revert_trips_sink_guard(tmp_path):
             ],
             str(REPO),
             checks=["sink-guard"],
+        )
+        == []
+    )
+
+
+# ---------------------------------------------------------------------------
+# the ISSUE 15 regression classes reproduce as findings (perf acceptance)
+# ---------------------------------------------------------------------------
+
+# The async PPO learner's consume path as it was BEFORE PR 13's device
+# data plane: every consumed block is gathered to host numpy and
+# re-uploaded inside the steady-state loop — the per-block transfer the
+# device ring removed. Re-introducing it must trip transfer-discipline.
+_PRE_PR13_HOST_GATHER = (
+    "# jaxlint: hot-module\n"
+    "import jax\n"
+    "import jax.numpy as jnp\n"
+    "def learner(queue, update, params, opt_state, key, n):\n"
+    "    for _ in range(n):\n"
+    "        block = queue.get()\n"
+    "        host = jax.device_get(block.arrays)\n"
+    "        arrays = {k: jnp.array(v) for k, v in host.items()}\n"
+    "        queue.release(block)\n"
+    "        params, opt_state, _ = update(params, opt_state, arrays, key)\n"
+    "    return params, opt_state\n"
+)
+
+
+def test_pre_pr13_host_gather_trips_transfer_discipline(tmp_path):
+    flagged = _run_snippet(tmp_path, _PRE_PR13_HOST_GATHER)
+    assert {f.check for f in flagged} == {"transfer-discipline"}
+    lines = {f.line for f in flagged}
+    assert len(lines) == 2  # the gather AND the re-upload
+    # the fixed device-plane consume (ppo.train_host_async's device
+    # branch) sweeps clean — audited annotations only
+    assert (
+        analysis.analyze_paths(
+            ["actor_critic_tpu/algos/ppo.py"],
+            str(REPO),
+            checks=["transfer-discipline"],
+        )
+        == []
+    )
+
+
+# An undonated recycled device ring ingest — the donation gap the
+# donation-discipline pass exists to price (the real ring's enqueue
+# donates; a NEW consumer forgetting to would re-pay a full-state copy
+# per block).
+_UNDONATED_RING_INGEST = (
+    "import jax\n"
+    "def make_ingest_update(cfg):\n"
+    "    def ingest(ring_state, block):\n"
+    "        return ring_state\n"
+    "    return jax.jit(ingest)\n"
+    "def learner(cfg, ring_state, blocks):\n"
+    "    ingest = make_ingest_update(cfg)\n"
+    "    for block in blocks:\n"
+    "        ring_state = ingest(ring_state, block)\n"
+    "    return ring_state\n"
+)
+
+
+def test_undonated_ring_ingest_trips_donation_discipline(tmp_path):
+    flagged = _run_snippet(tmp_path, _UNDONATED_RING_INGEST)
+    assert [f.check for f in flagged] == ["donation-discipline"]
+    assert "donate_argnums" in flagged[0].message
+    # the donated spelling is the near miss
+    fixed = _UNDONATED_RING_INGEST.replace(
+        "jax.jit(ingest)", "jax.jit(ingest, donate_argnums=0)"
+    )
+    assert _run_snippet(tmp_path, fixed) == []
+    # ...and the real device plane (donating enqueue/ingest) stays clean
+    assert (
+        analysis.analyze_paths(
+            [
+                "actor_critic_tpu/data_plane/ring.py",
+                "actor_critic_tpu/data_plane/device_replay.py",
+            ],
+            str(REPO),
+            checks=["donation-discipline"],
+        )
+        == []
+    )
+
+
+# A Python-level reduction over per-actor device metrics inside the
+# step loop — one tiny dispatch per element plus a sync, every
+# iteration; the dispatch-granularity class.
+_PY_REDUCTION_IN_LOOP = (
+    "import jax\n"
+    "import jax.numpy as jnp\n"
+    "step = jax.jit(lambda s, b: s, donate_argnums=0)\n"
+    "def drive(state, blocks, shards):\n"
+    "    for b in blocks:\n"
+    "        total = sum(jnp.sum(s) for s in shards)\n"
+    "        state = step(state, total)\n"
+    "    return state\n"
+)
+
+
+def test_python_reduction_trips_dispatch_granularity(tmp_path):
+    flagged = _run_snippet(tmp_path, _PY_REDUCTION_IN_LOOP)
+    assert {f.check for f in flagged} == {"dispatch-granularity"}
+    assert any("sum()" in f.message for f in flagged)
+    # folding the reduction into the program is the near miss
+    fixed = _PY_REDUCTION_IN_LOOP.replace(
+        "        total = sum(jnp.sum(s) for s in shards)\n"
+        "        state = step(state, total)\n",
+        "        state = step(state, b)\n",
+    )
+    assert _run_snippet(tmp_path, fixed) == []
+    # the real fused drivers (host_loop/mixture benches) stay clean
+    assert (
+        analysis.analyze_paths(
+            ["actor_critic_tpu/algos/host_loop.py", "bench"],
+            str(REPO),
+            checks=["dispatch-granularity"],
         )
         == []
     )

@@ -90,6 +90,23 @@ def test_spans_from_host_loop_are_valid_nested_chrome_trace(tmp_path):
     assert json.load(open(tmp_path / "trace.json"))["traceEvents"]
 
 
+def test_run_report_perf_budget_table():
+    """ISSUE 15 satellite: with the committed perf_budgets.json present
+    the report renders the budget table for every steady-state program
+    (its committed max_* values), with actuals joined only when a
+    perfsan_actuals.json report sits next to the manifest."""
+    lines = run_report.perf_budget_table()
+    assert lines, "committed manifest must render a table"
+    body = "\n".join(lines)
+    for program in (
+        "ppo_update_host", "ppo_update_device", "offpolicy_ingest",
+        "serving_dispatch", "mixture_fleet_step",
+    ):
+        assert f"`{program}`" in body
+    # the device plane's metered contract is visible in the table
+    assert "| `ppo_update_device` | 1 | 1 | 4 | 0 |" in body
+
+
 def test_span_stack_tracked_without_session():
     """Spans must maintain the open-span stack with NO session installed
     (the watchdog reads it in runs launched without --telemetry-dir)."""

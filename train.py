@@ -362,6 +362,10 @@ def run_fused(env, preset, args, logger) -> dict:
                 extra["eval_return"] = float(eval_fn(state_box[0], eval_key))
                 if typed_eval is not None:
                     for t, name in enumerate(env.member_names):
+                        # jaxlint: disable=transfer-discipline (eval
+                        # cadence: the per-type eval matrix runs
+                        # |types| dispatches once per eval, not in the
+                        # training step loop)
                         r = float(typed_eval(
                             state_box[0],
                             jax.random.fold_in(eval_key, t),
