@@ -789,6 +789,7 @@ def run_process_chaos(
     kill_rank: int = 1,
     timeout_s: float = 180.0,
     seed: int = 0,
+    telemetry_dir: Optional[str] = None,
 ) -> dict:
     """SIGKILL a REAL gossip worker mid-run and measure wall-clock
     time-to-recover: spawn `world` gossip-mode processes of
@@ -816,6 +817,8 @@ def run_process_chaos(
             "--sleep-s", "0.004", "--epochs", "1", "--minibatches", "1",
             "--seed", str(seed),
         ]
+        if telemetry_dir:
+            cmd += ["--telemetry-dir", telemetry_dir]
         return subprocess.Popen(
             cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
             text=True, env=env,
@@ -832,12 +835,57 @@ def run_process_chaos(
         t0 = time.monotonic()
         for r in range(world):
             procs[r] = spawn(r, duration_s, mailbox)
+        if telemetry_dir:
+            # Start the kill clock only once the victim is actually
+            # recording: worker startup (jax import + session
+            # construction) can dwarf kill_after_s on a cold cache, and
+            # SIGKILLing before the flight ring exists would prove
+            # nothing about crash recording.
+            from actor_critic_tpu.telemetry import flight
+
+            ring = os.path.join(
+                telemetry_dir, f"host{kill_rank}", flight.RING_FILENAME
+            )
+            ready_deadline = time.monotonic() + timeout_s
+            while time.monotonic() < ready_deadline:
+                if flight.harvest(ring):
+                    break
+                if procs[kill_rank].poll() is not None:
+                    break  # died at startup; surfaced by harvest below
+                time.sleep(0.05)
         time.sleep(kill_after_s)
         victim = procs[kill_rank]
         victim.send_signal(signal.SIGKILL)
         victim.wait(timeout=30)
         t_kill = time.monotonic()
         record["killed_at_s"] = round(t_kill - t0, 3)
+        if telemetry_dir:
+            # Post-mortem flight harvest (ISSUE 16) — BEFORE the
+            # restart, which recreates (zeroes) the same rank's ring.
+            # The victim got no chance to flush anything: every record
+            # here survived SIGKILL purely via the mmap'd ring.
+            from actor_critic_tpu.telemetry import flight
+
+            ring = os.path.join(
+                telemetry_dir, f"host{kill_rank}", flight.RING_FILENAME
+            )
+            flight_records = flight.harvest(ring)
+            if not flight_records:
+                raise FleetSanError(
+                    f"SIGKILL'd rank {kill_rank} left no harvestable "
+                    f"flight-ring records at {ring} — the crash "
+                    "recorder lost the victim's final seconds"
+                )
+            record["flight_dump"] = flight.write_dump(
+                os.path.join(
+                    telemetry_dir, f"host{kill_rank}",
+                    "flight_dump_sigkill_harvest.json",
+                ),
+                flight_records,
+                reason="sigkill_harvest",
+                meta={"rank": kill_rank, "seed": seed, "world": world},
+            )
+            record["flight_records"] = len(flight_records)
         time.sleep(restart_after_s)
         from actor_critic_tpu.parallel.multihost import params_file
 

@@ -736,8 +736,8 @@ def exercise_batcher(
         attach_batcher_poisoner(batcher)
         freeze_on_swap(store)
     report = {
-        "seed": seed, "responses": 0, "swaps": 0, "race_detected": False,
-        "alias_submit": alias_submit,
+        "seed": seed, "responses": 0, "swaps": 0, "scrapes": 0,
+        "race_detected": False, "alias_submit": alias_submit,
     }
     progress = {"clients_done": 0, "swapper_done": False}
 
@@ -813,10 +813,49 @@ def exercise_batcher(
             batcher._flush_once(block=False)
             sched.yield_point("flushed")
 
+    def scraper() -> None:
+        # /metrics scrape as a schedule participant (ISSUE 16): the
+        # exporter's reads — gauge() + per-policy histogram snapshots —
+        # interleave with hot-swaps and flushes on every seeded
+        # schedule. A scrape must never see a torn histogram (cumulative
+        # buckets non-monotone, or +Inf bucket != count) and its
+        # counters must never run backwards between scrapes.
+        from actor_critic_tpu.telemetry import histo
+
+        last_count: dict = {}
+        while not (
+            progress["clients_done"] >= clients
+            and progress["swapper_done"]
+        ):
+            row = batcher.gauge()
+            report["scrapes"] += 1
+            for k, v in row.items():
+                if not histo.is_snapshot(v):
+                    continue
+                cum = v["buckets"]
+                if any(b < a for b, a in zip(cum[1:], cum)) or (
+                    cum[-1] != v["count"]
+                ):
+                    report["race_detected"] = True
+                    raise RacesanError(
+                        f"scrape saw torn histogram {k}: buckets {cum} "
+                        f"count {v['count']} under seed {seed}"
+                    )
+                if v["count"] < last_count.get(k, 0):
+                    report["race_detected"] = True
+                    raise RacesanError(
+                        f"scrape saw histogram {k} count run backwards "
+                        f"({last_count[k]} -> {v['count']}) under "
+                        f"seed {seed}"
+                    )
+                last_count[k] = v["count"]
+            sched.yield_point("scraped")
+
     for c in range(clients):
         sched.spawn(f"client-{c}", lambda c=c: client(c))
     sched.spawn("swapper", swapper)
     sched.spawn("dispatcher", dispatcher)
+    sched.spawn("scraper", scraper)
     try:
         sched.run(timeout_s=timeout_s)
     finally:
@@ -1007,6 +1046,7 @@ def exercise_sweep(
         "takes": sum(r.get("takes", 0) for r in reports),
         "responses": sum(r.get("responses", 0) for r in reports),
         "swaps": sum(r.get("swaps", 0) for r in reports),
+        "scrapes": sum(r.get("scrapes", 0) for r in reports),
         "races": sum(1 for r in reports if r.get("race_detected")),
     }
 

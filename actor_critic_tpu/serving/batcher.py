@@ -26,9 +26,13 @@ payload: a client reusing its obs buffer after submit() must not be
 able to tear a flush (the PR 6 zero-copy class — racesan's
 `exercise_batcher` drives the aliasing variant to prove detection).
 
-Import-light by design (numpy/threading): racesan and the unit tests
-exercise request/flush/hot-swap interleavings with a stub engine and
-never pull jax.
+Import-light by design (numpy/threading/stdlib telemetry): racesan and
+the unit tests exercise request/flush/hot-swap interleavings with a
+stub engine and never pull jax — the telemetry modules imported here
+(histo, session's current(), spans' flow id) are stdlib-only at import
+time. Trace/span emission is a no-op unless a TelemetrySession is
+installed, and is host-side JSON either way (the perfsan serving
+budget holds with tracing on).
 """
 
 from __future__ import annotations
@@ -37,11 +41,14 @@ import math
 import threading
 import time
 from collections import deque
-from typing import Any, Optional
+from typing import Any, Callable, Optional
 
 import numpy as np
 
 from actor_critic_tpu.serving.policy_store import PolicyStore
+from actor_critic_tpu.telemetry import histo
+from actor_critic_tpu.telemetry.session import current as _telemetry_current
+from actor_critic_tpu.telemetry.spans import flow_id_of
 
 # jaxlint: hot-module
 
@@ -55,12 +62,34 @@ class DispatcherDown(RuntimeError):
 
 
 def _percentile(sorted_vals: list, p: float) -> float:
-    """Nearest-rank percentile of an already-sorted list (0 if empty)."""
-    if not sorted_vals:
+    """Linearly-interpolated percentile of an already-sorted list (0 if
+    empty). Nearest-rank was fine at the full 2048-sample window but on
+    a tiny cold-start window it degenerates — p99 of 10 samples IS the
+    max, and one outlier becomes the reported truth (ISSUE 16
+    satellite). Interpolating between the straddling ranks matches
+    numpy's default 'linear' method; callers report the window size
+    alongside so small-n rows read as what they are."""
+    n = len(sorted_vals)
+    if n == 0:
         return 0.0
-    k = max(0, min(len(sorted_vals) - 1,
-                   math.ceil(p / 100.0 * len(sorted_vals)) - 1))
-    return float(sorted_vals[k])
+    if n == 1:
+        return float(sorted_vals[0])
+    rank = (p / 100.0) * (n - 1)
+    lo = int(math.floor(rank))
+    hi = min(lo + 1, n - 1)
+    frac = rank - lo
+    return float(sorted_vals[lo]) * (1.0 - frac) + float(sorted_vals[hi]) * frac
+
+
+# Per-policy SLO burn window: the burn-rate gauge is the violation
+# fraction of the last this-many requests over the error budget — long
+# enough to smooth single-flush noise, short enough that a regression
+# moves the gauge within seconds at serving rates.
+SLO_BURN_WINDOW = 512
+# Error budget fraction an SLO class tolerates: burn 1.0 = violating at
+# exactly budget rate; burn >> 1 = eating future budget (the alerting
+# convention from the SRE workbook's multiwindow burn alerts).
+SLO_ERROR_BUDGET = 0.01
 
 
 class ServingMetrics:
@@ -76,8 +105,17 @@ class ServingMetrics:
         self._actions = 0
         self._flushes = 0
         self._rejected = 0
+        self._shed = 0
         self._errors = 0
         self._per_policy: dict[str, int] = {}
+        # SLO layer (ISSUE 16): per-policy cumulative latency histograms
+        # (mergeable across ranks — telemetry/histo.py), declared SLO
+        # class, cumulative violation counters, and the burn window of
+        # recent over-SLO flags the burn-rate gauge derives from.
+        self._hist: dict[str, histo.Histogram] = {}
+        self._slo_ms: dict[str, float] = {}
+        self._slo_viol: dict[str, int] = {}
+        self._slo_window: dict[str, deque] = {}
 
     def record_flush(
         self,
@@ -86,6 +124,7 @@ class ServingMetrics:
         requests: int,
         latencies_ms: list,
         occupancy: float,
+        slo_ms: Optional[float] = None,
     ) -> None:
         now = time.monotonic()
         with self._lock:
@@ -98,10 +137,36 @@ class ServingMetrics:
             self._lat_ms.extend(latencies_ms)
             self._recent.append((now, rows))
             self._occupancy.append(occupancy)
+            hist = self._hist.get(policy_id)
+            if hist is None:
+                hist = self._hist[policy_id] = histo.Histogram()
+            if slo_ms is not None:
+                self._slo_ms[policy_id] = float(slo_ms)
+                window = self._slo_window.get(policy_id)
+                if window is None:
+                    window = self._slo_window[policy_id] = deque(
+                        maxlen=SLO_BURN_WINDOW
+                    )
+                over = [lat > slo_ms for lat in latencies_ms]
+                window.extend(over)
+                self._slo_viol[policy_id] = (
+                    self._slo_viol.get(policy_id, 0) + sum(over)
+                )
+        # Histogram has its own lock; observing outside _lock keeps the
+        # two critical sections short and never nested.
+        hist.observe_many(latencies_ms)
 
     def record_reject(self) -> None:
         with self._lock:
             self._rejected += 1
+
+    def record_shed(self) -> None:
+        """One load-shedding 503 that was NOT a queue-capacity reject
+        (request timeout, dispatcher down) — the admission-control leg's
+        other shed path, counted separately so a saturated queue and a
+        wedged dispatcher don't read as the same failure."""
+        with self._lock:
+            self._shed += 1
 
     def record_errors(self, n: int) -> None:
         with self._lock:
@@ -110,7 +175,7 @@ class ServingMetrics:
     def snapshot(self) -> dict:
         """Flat numeric dict for the sampler gauge registry (the
         exporter flattens one level; per-policy request counters ride as
-        `requests_<policy>` keys)."""
+        `requests_<policy>` keys, SLO rows as `slo_*_<policy>`)."""
         with self._lock:
             lat = sorted(self._lat_ms)
             recent = list(self._recent)
@@ -120,11 +185,22 @@ class ServingMetrics:
                 "actions_total": self._actions,
                 "flushes_total": self._flushes,
                 "rejected_total": self._rejected,
+                "shed_total": self._shed,
                 "errors_total": self._errors,
             }
             per_policy = dict(self._per_policy)
+            slo_ms = dict(self._slo_ms)
+            slo_viol = dict(self._slo_viol)
+            slo_frac = {
+                pid: (sum(w) / len(w) if w else 0.0)
+                for pid, w in self._slo_window.items()
+            }
         out["latency_p50_ms"] = round(_percentile(lat, 50), 3)
         out["latency_p99_ms"] = round(_percentile(lat, 99), 3)
+        # The percentile window size rides along: a p99 over 7 samples
+        # is a cold-start anecdote, not an SLO row, and the consumer
+        # can only tell when n is visible (ISSUE 16 satellite).
+        out["latency_window_n"] = len(lat)
         if occ:
             out["batch_occupancy"] = round(sum(occ) / len(occ), 4)
         if len(recent) >= 2:
@@ -138,6 +214,34 @@ class ServingMetrics:
                 )
         for pid, n in sorted(per_policy.items()):
             out[f"requests_{pid}"] = n
+        if slo_viol:
+            out["slo_violations_total"] = sum(slo_viol.values())
+        burns = {}
+        for pid, target in sorted(slo_ms.items()):
+            burn = round(slo_frac.get(pid, 0.0) / SLO_ERROR_BUDGET, 3)
+            burns[pid] = burn
+            out[f"slo_ms_{pid}"] = target
+            out[f"slo_violations_{pid}"] = slo_viol.get(pid, 0)
+            out[f"slo_burn_{pid}"] = burn
+        if burns:
+            # Headline burn = the worst policy's: the fleet alert fires
+            # on any class eating budget, not on a traffic-weighted mean
+            # that lets a small policy burn invisibly.
+            out["slo_burn"] = max(burns.values())
+        return out
+
+    def histogram_snapshots(self) -> dict[str, dict]:
+        """{policy_id: cumulative-histogram snapshot} for the exporter
+        (each snapshot carries its policy label and the metric base name
+        so the renderer emits one `serving_latency_ms` family with
+        per-policy label sets)."""
+        with self._lock:
+            hists = list(self._hist.items())
+        out = {}
+        for pid, hist in hists:
+            snap = hist.snapshot(labels={"policy": pid})
+            snap["metric"] = "latency_ms"
+            out[pid] = snap
         return out
 
 
@@ -145,9 +249,12 @@ class _PendingRequest:
     """One enqueued act request; completed by the dispatcher."""
 
     __slots__ = ("policy_id", "obs", "rows", "result", "error", "done",
-                 "t_enq")
+                 "t_enq", "trace_id", "t_enq_pc")
 
-    def __init__(self, policy_id: str, obs: np.ndarray):
+    def __init__(
+        self, policy_id: str, obs: np.ndarray,
+        trace_id: Optional[str] = None,
+    ):
         self.policy_id = policy_id
         self.obs = obs
         self.rows = int(obs.shape[0])
@@ -155,6 +262,13 @@ class _PendingRequest:
         self.error: Optional[BaseException] = None
         self.done = threading.Event()
         self.t_enq = time.monotonic()
+        # Distributed-tracing hop state (ISSUE 16): the request id the
+        # gateway minted/propagated, and the perf_counter enqueue stamp
+        # the queue-wait span starts from (t_enq above is monotonic —
+        # the latency metric's clock — while spans live on the tracer's
+        # perf_counter axis).
+        self.trace_id = trace_id
+        self.t_enq_pc = time.perf_counter()
 
 
 class MicroBatcher:
@@ -187,6 +301,16 @@ class MicroBatcher:
         # dispatcher thread stamps flush progress; health() reads the
         # plain float GIL-atomically and tolerates one-flush staleness)
         self._last_flush_t = time.monotonic()
+        # jaxlint: thread-owned=dispatcher (flush sequence number the
+        # trace emission labels serve_dispatch/serve_queue_wait spans
+        # with; only the dispatcher increments it)
+        self._flush_seq = 0
+        # Span-emission target override: the owning gateway points this
+        # at its _trace_session so dispatcher-side hops land in the same
+        # session as the gateway-thread hops even when that session is
+        # attached explicitly rather than installed as the global
+        # current one. None -> fall back to the global.
+        self.session_resolver: Optional[Callable[[], object]] = None
         self._thread: Optional[threading.Thread] = None
         if start:
             self.start()
@@ -201,13 +325,17 @@ class MicroBatcher:
     # -- client side --------------------------------------------------------
 
     def submit(
-        self, obs, policy_id: Optional[str] = None, copy: bool = True
+        self, obs, policy_id: Optional[str] = None, copy: bool = True,
+        trace_id: Optional[str] = None,
     ) -> _PendingRequest:
         """Enqueue one act request of [n, *obs_shape] rows. Raises
         UnknownPolicy (404), ValueError (400: too many rows for the
         policy's largest bucket), QueueFull / DispatcherDown (503).
         `copy=False` exists ONLY for racesan's aliasing exerciser — the
-        gateway always copies so the batcher owns the payload."""
+        gateway always copies so the batcher owns the payload.
+        `trace_id` threads the gateway's request id through the flush
+        so the dispatcher can emit the queue-wait/dispatch hops of that
+        request's trace."""
         handle = self._store.get(policy_id)
         obs = np.asarray(obs)
         if copy:
@@ -218,7 +346,7 @@ class MicroBatcher:
                 f"request of {obs.shape[0]} rows exceeds the largest "
                 f"serving bucket ({limit}) — split it client-side"
             )
-        req = _PendingRequest(handle.policy_id, obs)
+        req = _PendingRequest(handle.policy_id, obs, trace_id=trace_id)
         with self._cv:
             if self._closed or (
                 self._thread is not None and not self._thread.is_alive()
@@ -307,6 +435,7 @@ class MicroBatcher:
                 else:
                     rest.append(r)
             self._pending.extend(rest)
+        t_disp_pc = time.perf_counter()
         try:
             # Re-resolve the handle at flush time: a hot-swap that
             # landed while this flush waited serves the NEW version;
@@ -336,12 +465,62 @@ class MicroBatcher:
                 offset += r.rows
                 latencies.append((now - r.t_enq) * 1e3)
                 r.done.set()
+            occupancy = rows / max(limit, 1)
             self.metrics.record_flush(
                 handle.policy_id, rows, len(batch), latencies,
-                occupancy=rows / max(limit, 1),
+                occupancy=occupancy,
+                slo_ms=getattr(handle, "slo_ms", None),
+            )
+            self._flush_seq += 1
+            self._emit_flush_trace(
+                batch, handle, rows, occupancy, t_disp_pc,
+                time.perf_counter(),
             )
         self._last_flush_t = time.monotonic()
         return True
+
+    def _emit_flush_trace(
+        self, batch, handle, rows: int, occupancy: float,
+        t_disp_pc: float, t_done_pc: float,
+    ) -> None:
+        """Dispatcher-side hops of every traced request in one flush:
+        a `serve_dispatch` span over the engine act, one
+        `serve_queue_wait` span per request (enqueue stamp -> window
+        close), and a flow STEP per trace id binding both to the
+        request's gateway-thread track. Host-side JSON emission only —
+        nothing here touches the device, so the perfsan serving budget
+        (1 dispatch / 2 crossings per act) holds with tracing on. No-op
+        without a session (gateway-attached via session_resolver, else
+        one global read)."""
+        resolver = self.session_resolver
+        session = resolver() if resolver is not None \
+            else _telemetry_current()
+        if session is None:
+            return
+        tracer = session.tracer
+        tracer.complete(
+            "serve_dispatch", t_disp_pc, t_done_pc - t_disp_pc,
+            {
+                "policy": handle.policy_id, "version": handle.version,
+                "rows": rows, "requests": len(batch),
+                "occupancy": round(occupancy, 4), "flush": self._flush_seq,
+            },
+        )
+        for r in batch:
+            if r.trace_id is None:
+                continue
+            tracer.complete(
+                "serve_queue_wait", r.t_enq_pc,
+                max(t_disp_pc - r.t_enq_pc, 0.0),
+                {"trace": r.trace_id, "flush": self._flush_seq,
+                 "policy": r.policy_id},
+            )
+            # Flow step stamped INSIDE the dispatch span so the arrow
+            # lands on the flush slice that served this request.
+            tracer.flow(
+                flow_id_of(r.trace_id), "t",
+                ts_us=tracer.pc_to_us(t_disp_pc),
+            )
 
     # -- introspection / lifecycle ------------------------------------------
 
@@ -365,9 +544,15 @@ class MicroBatcher:
         }
 
     def gauge(self) -> dict:
-        """The sampler-registry serving gauge: metrics + live queue."""
+        """The sampler-registry serving gauge: metrics + live queue +
+        per-policy latency-histogram snapshots (dict-valued entries the
+        exporter recognizes by their `histogram` marker and renders as
+        Prometheus `_bucket/_sum/_count`; plain numeric consumers skip
+        them as before)."""
         out = self.metrics.snapshot()
         out["queue_depth"] = self.queue_depth()
+        for pid, snap in self.metrics.histogram_snapshots().items():
+            out[f"latency_ms_hist_{pid}"] = snap
         return out
 
     def close(self, timeout: float = 5.0) -> None:

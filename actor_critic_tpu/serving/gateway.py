@@ -34,6 +34,7 @@ from __future__ import annotations
 import json
 import threading
 import time
+import uuid
 from http.server import (
     BaseHTTPRequestHandler,
     HTTPServer,
@@ -50,23 +51,50 @@ from actor_critic_tpu.serving.batcher import (
     QueueFull,
 )
 from actor_critic_tpu.serving.policy_store import PolicyStore, UnknownPolicy
+from actor_critic_tpu.telemetry import histo as _histo
 from actor_critic_tpu.telemetry import sampler as _sampler
+from actor_critic_tpu.telemetry.session import current as _telemetry_current
+from actor_critic_tpu.telemetry.spans import flow_id_of
 from actor_critic_tpu.utils.numguard import NonFiniteError
+
+# Trace-id header (ISSUE 16): accepted on ingress (a caller/LB that
+# already minted one keeps its id end-to-end), minted otherwise, and
+# echoed on every /v1/act response.
+TRACE_HEADER = "x-trace-id"
+_TRACE_ID_MAX = 64  # a hostile header must not bloat every span row
+
+
+def mint_trace_id() -> str:
+    return uuid.uuid4().hex[:16]
 
 
 def standalone_metrics(batcher: MicroBatcher) -> str:
     """Prometheus text of the serving gauge alone (no session) — same
     metric names the exporter renders when the gauge rides the sampler
-    registry, so dashboards survive either deployment."""
+    registry, so dashboards survive either deployment. Histogram
+    snapshots in the gauge render as `_bucket/_sum/_count` families
+    (one family per metric, per-policy label sets)."""
     from actor_critic_tpu.telemetry import exporter as _exp
 
     rows: list[str] = []
+    hist_rows: dict[str, list[str]] = {}
     for key, value in sorted(batcher.gauge().items()):
+        if _histo.is_snapshot(value):
+            name = _exp._metric_name(
+                "serving", value.get("metric") or key
+            )
+            hist_rows.setdefault(name, []).extend(
+                _histo.render_prometheus(name, value)
+            )
+            continue
         if isinstance(value, bool) or not isinstance(value, (int, float)):
             continue
         name = _exp._metric_name("serving", key)
         rows.append(f"# TYPE {name} gauge")
         rows.append(_exp._line(name, value))
+    for name in sorted(hist_rows):
+        rows.append(f"# TYPE {name} histogram")
+        rows.extend(hist_rows[name])
     return "\n".join(rows) + "\n"
 
 
@@ -87,17 +115,25 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, *args) -> None:
         pass  # serving must not write per-request noise to the run's logs
 
-    def _respond(self, status: int, content_type: str, payload: str) -> None:
+    def _respond(
+        self, status: int, content_type: str, payload: str,
+        headers: Optional[dict] = None,
+    ) -> None:
         data = payload.encode()
         self.send_response(status)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(data)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
         self.end_headers()
         self.wfile.write(data)
 
-    def _respond_json(self, status: int, body: dict) -> None:
+    def _respond_json(
+        self, status: int, body: dict, headers: Optional[dict] = None
+    ) -> None:
         self._respond(
-            status, "application/json", json.dumps(body, default=str) + "\n"
+            status, "application/json",
+            json.dumps(body, default=str) + "\n", headers,
         )
 
     def _read_body(self) -> Optional[dict]:
@@ -112,12 +148,28 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self) -> None:  # noqa: N802 (http.server contract)
         gw = self.server.gateway  # type: ignore[attr-defined]
         path = urlparse(self.path).path
+        t_recv_pc = time.perf_counter()
         try:
             body = self._read_body()
             if body is None:
                 self._respond_json(400, {"error": "body must be a JSON object"})
             elif path == "/v1/act":
-                self._respond_json(*gw.handle_act(body))
+                # Accept a caller-minted trace id (propagation across
+                # an upstream LB/service mesh), mint otherwise; the id
+                # is echoed as a header AND in the body so both curl
+                # eyeballs and structured clients can follow it into
+                # the trace.
+                trace_id = (
+                    self.headers.get(TRACE_HEADER) or mint_trace_id()
+                )[:_TRACE_ID_MAX]
+                status, out = gw.handle_act(
+                    body, trace_id=trace_id, t_recv_pc=t_recv_pc
+                )
+                t_resp_pc = time.perf_counter()
+                self._respond_json(
+                    status, out, headers={TRACE_HEADER: trace_id}
+                )
+                gw.emit_respond_span(trace_id, t_resp_pc)
             elif path == "/v1/swap":
                 self._respond_json(*gw.handle_swap(body))
             else:
@@ -146,12 +198,21 @@ class _Handler(BaseHTTPRequestHandler):
                     {"policies": gw.store.ids(),
                      "default": gw.store.default_id},
                 )
+            elif path == "/fleetz" and gw.aggregator is not None:
+                self._respond_json(200, gw.aggregator.fleetz())
+            elif path == "/fleetz/metrics" and gw.aggregator is not None:
+                self._respond(
+                    200,
+                    "text/plain; version=0.0.4; charset=utf-8",
+                    gw.aggregator.merged_metrics(),
+                )
             else:
+                routes = ["/v1/act (POST)", "/v1/swap (POST)",
+                          "/v1/policies", "/metrics", "/healthz"]
+                if gw.aggregator is not None:
+                    routes += ["/fleetz", "/fleetz/metrics"]
                 self._respond_json(
-                    404,
-                    {"error": f"no route {path!r}",
-                     "routes": ["/v1/act (POST)", "/v1/swap (POST)",
-                                "/v1/policies", "/metrics", "/healthz"]},
+                    404, {"error": f"no route {path!r}", "routes": routes},
                 )
         except Exception as e:
             try:
@@ -212,9 +273,14 @@ class ServeGateway:
         batcher: Optional[MicroBatcher] = None,
         threaded: bool = True,
         fleet=None,
+        aggregator=None,
     ):
         self.store = store
         self.session = session
+        # Optional telemetry.fleet.FleetAggregator (ISSUE 16): when
+        # attached, GET /fleetz serves the merged per-rank fleet view
+        # and /fleetz/metrics the label-rolled-up Prometheus merge.
+        self.aggregator = aggregator
         # Optional multihost.FleetMonitor (ISSUE 12 satellite): when
         # the gateway serves one host of a --distributed fleet,
         # /healthz surfaces rank/world/per-peer mailbox ages and goes
@@ -239,6 +305,11 @@ class ServeGateway:
             max_batch_rows=max_batch_rows,
             queue_limit=queue_limit,
         )
+        # Dispatcher-side hops (serve_dispatch/serve_queue_wait) must
+        # land in the SAME session as the gateway-thread hops, including
+        # a session attached via `session=` without being installed as
+        # the global current one.
+        self.batcher.session_resolver = self._trace_session
         self._gauge_key = _sampler.register_gauge(
             "serving", self.batcher.gauge
         )
@@ -272,7 +343,50 @@ class ServeGateway:
 
     # -- route handlers (return (status, body); HTTP-free for tests) --------
 
-    def handle_act(self, body: dict) -> tuple[int, dict]:
+    def _trace_session(self):
+        """Span-emission target: the explicitly-attached session wins,
+        else the process-installed one (tests drive either shape)."""
+        return self.session if self.session is not None else \
+            _telemetry_current()
+
+    def emit_respond_span(self, trace_id: str, t_resp_pc: float) -> None:
+        """`serve_respond` hop: response serialization + the socket
+        write the handler just finished (called from do_POST AFTER the
+        bytes left, so the span covers the real write)."""
+        sess = self._trace_session()
+        if sess is not None:
+            sess.tracer.complete(
+                "serve_respond", t_resp_pc,
+                time.perf_counter() - t_resp_pc, {"trace": trace_id},
+            )
+
+    def handle_act(
+        self, body: dict, trace_id: Optional[str] = None,
+        t_recv_pc: Optional[float] = None,
+    ) -> tuple[int, dict]:
+        """One /v1/act request. `trace_id`/`t_recv_pc` come from the
+        HTTP handler (header ingress + socket-read stamp); direct
+        callers (tests, in-process clients) may omit both — an id is
+        minted so the response/trace stay correlated either way."""
+        t0_pc = time.perf_counter() if t_recv_pc is None else t_recv_pc
+        tid = trace_id or mint_trace_id()
+        status, out = self._act_traced(body, tid, t0_pc)
+        if isinstance(out, dict):
+            out.setdefault("trace", tid)
+        sess = self._trace_session()
+        if sess is not None:
+            # Flow END first (its ts must land inside the serve_request
+            # slice about to be emitted), then the request span itself.
+            sess.tracer.flow(flow_id_of(tid), "f")
+            sess.tracer.complete(
+                "serve_request", t0_pc, time.perf_counter() - t0_pc,
+                {"trace": tid, "status": status},
+            )
+        return status, out
+
+    def _act_traced(
+        self, body: dict, tid: str, t0_pc: float
+    ) -> tuple[int, dict]:
         policy_id = body.get("policy")
         if "obs" not in body:
             return 400, {"error": "missing 'obs'"}
@@ -300,21 +414,40 @@ class ServeGateway:
                 }
         elif obs.ndim == 0:
             return 400, {"error": "obs must be at least rank 1"}
+        sess = self._trace_session()
+        if sess is not None:
+            # Parse hop: socket read + JSON decode + obs validation.
+            sess.tracer.complete(
+                "serve_parse", t0_pc, time.perf_counter() - t0_pc,
+                {"trace": tid},
+            )
         t0 = time.monotonic()
         try:
             # Route by the RESOLVED id: the default route could be
             # repointed between validation above and submit, and obs
             # was validated against THIS handle's spec.
-            req = self.batcher.submit(obs, handle.policy_id)
+            req = self.batcher.submit(obs, handle.policy_id, trace_id=tid)
         except ValueError as e:  # oversized request
             return 400, {"error": str(e)}
-        except (QueueFull, DispatcherDown) as e:
+        except QueueFull as e:  # submit() already counted the reject
             return 503, {"error": str(e)}
+        except DispatcherDown as e:
+            self.batcher.metrics.record_shed()
+            return 503, {"error": str(e)}
+        if sess is not None:
+            # Flow START on this thread, stamped inside serve_request:
+            # the dispatcher's flow STEP (batcher._emit_flush_trace)
+            # links the flush that serves this request back here.
+            sess.tracer.flow(flow_id_of(tid), "s")
         try:
             actions, version = self.batcher.wait(
                 req, timeout=self.request_timeout_s
             )
         except (DispatcherDown, TimeoutError) as e:
+            # A timed-out/dispatcherless request was SHED after
+            # admission — distinct from the queue-capacity reject
+            # counter (ISSUE 16 SLO layer).
+            self.batcher.metrics.record_shed()
             return 503, {"error": str(e)}
         except Exception as e:
             # Dispatch-side flush failure relayed through wait() — the

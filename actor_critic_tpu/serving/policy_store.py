@@ -39,6 +39,12 @@ class PolicyHandle:
     version: int
     params: Any
     engine: Any  # PolicyEngine (or a duck-typed stub in tests/racesan)
+    # SLO class target (milliseconds, ISSUE 16): requests answered
+    # slower than this count against the policy's error budget in the
+    # serving metrics' burn-rate gauge. None = no SLO class (nothing is
+    # counted). Rides the handle so a hot-swap keeps the class and a
+    # flush reads it with zero extra lookups.
+    slo_ms: Optional[float] = None
 
 
 class PolicyStore:
@@ -57,11 +63,17 @@ class PolicyStore:
         version: int = 0,
         default: bool = False,
         prepare: bool = True,
+        slo_ms: Optional[float] = None,
     ) -> PolicyHandle:
         """Install a new resident policy. The FIRST registration becomes
-        the default route unless a later one claims `default=True`."""
+        the default route unless a later one claims `default=True`.
+        `slo_ms` assigns the policy's SLO latency class (serve.py
+        --slo-ms; None = unclassed)."""
         prepared = engine.prepare_params(params) if prepare else params
-        handle = PolicyHandle(str(policy_id), int(version), prepared, engine)
+        handle = PolicyHandle(
+            str(policy_id), int(version), prepared, engine,
+            slo_ms=None if slo_ms is None else float(slo_ms),
+        )
         with self._lock:
             if handle.policy_id in self._handles:
                 raise ValueError(
@@ -101,8 +113,11 @@ class PolicyStore:
             # the latest install, not this caller's possibly-stale read.
             cur = self._handles[old.policy_id]
             new_version = cur.version + 1 if version is None else int(version)
+            # The SLO class survives the swap: it classifies the route,
+            # not the checkpoint riding it.
             handle = PolicyHandle(
-                cur.policy_id, new_version, prepared, cur.engine
+                cur.policy_id, new_version, prepared, cur.engine,
+                slo_ms=cur.slo_ms,
             )
             self._handles[cur.policy_id] = handle
         return handle

@@ -42,6 +42,26 @@ _PREFIX = "actor_critic"
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 _LABEL_ESC = {"\\": "\\\\", '"': '\\"', "\n": "\\n"}
 
+LOOPBACK_HOSTS = frozenset({"127.0.0.1", "localhost", "::1"})
+
+
+def validate_bind(host: str, distributed: bool = False) -> str:
+    """Gate non-loopback telemetry binds (ISSUE 16 satellite): the
+    exporter serves process internals with no auth, so exposing it
+    beyond the host must be an explicit fleet decision — a distributed
+    run whose ranks scrape each other (`/fleetz`), stated by the caller
+    passing `distributed=True`. Raises ValueError otherwise; returns
+    the host unchanged when acceptable."""
+    if host not in LOOPBACK_HOSTS and not distributed:
+        raise ValueError(
+            f"refusing non-loopback telemetry bind {host!r} without "
+            "--distributed: /metrics exposes process internals with no "
+            "auth — bind 127.0.0.1 and scrape through an SSH tunnel, "
+            "or pass --distributed for a fleet whose ranks scrape "
+            "each other"
+        )
+    return host
+
 
 def _metric_name(*parts: str) -> str:
     return "_".join(
@@ -69,7 +89,11 @@ def _line(name: str, value: float, labels: Optional[dict] = None) -> str:
 def render_metrics(session: "TelemetrySession") -> str:
     """One Prometheus text-format exposition of the session's live state.
     Pure function of (sampler row, session) so tests can render without
-    a socket."""
+    a socket. A CLOSED session renders a tombstone (`up 0` and nothing
+    else): the gauge registry and last-observation rows are process
+    state that outlives the session, and re-serving them after close()
+    is exactly the stale-last-event bug of ISSUE 16's small fix."""
+    from actor_critic_tpu.telemetry import histo
     from actor_critic_tpu.telemetry.sampler import sample_row
 
     out: list[str] = []
@@ -78,6 +102,14 @@ def render_metrics(session: "TelemetrySession") -> str:
         out.append(f"# HELP {name} {help_}")
         out.append(f"# TYPE {name} {mtype}")
         out.extend(rows)
+
+    if getattr(session, "closed", False):
+        emit(
+            _metric_name("up"), "gauge",
+            "1 while the telemetry session is live",
+            [_line(_metric_name("up"), 0)],
+        )
+        return "\n".join(out) + "\n"
 
     row = sample_row()
     emit(
@@ -134,17 +166,40 @@ def render_metrics(session: "TelemetrySession") -> str:
                 f"per-device {field} from memory_stats()", rows,
             )
     # Registered sampler gauges (dict-valued rows flatten one level:
-    # host_pool -> actor_critic_host_pool_utilization etc.).
+    # host_pool -> actor_critic_host_pool_utilization etc.). Histogram
+    # snapshots (telemetry/histo.py marker dicts, e.g. the serving
+    # gauge's per-policy latency histograms) render as one
+    # `_bucket/_sum/_count` family per metric name, all label sets
+    # grouped under a single TYPE header.
     skip = {"ts", "recompiles", "rss_bytes", "devices"}
+    hist_rows: dict[str, list[str]] = {}
     for key, value in row.items():
         if key in skip:
             continue
-        fields = value.items() if isinstance(value, dict) else [("", value)]
+        if histo.is_snapshot(value):
+            fields = [("", value)]
+        elif isinstance(value, dict):
+            fields = value.items()
+        else:
+            fields = [("", value)]
         for fk, fv in fields:
+            if histo.is_snapshot(fv):
+                name = _metric_name(key, fv.get("metric") or fk)
+                hist_rows.setdefault(name, []).extend(
+                    histo.render_prometheus(name, fv)
+                )
+                continue
             if isinstance(fv, bool) or not isinstance(fv, (int, float)):
                 continue
             name = _metric_name(key, fk)
             emit(name, "gauge", f"registered gauge {key}", [_line(name, fv)])
+    for name in sorted(hist_rows):
+        emit(
+            name, "histogram",
+            "cumulative fixed-boundary histogram (mergeable across "
+            "ranks: buckets sum exactly)",
+            hist_rows[name],
+        )
     for rk, rv in sorted(session.rates().items()):
         name = _metric_name(rk)
         emit(

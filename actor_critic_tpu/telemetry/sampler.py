@@ -171,9 +171,17 @@ class ResourceSampler:
     seconds (plus once at start and once at stop, so even a short run
     gets a first/last pair)."""
 
-    def __init__(self, fh: IO[str], interval_s: float = 5.0):
+    def __init__(
+        self,
+        fh: IO[str],
+        interval_s: float = 5.0,
+        mirror: Optional[Callable[[dict], None]] = None,
+    ):
         self._fh = fh
         self._interval = max(float(interval_s), 0.01)
+        # Optional tap fed every sampled row — the session points this
+        # at the flight recorder so gauge trends ride the crash ring.
+        self._mirror = mirror
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._run, name="telemetry-sampler", daemon=True
@@ -189,6 +197,7 @@ class ResourceSampler:
         self._thread.join(timeout=5.0)
 
     def _emit(self) -> None:
+        row = sample_row()
         try:
             # safe_json_row, not json.dumps(allow_nan=False): one NaN
             # gauge (a diverged loss ridden into a registered gauge)
@@ -196,12 +205,17 @@ class ResourceSampler:
             # silently end resource sampling for the rest of the run —
             # the ISSUE 14 telemetry crash class. Non-finite values
             # serialize as null and the key is reported once on stderr.
-            self._fh.write(safe_json_row(sample_row()) + "\n")
+            self._fh.write(safe_json_row(row) + "\n")
         except (OSError, ValueError):
             # OSError (disk full) would otherwise kill the daemon thread
             # and silently end sampling for the rest of the run; skip
             # the row and keep ticking — the disk may come back.
             pass
+        if self._mirror is not None:
+            try:
+                self._mirror(row)
+            except Exception:
+                pass  # same contract: a broken mirror never ends sampling
 
     def _run(self) -> None:
         self._emit()

@@ -208,10 +208,16 @@ class TelemetrySession:
         sample_resources: bool = True,
         throughput_drop_threshold: float = 0.5,
         serve_port: Optional[int] = None,
+        serve_host: str = "127.0.0.1",
         profile: bool = True,
+        flight: bool = True,
     ):
         self.directory = os.fspath(directory)
         os.makedirs(self.directory, exist_ok=True)
+        # Tombstone flag the exporter keys off: once close() runs, a
+        # still-scraping /metrics must see `up 0`, not the process-global
+        # gauges of a session that no longer exists (ISSUE 16).
+        self.closed = False
         self._spans_fh = self._open("spans.jsonl")
         self._resources_fh = self._open("resources.jsonl")
         self._events_fh = self._open("events.jsonl")
@@ -221,6 +227,30 @@ class TelemetrySession:
         # stall evidence the sink exists to preserve.
         self._events_lock = threading.Lock()
         self.tracer = SpanTracer(self._spans_fh)
+        # Crash flight recorder (telemetry/flight.py): an mmap'd ring of
+        # the last N spans/events/gauge ticks that survives SIGKILL for
+        # post-mortem harvest, dumped to JSON on stall/divergence. The
+        # mirrors feed it; line-buffered sinks stay the durable record.
+        # jaxlint: thread-owned=owner (only the session-owning thread
+        # writes this — set in __init__, cleared in close(); sampler and
+        # event() callers on other threads READ it, and FlightRecorder's
+        # record/dump/close are individually no-ops after close, so a
+        # stale read during shutdown degrades to a dropped mirror record)
+        self.flight = None
+        if flight:
+            from actor_critic_tpu.telemetry.flight import (
+                RING_FILENAME,
+                FlightRecorder,
+            )
+
+            try:
+                self.flight = FlightRecorder(
+                    os.path.join(self.directory, RING_FILENAME),
+                    meta={"pid": os.getpid(), **(run_info or {})},
+                )
+                self.tracer.mirror = self.flight.mirror
+            except Exception:
+                self.flight = None  # ring creation failing never blocks a run
         self._t0 = time.monotonic()
         # Live-introspection state the exporter reads: the most recent
         # observe() row and the rates derived from consecutive rows.
@@ -259,7 +289,12 @@ class TelemetrySession:
         self.sampler: Optional[ResourceSampler] = None
         if sample_resources:
             self.sampler = ResourceSampler(
-                self._resources_fh, interval_s=resource_interval_s
+                self._resources_fh,
+                interval_s=resource_interval_s,
+                mirror=(
+                    None if self.flight is None
+                    else self.flight.record_gauges
+                ),
             ).start()
         # jaxlint: thread-owned=train (same lifecycle contract as
         # profiler above)
@@ -267,7 +302,9 @@ class TelemetrySession:
         if serve_port is not None:
             from actor_critic_tpu.telemetry.exporter import TelemetryExporter
 
-            self.exporter = TelemetryExporter(self, port=serve_port)
+            self.exporter = TelemetryExporter(
+                self, port=serve_port, host=serve_host
+            )
             self.event("exporter_start", port=self.exporter.port)
 
     @property
@@ -307,7 +344,14 @@ class TelemetrySession:
             pass  # disk full / closed mid-shutdown
         finally:
             self._events_lock.release()
+        if self.flight is not None:
+            self.flight.record(f"event_{kind}", **fields)
         if kind in DURABLE_EVENT_KINDS:
+            # Last-words path: dump the flight ring BEFORE the fsync so
+            # a stall that ends in os._exit leaves both the durable
+            # sinks and a rendered flight_dump_*.json behind.
+            if self.flight is not None:
+                self.flight.dump(kind)
             self._durable_flush()
 
     def _durable_flush(self, timeout_s: float = 2.0) -> None:
@@ -382,6 +426,18 @@ class TelemetrySession:
             self.sampler.stop()
             self.sampler = None
         self.event("session_end")
+        if self.flight is not None:
+            self.tracer.mirror = None
+            self.flight.close()
+            self.flight = None
+        # Tombstone BEFORE closing the sinks: a /metrics scrape racing
+        # shutdown (the exporter above is gone, but a standalone serving
+        # exporter may still hold this session) must flip to `up 0`
+        # rather than re-serve the dead run's last rates and gauges.
+        self.closed = True
+        self.last_observation = None
+        self._rates = {}
+        self._prev_observe = None
         for fh in (self._spans_fh, self._resources_fh, self._events_fh):
             try:
                 fh.close()

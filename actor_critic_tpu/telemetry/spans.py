@@ -17,6 +17,7 @@ from __future__ import annotations
 import os
 import threading
 import time
+import zlib
 from typing import IO, Optional
 
 from actor_critic_tpu.utils.numguard import safe_json_row
@@ -38,7 +39,21 @@ CANONICAL_PHASES = frozenset({
     "log",              # metrics materialization + sinks
     "checkpoint",       # orbax save boundary
     "profile",          # on-demand jax.profiler capture window
+    # Serving-gateway request hops (ISSUE 16): one /v1/act request
+    # renders as a flow-linked track across these.
+    "serve_request",    # whole request on its gateway handler thread
+    "serve_parse",      # HTTP body read + obs validation
+    "serve_queue_wait", # enqueue -> dispatcher pops it into a flush
+    "serve_dispatch",   # one micro-batch flush through engine.act
+    "serve_respond",    # response serialization + socket write
 })
+
+
+def flow_id_of(trace_id: str) -> int:
+    """Stable 32-bit Chrome-trace flow id for a request trace id (hex
+    or arbitrary client-minted text — crc32 keeps it deterministic
+    either way, so the same id links across processes)."""
+    return zlib.crc32(str(trace_id).encode()) & 0x7FFFFFFF
 
 
 class SpanTracer:
@@ -47,6 +62,11 @@ class SpanTracer:
     def __init__(self, fh: IO[str]):
         self._fh = fh
         self._lock = threading.Lock()
+        # Optional tap fed every emitted event dict — the session points
+        # this at the flight recorder's ring (telemetry/flight.py) so
+        # the last N spans survive a SIGKILL. Called OUTSIDE _lock (it
+        # has its own) and must never raise.
+        self.mirror = None
         self._pid = os.getpid()
         self._t0 = time.perf_counter()
         # Epoch of ts=0, kept for converting FOREIGN timestamps (worker
@@ -66,6 +86,11 @@ class SpanTracer:
     def now_us(self) -> float:
         return (time.perf_counter() - self._t0) * 1e6
 
+    def pc_to_us(self, pc: float) -> float:
+        """Convert a raw `perf_counter()` reading onto this tracer's ts
+        axis (callers that stamped an event before emission time)."""
+        return (pc - self._t0) * 1e6
+
     def _write(self, evt: dict) -> None:
         try:
             # safe_json_row: a non-finite span arg (e.g. a NaN metric
@@ -79,6 +104,12 @@ class SpanTracer:
             # down — a span emission failing on the training thread
             # would otherwise crash a multi-day run over a full disk.
             pass
+        mirror = self.mirror
+        if mirror is not None:
+            try:
+                mirror(evt)
+            except Exception:
+                pass
 
     def complete(
         self, name: str, start_pc: float, dur_s: float,
@@ -165,6 +196,33 @@ class SpanTracer:
                 self._fh.write("\n".join(lines) + "\n")
         except (OSError, ValueError):
             pass  # same never-take-the-run-down contract as _write
+
+    def flow(
+        self,
+        flow_id: int,
+        phase: str = "s",
+        ts_us: Optional[float] = None,
+        name: str = "serve_flow",
+    ) -> None:
+        """Emit one Chrome-trace flow event (`ph` "s" start / "t" step /
+        "f" end). Flow events with the same `id` draw as connecting
+        arrows between the slices that CONTAIN their timestamps — which
+        is how one request's gateway-thread span, its queue wait, and
+        the dispatcher's flush render as a single connected track
+        (ISSUE 16). Pass `ts_us` (via `pc_to_us`) to bind to a slice
+        stamped earlier than the emission call."""
+        evt = {
+            "name": name,
+            "cat": "flow",
+            "ph": phase,
+            "id": int(flow_id) & 0xFFFFFFFF,
+            "ts": round(self.now_us() if ts_us is None else ts_us, 1),
+            "pid": self._pid,
+            "tid": threading.get_ident() & 0x7FFFFFFF,
+        }
+        if phase == "f":
+            evt["bp"] = "e"  # bind to the enclosing slice, not the next
+        self._write(evt)
 
     def instant(self, name: str, args: Optional[dict] = None) -> None:
         """Emit a ph:"i" instant event (thread scope) — used to mark

@@ -1113,8 +1113,14 @@ def bench_serving_latency():
     profiler.ensure_compile_introspection()
 
     def drive(engine, threaded: bool) -> dict:
+        from actor_critic_tpu.telemetry import histo
+
         store = serving.PolicyStore()
-        store.register("default", engine, params)
+        # SLO class on the bench policy (ISSUE 16): the bench reports
+        # the server-side burn rate and histogram-derived quantiles
+        # next to the loadgen's client-side point percentiles, so a
+        # trend regression shows up in the mergeable fleet metric too.
+        store.register("default", engine, params, slo_ms=100.0)
         gw = serving.ServeGateway(
             store, port=0, max_wait_us=2000.0, threaded=threaded
         )
@@ -1136,9 +1142,13 @@ def bench_serving_latency():
                     + (out.stderr or "").strip()[-500:]
                 )
             rec = json.loads(out.stdout.strip().splitlines()[-1])
-            rec["batch_occupancy"] = gw.batcher.gauge().get(
-                "batch_occupancy", 0.0
-            )
+            gauge = gw.batcher.gauge()
+            rec["batch_occupancy"] = gauge.get("batch_occupancy", 0.0)
+            rec["slo_burn"] = gauge.get("slo_burn", 0.0)
+            snap = gw.batcher.metrics.histogram_snapshots().get("default")
+            for key, q in (("hist_p50_ms", 0.5), ("hist_p99_ms", 0.99)):
+                v = histo.quantile(snap, q) if snap else None
+                rec[key] = None if v is None else round(v, 3)
         finally:
             gw.close()
         return rec
@@ -1161,7 +1171,8 @@ def bench_serving_latency():
             "micro_batched": {
                 k: micro[k] for k in
                 ("actions_per_s", "p50_ms", "p99_ms", "requests", "errors",
-                 "batch_occupancy")
+                 "batch_occupancy", "slo_burn", "hist_p50_ms",
+                 "hist_p99_ms")
             },
             "sequential": {
                 k: seq[k] for k in

@@ -442,8 +442,13 @@ def trend_rows(root: str) -> tuple[list[int], list[tuple[str, list[str]]]]:
             # Micro-batched gateway sub-rows (ISSUE 10): the SLO curve
             # (p50/p99 at saturating closed-loop concurrency) and the
             # absolute actions/s, so a latency regression is visible
-            # even when the headline speedup ratio holds.
-            for field in ("actions_per_s", "p50_ms", "p99_ms"):
+            # even when the headline speedup ratio holds. The hist_*
+            # quantiles + burn rate (ISSUE 16) are the server-side
+            # histogram-derived view — the mergeable fleet metric —
+            # trending next to the loadgen's client-side point
+            # percentiles; rounds predating them render `?`.
+            for field in ("actions_per_s", "p50_ms", "p99_ms",
+                          "slo_burn", "hist_p50_ms", "hist_p99_ms"):
                 rows.append((
                     f"serving_latency.{field}",
                     [serving_cell(r, field) for r in recs],

@@ -73,6 +73,13 @@ def main(argv=None) -> int:
         "--duration-s", type=float, default=8.0,
         help="process scenario: per-worker wall window",
     )
+    p.add_argument(
+        "--flight-dump", default="", metavar="DIR",
+        help="ALSO run one short real-SIGKILL schedule with per-host "
+        "telemetry under DIR and harvest the victim's crash flight "
+        "ring into DIR/host<rank>/flight_dump_*.json (tier-1 asserts "
+        "the dump exists; violation exit 1 if the ring is empty)",
+    )
     p.add_argument("--json", action="store_true", help="machine output")
     args = p.parse_args(argv)
 
@@ -102,6 +109,22 @@ def main(argv=None) -> int:
             out = fleetsan.run_process_chaos(
                 duration_s=args.duration_s, seed=args.seed0
             )
+        if args.flight_dump:
+            # One short REAL kill/restart schedule with telemetry on:
+            # the acceptance check that a SIGKILL'd rank's flight ring
+            # is harvestable post-mortem (duration trimmed to fit the
+            # tier-1 step budget next to the sim sweep above).
+            os.makedirs(args.flight_dump, exist_ok=True)
+            chaos = fleetsan.run_process_chaos(
+                duration_s=6.0, kill_after_s=2.5,
+                seed=args.seed0, telemetry_dir=args.flight_dump,
+            )
+            out = dict(out) if isinstance(out, dict) else {"sweep": out}
+            out.update(
+                flight_dump=chaos.get("flight_dump"),
+                flight_records=chaos.get("flight_records"),
+                flight_ttr_s=chaos.get("time_to_recover_s"),
+            )
     except fleetsan.FleetSanError as e:
         # A detected violation names its seed: rerun that single seed
         # to replay the schedule (and its faults) bit-identically.
@@ -111,6 +134,12 @@ def main(argv=None) -> int:
         print(f"fleetsan: error: {type(e).__name__}: {e}", file=sys.stderr)
         return 2
 
+    if args.flight_dump and not args.json:
+        print(
+            f"fleetsan: flight dump harvested — {out.get('flight_dump')} "
+            f"({out.get('flight_records')} ring records, TTR "
+            f"{out.get('flight_ttr_s')}s)"
+        )
     if args.json:
         print(json.dumps(out, indent=2, default=str))
     elif args.scenario == "process":

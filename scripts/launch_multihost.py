@@ -80,10 +80,24 @@ def run_worker(args) -> int:
         host_dir = os.path.join(args.telemetry_dir, f"host{args.rank}")
         session = telemetry.TelemetrySession(
             host_dir,
-            run_info={"multihost_rank": args.rank, "mode": args.mode},
+            run_info={
+                "multihost_rank": args.rank, "mode": args.mode,
+                "seed": args.seed,
+            },
+            serve_port=0,
         )
         telemetry.set_current(session)
         multihost.host_lane(args.rank)
+        if args.mailbox_dir:
+            # Announce this rank's exporter into the gossip mailbox so
+            # any process sharing it (rank 0's rollup below, a serving
+            # gateway's /fleetz) can discover and scrape the fleet.
+            from actor_critic_tpu.telemetry import fleet as tfleet
+
+            tfleet.announce_endpoint(
+                args.mailbox_dir, args.rank,
+                f"http://127.0.0.1:{session.exporter_port}",
+            )
 
     sleep_s = args.sleep_s
     if args.rank == args.straggler_rank:
@@ -134,6 +148,24 @@ def run_worker(args) -> int:
         summary["eval_return"] = eval_return
         last = history[-1][1] if history else {}
         summary["last_loss"] = last.get("loss")
+        if session is not None and args.mailbox_dir and args.rank == 0:
+            # Fleet rollup (ISSUE 16): rank 0 scrapes every announced
+            # exporter once before exiting. Best-effort — peers that
+            # already exited degrade to `unreachable` entries, never a
+            # worker failure.
+            try:
+                from actor_critic_tpu.telemetry import fleet as tfleet
+
+                fz = tfleet.FleetAggregator(
+                    mailbox_dir=args.mailbox_dir, timeout_s=2.0
+                ).fleetz()
+                summary["fleet"] = {
+                    "size": fz["fleet_size"],
+                    "reachable": fz["reachable"],
+                    "counters": fz["counters"],
+                }
+            except Exception:
+                pass
         print(json.dumps(summary), flush=True)
         return 0
     finally:

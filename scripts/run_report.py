@@ -477,6 +477,137 @@ def slowest_spans(spans: list[dict], k: int = 10) -> list[str]:
     return out
 
 
+def request_traces(spans: list[dict], k: int = 10) -> list[str]:
+    """Markdown lines for the per-request critical-path table (ISSUE
+    16): every traced /v1/act request's hop durations, joined on the
+    `trace` span arg (parse/queue/respond) and on the `flush` arg
+    (queue_wait → the serve_dispatch flush that actually served it).
+    Empty when the run has no serving spans, so training-only reports
+    don't grow a no-op section."""
+    complete = [e for e in spans if e.get("ph") == "X"]
+
+    def by_trace(name: str) -> dict:
+        out: dict = {}
+        for e in complete:
+            if e.get("name") == name:
+                t = (e.get("args") or {}).get("trace")
+                if t is not None and t not in out:
+                    out[t] = e
+        return out
+
+    reqs = by_trace("serve_request")
+    if not reqs:
+        return []
+    parses = by_trace("serve_parse")
+    queues = by_trace("serve_queue_wait")
+    responds = by_trace("serve_respond")
+    flushes: dict = {}
+    for e in complete:
+        if e.get("name") == "serve_dispatch":
+            fl = (e.get("args") or {}).get("flush")
+            if fl is not None and fl not in flushes:
+                flushes[fl] = e
+
+    def ms(e) -> str:
+        if e is None:
+            return "—"
+        return f"{float(e.get('dur', 0.0)) / 1e3:.2f}"
+
+    top = sorted(
+        reqs.items(), key=lambda kv: -float(kv[1].get("dur", 0.0))
+    )[:max(k, 1)]
+    out = [
+        f"{len(reqs)} traced request(s); the {len(top)} slowest by "
+        "total, hop durations in ms (dispatch is the whole micro-batch "
+        "flush the request rode — shared with its batchmates; respond "
+        "is the post-handler socket write, outside total):",
+        "",
+        "| trace | status | total | parse | queue wait | dispatch "
+        "| flush | occupancy | respond |",
+        "|---|---:|---:|---:|---:|---:|---:|---:|---:|",
+    ]
+    for tid, e in top:
+        q = queues.get(tid)
+        fl = (q.get("args") or {}).get("flush") if q else None
+        d = flushes.get(fl)
+        occ = (d.get("args") or {}).get("occupancy") if d else None
+        out.append(
+            f"| `{tid}` | {(e.get('args') or {}).get('status', '?')} "
+            f"| {ms(e)} | {ms(parses.get(tid))} | {ms(q)} | {ms(d)} "
+            f"| {fl if fl is not None else '—'} "
+            f"| {occ if occ is not None else '—'} "
+            f"| {ms(responds.get(tid))} |"
+        )
+    out.append("")
+    out.append(
+        "*Flow-linked in Perfetto: `--trace`, then follow a request's "
+        "arrows from its gateway-thread slice through the dispatcher "
+        "flush that served it.*"
+    )
+    return out
+
+
+def flight_summary(telemetry_dir: str, window_s: float = 5.0) -> list[str]:
+    """Markdown lines for the flight-recorder section (ISSUE 16): the
+    newest `flight_dump_*.json` in the directory — a stalled/killed
+    run's last-N-records ring, dumped by the session on stall/divergence
+    or harvested post-mortem by fleetsan — rendered as the final
+    `window_s` seconds before the dump. Empty when no dump exists."""
+    try:
+        names = os.listdir(telemetry_dir)
+    except OSError:
+        return []
+    dumps = sorted(
+        os.path.join(telemetry_dir, n) for n in names
+        if n.startswith("flight_dump_") and n.endswith(".json")
+    )
+    if not dumps:
+        return []
+    path = max(dumps, key=os.path.getmtime)
+    try:
+        with open(path) as f:
+            body = json.load(f)
+    except (OSError, ValueError):
+        return [f"*(malformed flight dump: `{path}`)*"]
+    records = [r for r in body.get("records", []) if isinstance(r, dict)]
+    out = [
+        f"Dump `{os.path.basename(path)}` (reason: "
+        f"**{body.get('reason', '?')}**"
+        + (f", of {len(dumps)} dumps" if len(dumps) > 1 else "")
+        + f"); meta `{json.dumps(body.get('meta', {}), default=str)}`; "
+        f"{len(records)} ring record(s)."
+    ]
+    if not records:
+        return out
+    tmax = max(float(r.get("t", 0.0)) for r in records)
+    recent = [
+        r for r in records if float(r.get("t", 0.0)) >= tmax - window_s
+    ]
+    kinds: dict[str, int] = {}
+    for r in recent:
+        kinds[r.get("kind", "?")] = kinds.get(r.get("kind", "?"), 0) + 1
+    out.append(
+        f"Last {window_s:g}s before the dump: {len(recent)} record(s) — "
+        + ", ".join(f"{k} ×{v}" for k, v in sorted(kinds.items()))
+        + "."
+    )
+    out.append("")
+    out.append("| t (s before dump) | kind | detail |")
+    out.append("|---:|---|---|")
+    for r in recent[-15:]:
+        detail = {
+            k: v for k, v in r.items() if k not in ("t", "kind")
+        }
+        txt = json.dumps(detail, default=str)
+        if len(txt) > 80:
+            txt = txt[:77] + "…"
+        out.append(
+            f"| -{tmax - float(r.get('t', 0.0)):.3f} "
+            f"| **{r.get('kind', '?')}** | `{txt}` |"
+        )
+    return out
+
+
 def profile_captures(rows: list[dict], telemetry_dir: str) -> list[str]:
     """Links to on-demand profile captures: `profile_done` events plus
     any profile_* directories present on disk that lack an event (a
@@ -831,6 +962,19 @@ def render(
     lines += ["## Events & health", ""] + event_summary(events) + [""]
     lines += ["## Phase breakdown", ""] + phase_breakdown(spans) + [""]
     lines += ["## Slowest spans", ""] + slowest_spans(spans) + [""]
+    traces = request_traces(spans)
+    if traces:
+        # Only for serving runs: a training-only report must not grow a
+        # permanently empty requests section.
+        lines += ["## Request traces (serving)", ""] + traces + [""]
+    flight = flight_summary(telemetry_dir)
+    if flight:
+        # Only when a dump exists: its presence already means the run
+        # ended badly (stall/divergence dump or post-mortem harvest).
+        lines += (
+            ["## Flight recorder (last seconds before death)", ""]
+            + flight + [""]
+        )
     lines += ["## Resources", ""] + resource_summary(resources) + [""]
     lines += (
         ["## Recompile attribution", ""] + compile_attribution(events) + [""]

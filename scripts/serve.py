@@ -136,6 +136,18 @@ def main(argv=None) -> int:
         "exporter exposition and the serving gauge is sampled to disk",
     )
     p.add_argument(
+        "--telemetry-bind", default="127.0.0.1", metavar="HOST",
+        help="bind address for the session's telemetry exporter "
+        "(default 127.0.0.1; non-loopback refused unless --distributed "
+        "— /metrics has no auth)",
+    )
+    p.add_argument(
+        "--slo-ms", action="append", default=[], metavar="[ID=]MS",
+        help="per-policy latency SLO class in ms (repeatable; plain MS "
+        "applies to every policy without its own). Rides the policy "
+        "handle across hot-swaps; /metrics exports slo_burn per policy",
+    )
+    p.add_argument(
         "--compile-cache-dir", default=None,
         help="persistent XLA compile cache (warm restarts skip bucket "
         "compiles entirely)",
@@ -172,6 +184,24 @@ def main(argv=None) -> int:
             "--distributed wants --mailbox-dir and --world (the fleet "
             "this gateway is a member of)"
         )
+    from actor_critic_tpu.telemetry.exporter import validate_bind
+
+    try:
+        validate_bind(args.telemetry_bind, distributed=args.distributed)
+    except ValueError as e:
+        raise SystemExit(str(e))
+
+    slo_default = None
+    slo_by_id: dict[str, float] = {}
+    for item in args.slo_ms:
+        try:
+            if "=" in item:
+                pid, ms = item.split("=", 1)
+                slo_by_id[pid] = float(ms)
+            else:
+                slo_default = float(item)
+        except ValueError:
+            raise SystemExit(f"--slo-ms wants [ID=]MS, got {item!r}")
 
     from actor_critic_tpu import config as config_mod
     from actor_critic_tpu import serving
@@ -196,6 +226,11 @@ def main(argv=None) -> int:
             args.telemetry_dir,
             run_info={"mode": "serve", "algo": preset.algo,
                       "env": preset.env, "buckets": list(buckets)},
+            # Exporter sidecar on --telemetry-bind: the fleet
+            # aggregation path (/fleetz on any member) scrapes THIS
+            # per-rank endpoint, announced below under --distributed.
+            serve_port=0,
+            serve_host=args.telemetry_bind,
         )
         telemetry.set_current(session)
 
@@ -226,15 +261,22 @@ def main(argv=None) -> int:
                                    seed=args.seed)
     for pid, ckpt_dir in policies.items():
         params = serving.restore_policy_params(ckpt_dir, template)
-        store.register(pid, engine, params, default=(pid == args.default))
+        store.register(pid, engine, params, default=(pid == args.default),
+                       slo_ms=slo_by_id.get(pid, slo_default))
         print(f"policy {pid!r} <- {ckpt_dir}", flush=True)
     if args.random_init:
         # Without --default the FIRST registration keeps the route (a
         # loaded checkpoint, when any was given): the random policy
         # must never silently steal traffic from a real one.
         store.register("default", engine, template,
-                       default=(args.default == "default"))
+                       default=(args.default == "default"),
+                       slo_ms=slo_by_id.get("default", slo_default))
         print("policy 'default' <- random init", flush=True)
+    unknown_slo = set(slo_by_id) - set(store.ids())
+    if unknown_slo:
+        raise SystemExit(
+            f"--slo-ms names no resident policy: {sorted(unknown_slo)}"
+        )
 
     if runner is not None:
         runner.wait(timeout=120)
@@ -246,24 +288,41 @@ def main(argv=None) -> int:
         print(f"warm: {n_warm} act buckets compiled", flush=True)
 
     fleet = None
+    aggregator = None
     if args.distributed:
         from actor_critic_tpu.parallel.multihost import FleetMonitor
+        from actor_critic_tpu.telemetry.fleet import (
+            FleetAggregator,
+            announce_endpoint,
+        )
 
         fleet = FleetMonitor(
             args.mailbox_dir, args.rank, args.world,
             stale_after_s=args.stale_after_s,
         )
+        # Fleet metrics plane (ISSUE 16): announce this rank's exporter
+        # into the shared mailbox and serve merged /fleetz views from
+        # every member's discovered endpoint.
+        if session is not None and session.exporter_port is not None:
+            announce_endpoint(
+                args.mailbox_dir, args.rank,
+                f"http://{args.telemetry_bind}:{session.exporter_port}",
+            )
+        aggregator = FleetAggregator(mailbox_dir=args.mailbox_dir)
 
     gateway = serving.ServeGateway(
         store, port=args.port, host=args.host, session=session,
         max_wait_us=args.max_wait_us, queue_limit=args.queue_limit,
-        fleet=fleet,
+        fleet=fleet, aggregator=aggregator,
     )
     # The ACTUAL bound port — with --port 0 this is the OS-assigned one.
+    routes = "/v1/swap /v1/policies /metrics /healthz" + (
+        " /fleetz /fleetz/metrics" if aggregator is not None else ""
+    )
     print(
         f"serving gateway: {gateway.url}/v1/act "
         f"(policies: {sorted(store.ids())}, default {store.default_id!r}; "
-        f"also /v1/swap /v1/policies /metrics /healthz)",
+        f"also {routes})",
         flush=True,
     )
     try:

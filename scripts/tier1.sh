@@ -30,8 +30,14 @@ t0=$(date +%s)
 # schedules over the gossip-fleet + gateway-swap units (real mailbox
 # objects, injected kills/torn files/reordered delivery), under its
 # OWN timeout like the racesan step (exit 1 = protocol violation
-# detected, 2 = exerciser crash).
-timeout -k 5 180 env JAX_PLATFORMS=cpu python scripts/fleetsan.py --schedules 30 || exit $?
+# detected, 2 = exerciser crash). --flight-dump (ISSUE 16) adds one
+# REAL SIGKILL schedule with per-host telemetry and asserts the
+# victim's crash flight ring was harvested into a rendered dump — the
+# post-mortem path must produce evidence, not just not crash.
+fleetdir=$(mktemp -d /tmp/tier1_flight.XXXXXX)
+timeout -k 5 180 env JAX_PLATFORMS=cpu python scripts/fleetsan.py --schedules 30 --flight-dump "$fleetdir" || { rc=$?; rm -rf "$fleetdir"; exit $rc; }
+ls "$fleetdir"/host*/flight_dump_*.json >/dev/null 2>&1 || { echo "tier1: fleetsan left no flight dump in $fleetdir" >&2; rm -rf "$fleetdir"; exit 1; }
+rm -rf "$fleetdir"
 echo "tier1: fleetsan wall $(( $(date +%s) - t0 ))s"
 t0=$(date +%s)
 # Numerics fault sanitizer quick profile (ISSUE 14): 16 fixed-seed

@@ -271,21 +271,54 @@ def test_serving_latency_sub_rows(tmp_path):
         "metric": "a2c", "value": 1.0,
         "cpu_metrics": {"serving_latency": {"error": "rc=1"}},
     }) + "\n")
+    # r05: carries the ISSUE 16 histogram-derived fields — one of them
+    # malformed (a string where a number belongs).
+    (tmp_path / "BENCH_r05.json").write_text(json.dumps({
+        "metric": "a2c", "value": 1.0,
+        "cpu_metrics": {
+            "serving_latency": {
+                "value": 5.1,
+                "micro_batched": {
+                    "actions_per_s": 400.0, "p50_ms": 70.0,
+                    "p99_ms": 190.0, "slo_burn": 0.25,
+                    "hist_p50_ms": 68.4, "hist_p99_ms": "garbage",
+                },
+            },
+        },
+    }) + "\n")
     rounds, rows = mod.trend_rows(str(tmp_path))
-    assert rounds == [1, 2, 3, 4]
+    assert rounds == [1, 2, 3, 4, 5]
     table = dict(rows)
-    assert table["serving_latency"] == ["-", "6.6", "1", "err"]
+    assert table["serving_latency"] == ["-", "6.6", "1", "err", "5.1"]
     assert table["serving_latency.actions_per_s"] == [
-        "-", "445.6", "?", "err",
+        "-", "445.6", "?", "err", "400",
     ]
-    assert table["serving_latency.p50_ms"] == ["-", "66.2", "?", "err"]
-    assert table["serving_latency.p99_ms"] == ["-", "182.4", "?", "err"]
+    assert table["serving_latency.p50_ms"] == [
+        "-", "66.2", "?", "err", "70",
+    ]
+    assert table["serving_latency.p99_ms"] == [
+        "-", "182.4", "?", "err", "190",
+    ]
+    # ISSUE 16 sub-rows: rounds predating the fields render '?', the
+    # malformed hist_p99_ms cell degrades to '?' instead of crashing.
+    assert table["serving_latency.slo_burn"] == [
+        "-", "?", "?", "err", "0.25",
+    ]
+    assert table["serving_latency.hist_p50_ms"] == [
+        "-", "?", "?", "err", "68.4",
+    ]
+    assert table["serving_latency.hist_p99_ms"] == [
+        "-", "?", "?", "err", "?",
+    ]
     labels = [label for label, _ in rows]
     i = labels.index("serving_latency")
-    assert labels[i + 1:i + 4] == [
+    assert labels[i + 1:i + 7] == [
         "serving_latency.actions_per_s",
         "serving_latency.p50_ms",
         "serving_latency.p99_ms",
+        "serving_latency.slo_burn",
+        "serving_latency.hist_p50_ms",
+        "serving_latency.hist_p99_ms",
     ]
 
 

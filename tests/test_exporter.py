@@ -338,3 +338,171 @@ def test_ephemeral_port_reported_on_session_object(tmp_path):
     # No exporter -> None, not an attribute error.
     with _session(tmp_path, serve_port=None) as s2:
         assert s2.exporter_port is None
+
+
+# ------------------------------------------------------------- ISSUE 16
+
+
+def test_closed_session_renders_tombstone(tmp_path):
+    """A scraper hitting a session that already close()d must read
+    `up 0` — down, not frozen: stale gauges from a dead process are
+    indistinguishable from a healthy flatline."""
+    with _session(tmp_path) as s:
+        telemetry.observe(1, {"loss": 0.5})
+        live = render_metrics(s)
+        assert "actor_critic_up 1" in live and "loss" in live
+    dead = render_metrics(s)  # the with-block close()d it
+    assert dead.strip().splitlines()[-1] == "actor_critic_up 0"
+    assert "loss" not in dead  # no stale training row
+    assert len(dead.strip().splitlines()) <= 3
+
+
+def test_histogram_gauge_renders_prometheus_family(tmp_path):
+    """A histogram snapshot inside a registered gauge row renders as a
+    `_bucket/_sum/_count` family (policy-labeled), not as a skipped
+    non-numeric value."""
+    from actor_critic_tpu.telemetry import histo, sampler
+
+    h = histo.Histogram((1.0, 10.0))
+    h.observe_many([0.5, 5.0, 50.0])
+    snap = h.snapshot(labels={"policy": "champ"})
+    snap["metric"] = "latency_ms"
+    key = sampler.register_gauge(
+        "serving", lambda: {
+            "requests_total": 3, "latency_ms_hist_champ": snap,
+        },
+    )
+    try:
+        with _session(tmp_path) as s:
+            body = render_metrics(s)
+    finally:
+        sampler.unregister_gauge(key)
+    fam = "actor_critic_serving_latency_ms"
+    assert f'{fam}_bucket{{policy="champ",le="1"}} 1' in body
+    assert f'{fam}_bucket{{policy="champ",le="+Inf"}} 3' in body
+    assert f'{fam}_count{{policy="champ"}} 3' in body
+    assert "actor_critic_serving_requests_total 3" in body
+    # every line still parses as Prometheus text
+    for line in body.splitlines():
+        if line and not line.startswith("#"):
+            assert _PROM_LINE.match(line), line
+
+
+def test_concurrent_scrape_during_hot_swap_and_sampler_tick(tmp_path):
+    """/metrics scraped continuously while (a) the policy store
+    hot-swaps under live traffic and (b) the resource sampler ticks at
+    high cadence: every scrape must be complete, parseable Prometheus
+    text with monotone histogram counts — never a torn view or a 500."""
+    import numpy as np
+
+    from actor_critic_tpu import serving
+
+    class _Eng:
+        max_rows = 8
+
+        def prepare_params(self, params):
+            return {k: np.array(v) for k, v in params.items()}
+
+        def act(self, params, obs):
+            return np.asarray(obs)[:, 0] * params["scale"][0]
+
+    store = serving.PolicyStore()
+    store.register(
+        "default", _Eng(), {"scale": np.ones(1, np.float32)}, slo_ms=50.0
+    )
+    session = telemetry.TelemetrySession(
+        tmp_path, resource_interval_s=0.02, serve_port=0
+    )
+    gw = serving.ServeGateway(store, port=0, session=session)
+    stop = None
+    try:
+        import threading
+
+        stop = threading.Event()
+        errors: list = []
+
+        def traffic():
+            i = 0
+            while not stop.is_set():
+                body = json.dumps(
+                    {"obs": [[float(i + 1), 0.0]]}
+                ).encode()
+                req = urllib.request.Request(
+                    gw.url + "/v1/act", data=body,
+                    headers={"Content-Type": "application/json"},
+                )
+                try:
+                    urllib.request.urlopen(req, timeout=10).read()
+                except Exception as e:  # noqa: BLE001
+                    errors.append(("traffic", repr(e)))
+                    return
+                i += 1
+
+        def swapper():
+            v = 0
+            while not stop.is_set():
+                v += 1
+                store.swap(
+                    "default",
+                    {"scale": np.full(1, float(v + 1), np.float32)},
+                    version=v,
+                )
+                time.sleep(0.002)
+
+        threads = [
+            threading.Thread(target=traffic),
+            threading.Thread(target=swapper),
+        ]
+        for t in threads:
+            t.start()
+        last_count = 0.0
+        deadline = time.monotonic() + 2.0
+        scrapes = 0
+        count_re = re.compile(
+            r'actor_critic_serving_latency_ms_count\{policy="default"\} '
+            r"(\S+)"
+        )
+        while time.monotonic() < deadline:
+            status, text = _get(session.exporter.url + "/metrics")
+            assert status == 200
+            for line in text.splitlines():
+                if line and not line.startswith("#"):
+                    assert _PROM_LINE.match(line), line
+            m = count_re.search(text)
+            if m:
+                count = float(m.group(1))
+                assert count >= last_count  # counters never run backwards
+                last_count = count
+            scrapes += 1
+        stop.set()
+        for t in threads:
+            t.join(10)
+        assert not errors, errors[:3]
+        assert scrapes >= 10 and last_count > 0
+    finally:
+        if stop is not None:
+            stop.set()
+        gw.close()
+        session.close()
+
+
+def test_validate_bind_refuses_non_loopback_without_distributed():
+    from actor_critic_tpu.telemetry.exporter import validate_bind
+
+    for host in ("127.0.0.1", "localhost", "::1"):
+        validate_bind(host)  # loopback always fine
+    with pytest.raises(ValueError, match="distributed"):
+        validate_bind("0.0.0.0")
+    with pytest.raises(ValueError):
+        validate_bind("10.0.0.7")
+    validate_bind("0.0.0.0", distributed=True)  # fleet scrape path
+
+
+def test_cli_telemetry_bind_refused_without_distributed():
+    import train as train_cli
+
+    with pytest.raises(SystemExit, match="loopback"):
+        train_cli.main(
+            ["--preset", "a2c_cartpole", "--telemetry-dir", "/tmp/x",
+             "--telemetry-bind", "0.0.0.0"]
+        )

@@ -1,0 +1,200 @@
+"""Fleet metrics aggregation (telemetry/fleet.py, ISSUE 16): endpoint
+announce/discover over the shared mailbox dir (atomic publish, torn
+reads tolerated), and the FleetAggregator's merged views — counters
+and histogram buckets summing EXACTLY across ranks, gauges rolled up
+min/max, dead ranks degrading to `unreachable` instead of failing the
+view."""
+
+import json
+
+import pytest
+
+from actor_critic_tpu.telemetry import fleet, histo
+
+
+# ------------------------------------------------------ announce/discover
+
+
+def test_announce_then_discover_round_trip(tmp_path):
+    fleet.announce_endpoint(tmp_path, 0, "http://127.0.0.1:9100")
+    fleet.announce_endpoint(tmp_path, 3, "http://127.0.0.1:9103", seed=7)
+    assert fleet.discover_endpoints(tmp_path) == {
+        0: "http://127.0.0.1:9100", 3: "http://127.0.0.1:9103",
+    }
+    ann = fleet.read_endpoint(tmp_path, 3)
+    assert ann["rank"] == 3 and ann["seed"] == 7 and ann["pid"] > 0
+    # re-announce replaces (a restarted rank's new port wins)
+    fleet.announce_endpoint(tmp_path, 0, "http://127.0.0.1:9200")
+    assert fleet.discover_endpoints(tmp_path)[0] == "http://127.0.0.1:9200"
+
+
+def test_announce_leaves_no_tmp_droppings(tmp_path):
+    fleet.announce_endpoint(tmp_path, 1, "http://x:1")
+    names = [p.name for p in tmp_path.iterdir()]
+    assert names == ["telemetry_endpoint_host1.json"]
+
+
+def test_torn_announce_reads_as_none_not_crash(tmp_path):
+    path = fleet.endpoint_file(tmp_path, 2)
+    with open(path, "w") as f:
+        f.write('{"rank": 2, "url"')  # writer died mid-write
+    assert fleet.read_endpoint(tmp_path, 2) is None
+    assert fleet.discover_endpoints(tmp_path) == {}
+    assert fleet.read_endpoint(tmp_path, 99) is None  # absent
+    assert fleet.discover_endpoints(tmp_path / "nope") == {}
+
+
+# -------------------------------------------------- snapshot reconstruction
+
+
+def test_snapshots_from_parsed_round_trips_render(tmp_path):
+    h = histo.Histogram((1.0, 2.5, 10.0))
+    h.observe_many([0.5, 2.0, 9.0, 50.0])
+    snap = h.snapshot(labels={"policy": "champ"})
+    text = "\n".join(histo.render_prometheus("serving_latency_ms", snap))
+    out = fleet.snapshots_from_parsed(histo.parse_prometheus(text))
+    key = ("serving_latency_ms", (("policy", "champ"),))
+    assert key in out
+    back = out[key]
+    assert back["buckets"] == snap["buckets"]
+    assert back["boundaries"] == list(snap["boundaries"])
+    assert back["count"] == snap["count"]
+    assert back["sum"] == pytest.approx(snap["sum"])
+
+
+# ------------------------------------------------------------- aggregator
+
+
+def _two_rank_aggregator(rank_texts):
+    """Aggregator over static endpoints whose scrape is stubbed to the
+    given {rank: text} (None = unreachable) — no sockets, deterministic."""
+    agg = fleet.FleetAggregator(
+        endpoints={r: f"http://stub:{r}" for r in rank_texts}
+    )
+    agg._fetch = lambda url, _t=rank_texts: _t[int(url.rsplit(":", 1)[1])]
+    return agg
+
+
+def _rank_text(scale: int) -> str:
+    h = histo.Histogram((1.0, 10.0))
+    h.observe_many([0.5] * scale + [5.0] * scale + [50.0] * scale)
+    lines = [
+        "actor_critic_up 1",
+        f"actor_critic_serving_requests_total {10 * scale}",
+        f"actor_critic_rss_bytes {1000 * scale}",
+    ] + histo.render_prometheus(
+        "actor_critic_serving_latency_ms", h.snapshot(
+            labels={"policy": "default"}
+        )
+    )
+    return "\n".join(lines) + "\n"
+
+
+def test_fleetz_buckets_and_counters_sum_exactly():
+    agg = _two_rank_aggregator({0: _rank_text(2), 1: _rank_text(3)})
+    z = agg.fleetz()
+    assert z["fleet_size"] == 2 and z["reachable"] == [0, 1]
+    assert z["counters"]["actor_critic_serving_requests_total"] == 50
+    (hist,) = z["histograms"].values()
+    # rank0 buckets [2,4,6], rank1 [3,6,9] -> fleet [5,10,15], exactly
+    assert hist["buckets"] == [5, 10, 15]
+    assert hist["count"] == 15
+    # quantiles come from the MERGED buckets
+    assert 0.0 < hist["p50"] <= 10.0
+    assert hist["p99"] == 10.0  # +Inf bucket clamps to last bound
+    assert list(z["histograms"]) == [
+        "actor_critic_serving_latency_ms{policy=default}"
+    ]
+
+
+def test_fleetz_dead_rank_degrades_to_unreachable():
+    agg = _two_rank_aggregator({0: _rank_text(1), 1: None})
+    z = agg.fleetz()
+    assert z["reachable"] == [0] and z["unreachable"] == [1]
+    assert z["ranks"]["1"] == {"url": "http://stub:1", "up": False}
+    assert z["ranks"]["0"]["up"] is True
+    # the reachable rank's counters still roll up
+    assert z["counters"]["actor_critic_serving_requests_total"] == 10
+    json.dumps(z)  # the /fleetz body must be JSON-serializable
+
+
+def test_merged_metrics_labels_ranks_and_sums_fleet_rows():
+    agg = _two_rank_aggregator({0: _rank_text(2), 1: _rank_text(3)})
+    body = agg.merged_metrics()
+    samples = {
+        (name, tuple(sorted(labels.items()))): value
+        for name, labels, value in histo.parse_prometheus(body)
+    }
+
+    def get(name, **labels):
+        return samples[(name, tuple(sorted(labels.items())))]
+
+    assert get("actor_critic_fleet_size") == 2
+    assert get("actor_critic_fleet_reachable") == 2
+    # per-rank rows carry their rank label
+    assert get("actor_critic_serving_requests_total", rank="0") == 20
+    assert get("actor_critic_serving_requests_total", rank="1") == 30
+    # fleet rollup: counters sum exactly ...
+    assert get("actor_critic_serving_requests_total", rank="fleet") == 50
+    assert get(
+        "actor_critic_serving_latency_ms_bucket",
+        le="+Inf", policy="default", rank="fleet",
+    ) == 15
+    # ... gauges do NOT (min/max, never a manufactured average)
+    assert get("actor_critic_rss_bytes", rank="fleet", agg="min") == 2000
+    assert get("actor_critic_rss_bytes", rank="fleet", agg="max") == 3000
+
+
+def test_discovery_plus_static_endpoints_merge(tmp_path):
+    fleet.announce_endpoint(tmp_path, 0, "http://a:1")
+    agg = fleet.FleetAggregator(
+        mailbox_dir=str(tmp_path), endpoints={1: "http://b:2"}
+    )
+    assert agg.endpoints() == {0: "http://a:1", 1: "http://b:2"}
+
+
+def test_aggregator_against_real_exporters(tmp_path):
+    """End-to-end over real sockets: two TelemetrySessions announce
+    into one mailbox; /fleetz sees both up and merges their (shared —
+    the gauge registry is process-global, so both exporters render the
+    same snapshot) histogram buckets by exact addition."""
+    from actor_critic_tpu import telemetry
+    from actor_critic_tpu.telemetry import sampler
+
+    mailbox = tmp_path / "mailbox"
+    mailbox.mkdir()
+    h = histo.Histogram((1.0, 10.0))
+    h.observe_many([0.5, 5.0, 5.0])
+    snap = h.snapshot(labels={"policy": "default"})
+    snap["metric"] = "latency_ms"
+    key = sampler.register_gauge(
+        "serving", lambda: {
+            "requests_total": 10,
+            "latency_ms_hist_default": snap,
+        },
+    )
+    sessions = []
+    try:
+        for rank in (0, 1):
+            s = telemetry.TelemetrySession(
+                tmp_path / f"host{rank}", sample_resources=False,
+                serve_port=0, flight=False,
+            )
+            fleet.announce_endpoint(mailbox, rank, s.exporter.url)
+            sessions.append(s)
+        agg = fleet.FleetAggregator(mailbox_dir=str(mailbox))
+        z = agg.fleetz()
+        assert z["reachable"] == [0, 1]
+        hists = [
+            v for k, v in z["histograms"].items()
+            if "latency_ms" in k and "policy=default" in k
+        ]
+        assert len(hists) == 1
+        # each rank exposes buckets [1, 3, 3]; the fleet view is their
+        # exact sum, not an average or a pick
+        assert hists[0]["buckets"] == [2, 6, 6]
+        assert hists[0]["count"] == 6
+    finally:
+        sampler.unregister_gauge(key)
+        for s in sessions:
+            s.close()

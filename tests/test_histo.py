@@ -1,0 +1,132 @@
+"""SLO histogram layer (telemetry/histo.py, ISSUE 16): fixed-boundary
+cumulative histograms whose snapshots merge EXACTLY across processes
+(fleet bucket k == sum of rank bucket k — the property point
+percentiles lack), quantiles recovered by linear interpolation inside
+the landing bucket, and a Prometheus render/parse round-trip the fleet
+aggregator's scrape decoder rides."""
+
+import math
+
+import pytest
+
+from actor_critic_tpu.telemetry import histo
+
+
+def test_boundaries_must_be_strictly_increasing():
+    with pytest.raises(ValueError):
+        histo.Histogram(())
+    with pytest.raises(ValueError):
+        histo.Histogram((1.0, 1.0, 2.0))
+    with pytest.raises(ValueError):
+        histo.Histogram((5.0, 1.0))
+
+
+def test_snapshot_buckets_are_cumulative_and_inf_equals_count():
+    h = histo.Histogram((1.0, 10.0, 100.0))
+    for v in (0.5, 0.7, 5.0, 50.0, 5000.0):
+        h.observe(v)
+    snap = h.snapshot()
+    assert histo.is_snapshot(snap)
+    # cumulative: <=1 -> 2, <=10 -> 3, <=100 -> 4, +Inf -> 5
+    assert snap["buckets"] == [2, 3, 4, 5]
+    assert snap["count"] == 5 == snap["buckets"][-1]
+    assert snap["sum"] == pytest.approx(5056.2)
+
+
+def test_observe_many_matches_singles_and_skips_nan():
+    a = histo.Histogram((1.0, 2.0))
+    b = histo.Histogram((1.0, 2.0))
+    vals = [0.5, 1.5, 3.0, 0.1]
+    for v in vals:
+        a.observe(v)
+    a.observe(float("nan"))
+    b.observe_many(vals + [float("nan")])
+    assert a.snapshot()["buckets"] == b.snapshot()["buckets"]
+    assert a.snapshot()["count"] == len(vals)
+    assert not math.isnan(a.snapshot()["sum"])
+
+
+def test_merge_is_exact_bucketwise_addition():
+    a = histo.Histogram((1.0, 10.0))
+    b = histo.Histogram((1.0, 10.0))
+    a.observe_many([0.5, 5.0, 50.0])
+    b.observe_many([0.1, 0.2, 7.0])
+    sa, sb = a.snapshot(), b.snapshot()
+    m = histo.merge([sa, sb])
+    assert m["buckets"] == [
+        x + y for x, y in zip(sa["buckets"], sb["buckets"])
+    ]
+    assert m["count"] == sa["count"] + sb["count"]
+    assert m["sum"] == pytest.approx(sa["sum"] + sb["sum"])
+
+
+def test_merge_refuses_boundary_skew_and_junk():
+    a = histo.Histogram((1.0, 10.0)).snapshot()
+    b = histo.Histogram((1.0, 20.0)).snapshot()
+    assert histo.merge([a, b]) is None  # deploy skew, not a blend
+    assert histo.merge([]) is None
+    assert histo.merge([{"histogram": True}]) is None
+
+
+def test_quantile_interpolates_inside_bucket():
+    h = histo.Histogram((10.0, 20.0))
+    h.observe_many([5.0] * 10)  # all in the first bucket (0, 10]
+    # rank q*10 inside a 10-count bucket spanning 0..10 -> q*10
+    assert histo.quantile(h.snapshot(), 0.5) == pytest.approx(5.0)
+    assert histo.quantile(h.snapshot(), 0.99) == pytest.approx(9.9)
+
+
+def test_quantile_clamps_overflow_and_handles_empty():
+    h = histo.Histogram((1.0, 2.0))
+    assert histo.quantile(h.snapshot(), 0.5) is None  # empty
+    h.observe_many([100.0] * 4)  # all +Inf bucket
+    assert histo.quantile(h.snapshot(), 0.99) == 2.0  # clamp to last bound
+    assert histo.quantile(h.snapshot(), 1.5) is None  # bad q
+
+
+def test_fleet_quantile_from_merged_buckets_not_quantile_average():
+    """The motivating property: rank A all-fast, rank B all-slow — the
+    fleet p50 must come from the MERGED distribution (between the two
+    modes), which no average of per-rank p50s recovers."""
+    fast = histo.Histogram((1.0, 100.0))
+    slow = histo.Histogram((1.0, 100.0))
+    fast.observe_many([0.5] * 100)
+    slow.observe_many([50.0] * 100)
+    m = histo.merge([fast.snapshot(), slow.snapshot()])
+    q75 = histo.quantile(m, 0.75)
+    assert 1.0 < q75 <= 100.0  # lands in the slow mode's bucket
+    assert histo.quantile(fast.snapshot(), 0.75) < 1.0
+
+
+def test_render_parse_round_trip_preserves_every_sample():
+    h = histo.Histogram((1.0, 2.5, 10.0))
+    h.observe_many([0.5, 2.0, 2.2, 9.0, 99.0])
+    snap = h.snapshot(labels={"policy": "canary"})
+    lines = histo.render_prometheus("serving_latency_ms", snap)
+    text = "\n".join(lines)
+    assert 'le="1"' in text and 'le="2.5"' in text and 'le="+Inf"' in text
+    assert 'policy="canary"' in text
+    parsed = histo.parse_prometheus(text)
+    rebuilt = {
+        (name, labels.get("le")): value for name, labels, value in parsed
+    }
+    assert rebuilt[("serving_latency_ms_bucket", "+Inf")] == 5
+    assert rebuilt[("serving_latency_ms_count", None)] == 5
+    assert rebuilt[("serving_latency_ms_sum", None)] == pytest.approx(
+        snap["sum"]
+    )
+
+
+def test_parse_prometheus_skips_malformed_lines():
+    text = "\n".join([
+        "# HELP x y",
+        "good_metric 1.5",
+        'labeled{a="b",c="d,e"} 2',
+        "torn_line_no_value",
+        "bad_value abc",
+        "",
+    ])
+    parsed = histo.parse_prometheus(text)
+    assert ("good_metric", {}, 1.5) in parsed
+    assert ("labeled", {"a": "b", "c": "d,e"}, 2.0) in parsed
+    assert len(parsed) == 2

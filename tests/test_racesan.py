@@ -244,6 +244,10 @@ def test_batcher_exerciser_sweeps_clean_with_poison():
     )
     assert out["races"] == 0
     assert out["responses"] > 0 and out["swaps"] > 0
+    # The /metrics scraper participant (ISSUE 16) actually interleaved:
+    # gauge()+histogram snapshots read mid-swap/mid-flush on every
+    # schedule, checked for torn/backwards histograms.
+    assert out["scrapes"] > 0
 
 
 def test_batcher_exerciser_replays_bit_identically():

@@ -6,6 +6,7 @@ plus store/batcher/engine units."""
 from __future__ import annotations
 
 import json
+import re
 import threading
 import time
 import urllib.error
@@ -554,3 +555,249 @@ def test_mirror_backend_serves_over_http():
         assert np.asarray(body["actions"]).shape == (1, spec.action_dim)
     finally:
         gw.close()
+
+
+# ----------------------------------------------------- tracing (ISSUE 16)
+
+
+def _post_traced(url: str, body: dict, trace_id: str | None = None):
+    """POST returning (status, body, response x-trace-id header)."""
+    headers = {"Content-Type": "application/json"}
+    if trace_id is not None:
+        headers["x-trace-id"] = trace_id
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(), headers=headers
+    )
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return r.status, json.loads(r.read()), r.headers.get("x-trace-id")
+
+
+def test_trace_id_minted_echoed_and_propagated(ppo_serving):
+    gw, *_ = ppo_serving
+    obs = {"obs": [[0.0, 0.0, 0.0, 0.0]]}
+    # No header: the gateway mints a 16-hex id, echoes it in the
+    # response header AND the body.
+    status, body, tid = _post_traced(gw.url + "/v1/act", obs)
+    assert status == 200
+    assert re.fullmatch(r"[0-9a-f]{16}", tid), tid
+    assert body["trace"] == tid
+    # Caller-minted id: propagated end-to-end unchanged.
+    status, body, tid = _post_traced(
+        gw.url + "/v1/act", obs, trace_id="deadbeefcafef00d"
+    )
+    assert status == 200
+    assert tid == body["trace"] == "deadbeefcafef00d"
+    # Hostile oversize header: capped, not copied into every span row.
+    status, body, tid = _post_traced(
+        gw.url + "/v1/act", obs, trace_id="x" * 500
+    )
+    assert status == 200 and len(body["trace"]) <= 64
+
+
+def test_request_spans_linked_by_flow_events(tmp_path):
+    """The tentpole contract: one traced /v1/act request leaves the
+    full hop chain in spans.jsonl — serve_request/parse/queue_wait/
+    respond carrying its trace id, the serve_dispatch flush that served
+    it, and s/t/f flow events sharing the trace's flow id so Perfetto
+    draws one connected track across the thread handoff."""
+    from actor_critic_tpu import telemetry
+    from actor_critic_tpu.telemetry.spans import flow_id_of
+
+    store = serving.PolicyStore()
+    store.register(
+        "default", StubEngine(), {"scale": np.ones(1, np.float32)}
+    )
+    session = telemetry.TelemetrySession(
+        tmp_path, sample_resources=False, serve_port=None
+    )
+    gw = serving.ServeGateway(store, port=0, session=session)
+    try:
+        status, body, _ = _post_traced(
+            gw.url + "/v1/act", {"obs": [[2.0, 0.0]]},
+            trace_id="cafe0000cafe0000",
+        )
+        assert status == 200 and body["trace"] == "cafe0000cafe0000"
+    finally:
+        gw.close()
+        session.close()
+    events = [
+        json.loads(line)
+        for line in (tmp_path / "spans.jsonl").read_text().splitlines()
+    ]
+    tid = "cafe0000cafe0000"
+    spans = {
+        e["name"]: e for e in events
+        if e.get("ph") == "X" and (e.get("args") or {}).get("trace") == tid
+    }
+    for name in ("serve_request", "serve_parse", "serve_queue_wait",
+                 "serve_respond"):
+        assert name in spans, (name, sorted(spans))
+    assert spans["serve_request"]["args"]["status"] == 200
+    # the queue-wait span names the flush that served the request, and
+    # that flush's serve_dispatch span exists with batch stats
+    flush = spans["serve_queue_wait"]["args"]["flush"]
+    dispatches = [
+        e for e in events if e.get("ph") == "X"
+        and e.get("name") == "serve_dispatch"
+        and (e.get("args") or {}).get("flush") == flush
+    ]
+    assert len(dispatches) == 1
+    assert dispatches[0]["args"]["requests"] >= 1
+    assert 0.0 < dispatches[0]["args"]["occupancy"] <= 1.0
+    # flow triplet: start (gateway thread), step (dispatcher), end
+    # (gateway, inside the serve_request slice), one shared id
+    flows = [e for e in events if e.get("ph") in ("s", "t", "f")
+             and e.get("id") == flow_id_of(tid)]
+    phases = sorted(e["ph"] for e in flows)
+    assert phases == ["f", "s", "t"], flows
+    fin = next(e for e in flows if e["ph"] == "f")
+    req = spans["serve_request"]
+    assert req["ts"] <= fin["ts"] <= req["ts"] + req["dur"]
+
+
+def test_slo_histograms_and_burn_on_metrics():
+    """Per-policy cumulative histogram + SLO burn gauges ride /metrics
+    in the Prometheus convention; an impossible SLO class burns > 1."""
+    store = serving.PolicyStore()
+    eng = StubEngine(pad_s=0.002)
+    store.register(
+        "default", eng, {"scale": np.ones(1, np.float32)},
+        slo_ms=0.001,  # unmeetable: every request violates
+    )
+    gw = serving.ServeGateway(store, port=0, max_wait_us=0.0)
+    try:
+        for _ in range(4):
+            status, _ = _post(gw.url + "/v1/act", {"obs": [[1.0, 0.0]]})
+            assert status == 200
+        _, text = _get(gw.url + "/metrics")
+    finally:
+        gw.close()
+    samples = {}
+    for line in text.splitlines():
+        if line and not line.startswith("#"):
+            head, val = line.rsplit(" ", 1)
+            samples[head] = float(val)
+    # true cumulative histogram: +Inf bucket == count == 4 requests
+    fam = "actor_critic_serving_latency_ms"
+    assert samples[fam + '_bucket{policy="default",le="+Inf"}'] == 4
+    assert samples[fam + '_count{policy="default"}'] == 4
+    assert samples[fam + '_sum{policy="default"}'] > 0
+    bucket_vals = [
+        v for k, v in samples.items() if k.startswith(fam + "_bucket")
+    ]
+    assert sorted(bucket_vals)[-1] == 4  # cumulative, monotone to count
+    # SLO layer: class, violations, burn (every request over 1 us SLO)
+    assert samples["actor_critic_serving_slo_ms_default"] == 0.001
+    assert samples["actor_critic_serving_slo_violations_default"] == 4
+    assert samples["actor_critic_serving_slo_burn_default"] > 1.0
+    assert samples["actor_critic_serving_slo_burn"] == samples[
+        "actor_critic_serving_slo_burn_default"
+    ]
+    # percentile window size rides along (small-n honesty)
+    assert samples["actor_critic_serving_latency_window_n"] == 4
+
+
+def test_slo_class_rides_swap():
+    """A hot-swap must not drop the policy's SLO class (the class is
+    an operator declaration about the POLICY id, not one params tree)."""
+    store = serving.PolicyStore()
+    eng = StubEngine()
+    store.register(
+        "default", eng, {"scale": np.ones(1, np.float32)}, slo_ms=25.0
+    )
+    assert store.get("default").slo_ms == 25.0
+    store.swap("default", {"scale": np.full(1, 2.0, np.float32)})
+    assert store.get("default").slo_ms == 25.0
+
+
+def test_percentile_linear_interpolation():
+    from actor_critic_tpu.serving.batcher import _percentile
+
+    assert _percentile([], 99) == 0.0
+    assert _percentile([7.0], 99) == 7.0
+    assert _percentile([1.0, 2.0, 3.0, 4.0], 50) == 2.5  # numpy 'linear'
+    assert _percentile([1.0, 2.0, 3.0, 4.0], 99) == pytest.approx(3.97)
+    assert _percentile([1.0, 2.0], 100) == 2.0
+
+
+def test_shed_counter_distinct_from_reject():
+    """Dispatcher-down/timeout sheds count separately from the
+    queue-capacity reject counter (two different saturation stories)."""
+    store = serving.PolicyStore()
+    store.register(
+        "default", StubEngine(), {"scale": np.ones(1, np.float32)}
+    )
+    batcher = serving.MicroBatcher(store, queue_limit=4, start=True)
+    gw = serving.ServeGateway(store, port=0, batcher=batcher)
+    try:
+        batcher.close()  # dispatcher gone: the next act is shed
+        status, _ = _post(gw.url + "/v1/act", {"obs": [[1.0, 2.0]]})
+        assert status == 503
+        snap = batcher.metrics.snapshot()
+        assert snap["shed_total"] == 1
+        assert snap["rejected_total"] == 0
+    finally:
+        gw.close()
+
+
+def test_run_report_request_trace_table_and_flight_section(tmp_path):
+    """run_report renders the per-request critical-path table from
+    serve_* spans, and the flight-recorder 'last seconds before death'
+    section from a flight dump (ISSUE 16 report satellites)."""
+    import importlib.util
+    from pathlib import Path
+
+    spec = importlib.util.spec_from_file_location(
+        "run_report",
+        Path(__file__).parent.parent / "scripts" / "run_report.py",
+    )
+    run_report = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(run_report)
+
+    spans = [
+        {"name": "serve_request", "ph": "X", "ts": 0.0, "dur": 9000.0,
+         "args": {"trace": "aaaa", "status": 200}},
+        {"name": "serve_parse", "ph": "X", "ts": 0.0, "dur": 500.0,
+         "args": {"trace": "aaaa"}},
+        {"name": "serve_queue_wait", "ph": "X", "ts": 500.0,
+         "dur": 3000.0, "args": {"trace": "aaaa", "flush": 7}},
+        {"name": "serve_dispatch", "ph": "X", "ts": 3500.0, "dur": 5000.0,
+         "args": {"flush": 7, "occupancy": 0.5, "requests": 2}},
+        {"name": "serve_respond", "ph": "X", "ts": 9100.0, "dur": 400.0,
+         "args": {"trace": "aaaa"}},
+        {"name": "serve_request", "ph": "X", "ts": 0.0, "dur": 2000.0,
+         "args": {"trace": "bbbb", "status": 200}},
+    ]
+    lines = run_report.request_traces(spans)
+    text = "\n".join(lines)
+    assert "2 traced request(s)" in text
+    rows = [ln for ln in lines if ln.startswith("| `")]
+    assert rows[0].startswith("| `aaaa`")  # slowest first
+    assert "| 9.00 | 0.50 | 3.00 | 5.00 | 7 | 0.5 | 0.40 |" in rows[0]
+    assert "| `bbbb` | 200 | 2.00 | — | — | — | — | — | — |" in text
+    # no serving spans -> no section
+    assert run_report.request_traces([{"name": "update", "ph": "X"}]) == []
+
+    # flight section: dump -> rendered table with relative offsets
+    from actor_critic_tpu.telemetry import flight
+
+    rec = flight.FlightRecorder(
+        tmp_path / flight.RING_FILENAME, slots=8, slot_size=256,
+        meta={"rank": 1},
+    )
+    rec.record("event_stall", open_span="update")
+    rec.dump("stall")
+    rec.close()
+    flines = run_report.flight_summary(str(tmp_path))
+    ftext = "\n".join(flines)
+    assert "flight_dump_stall_1.json" in ftext
+    assert "reason: **stall**" in ftext
+    assert "**event_stall**" in ftext and "open_span" in ftext
+    assert run_report.flight_summary(str(tmp_path / "empty")) == []
+    # the full render wires both sections in
+    (tmp_path / "spans.jsonl").write_text(
+        "\n".join(json.dumps(s) for s in spans) + "\n"
+    )
+    report = run_report.render(str(tmp_path))
+    assert "Slowest traced requests" in report or "| `aaaa`" in report
+    assert "Flight recorder" in report

@@ -718,6 +718,13 @@ def main(argv=None) -> int:
         "captures land there). SIGUSR2 also arms a capture.",
     )
     p.add_argument(
+        "--telemetry-bind", default="127.0.0.1", metavar="HOST",
+        help="bind address for the --telemetry-port exporter (default "
+        "127.0.0.1). Non-loopback binds expose unauthenticated run "
+        "internals, so they are refused unless --distributed (where "
+        "the fleet aggregator scrapes peers over the network).",
+    )
+    p.add_argument(
         "--telemetry-sample-s", type=float, default=5.0, metavar="SECS",
         help="cadence of the telemetry resource sampler thread "
         "(resources.jsonl rows; default 5 s). Only meaningful with "
@@ -919,6 +926,12 @@ def main(argv=None) -> int:
         )
     if args.telemetry_sample_s <= 0:
         raise SystemExit("--telemetry-sample-s must be > 0")
+    from actor_critic_tpu.telemetry.exporter import validate_bind
+
+    try:
+        validate_bind(args.telemetry_bind, distributed=args.distributed)
+    except ValueError as e:
+        raise SystemExit(str(e))
 
     from actor_critic_tpu.config import (
         PRESETS, parse_env_set_args, parse_set_args, resolve,
@@ -1128,6 +1141,7 @@ def main(argv=None) -> int:
             },
             resource_interval_s=args.telemetry_sample_s,
             serve_port=args.telemetry_port,
+            serve_host=args.telemetry_bind,
         )
         telemetry.set_current(telemetry_session)
         if telemetry_session.exporter is not None:
