@@ -576,6 +576,7 @@ def train_host_async(
     data_plane: str = "host",
     plane_codec: str = "fp32",
     transfer_pad_s: float = 0.0,
+    publish_hook: Optional[Callable[[int, object], None]] = None,
 ):
     """DDPG/TD3 with decoupled actor services (ISSUE 9 satellite; the
     PPO-only restriction of `--async-actors` lifted): one exploration
@@ -603,6 +604,7 @@ def train_host_async(
         data_plane=data_plane, plane_codec=plane_codec,
         transfer_pad_s=transfer_pad_s,
         make_device_ingest_update=make_device_ingest_update,
+        publish_hook=publish_hook,
     )
 
 

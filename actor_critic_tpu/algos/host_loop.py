@@ -776,6 +776,7 @@ def off_policy_train_host_async(
     plane_codec: str = "fp32",
     transfer_pad_s: float = 0.0,
     make_device_ingest_update: Optional[Callable] = None,
+    publish_hook: Optional[Callable[[int, object], None]] = None,
 ):
     """Async actor–learner loop for the off-policy trainers (DDPG/TD3,
     SAC) — the ROADMAP item PR 6 left open: replay absorbs behavior-
@@ -931,9 +932,14 @@ def off_policy_train_host_async(
                 # jaxlint: disable=transfer-discipline (deliberate: the
                 # per-block behavior-params publish IS the async
                 # contract — concrete by the overlap argument above)
-                publisher.publish(
-                    jax.device_get(learner.actor_params), version=it
-                )
+                np_behavior = jax.device_get(learner.actor_params)
+                publisher.publish(np_behavior, version=it)
+                if publish_hook is not None:
+                    # Serve-while-training (ISSUE 17): same snapshot
+                    # cadence feeds the resident serving policy; the
+                    # publisher copies its own leaves, so the hook may
+                    # hand this tree to PolicyStore.swap.
+                    publish_hook(it, np_behavior)
                 staleness = max(it - block.version, 0)
                 env_steps = sum(a.steps_collected for a in actors)
                 if use_device_plane:

@@ -816,6 +816,7 @@ def train_host_async(
     data_plane: str = "host",
     plane_codec: str = "fp32",
     transfer_pad_s: float = 0.0,
+    publish_hook: Optional[Callable[[int, Any], None]] = None,
 ):
     """Async actor–learner PPO on host env pools (ISSUE 6 tentpole).
 
@@ -1037,7 +1038,14 @@ def train_host_async(
                 # jaxlint: disable=transfer-discipline (deliberate: the
                 # per-block behavior-params publish IS the async
                 # contract — concrete by the overlap argument above)
-                publisher.publish(jax.device_get(params), version=it)
+                np_behavior = jax.device_get(params)
+                publisher.publish(np_behavior, version=it)
+                if publish_hook is not None:
+                    # Serve-while-training (ISSUE 17): the same frozen-
+                    # snapshot cadence feeds the resident serving
+                    # policy. The publisher copies its own leaves, so
+                    # the hook may hand this tree to PolicyStore.swap.
+                    publish_hook(it, np_behavior)
                 staleness = max(it - block.version, 0)
                 kwargs = {}
                 if cfg.anneal_iters > 0:

@@ -717,6 +717,197 @@ def exercise_gateway(
 
 
 # ---------------------------------------------------------------------------
+# replica-fleet swap exerciser (ISSUE 17 leg b)
+# ---------------------------------------------------------------------------
+
+
+def exercise_replica_fleet(
+    seed: int,
+    versions: int = 8,
+    replicas: int = 3,
+) -> dict:
+    """One seeded replica-kill-mid-swap schedule over the horizontal
+    scale-out propagation path: a trainer rank publishes `(version,
+    params)` snapshots through the real file mailbox while N replica
+    gateways consume them through the REAL
+    `serving.fleet_proxy.MailboxPolicySyncer.poll_once` (sync thread
+    never started — the scheduler owns every interleave point) into N
+    real `PolicyStore`s. The controller injects the gateway exerciser's
+    fault menu (torn live file, stale replay, duplicate delivery) PLUS
+    a replica SIGKILL at a seeded point in the swap pipeline — possibly
+    between a publish and the victim's consume of it — and a later
+    restart with a cold store and a reset version clock (exactly what a
+    respawned serve.py process has).
+
+    Invariants, checked after EVERY scheduler action on the polled
+    replica: (1) the resident policy's params always self-verify
+    against the version they claim (`_encode` — a torn policy is never
+    served), (2) each replica's resident version never regresses within
+    one process lifetime (the syncer's per-publisher clock; a restart
+    legitimately resets it), (3) every replica — including the
+    killed-and-restarted one — converges to the final published
+    version within the bounded drain."""
+    from actor_critic_tpu.parallel.multihost import params_file, write_params
+    from actor_critic_tpu.serving.fleet_proxy import MailboxPolicySyncer
+    from actor_critic_tpu.serving.policy_store import PolicyStore
+
+    sched = ChaosScheduler(seed)
+    report = {
+        "seed": seed, "replicas": replicas, "swaps": 0, "published": 0,
+        "kills": 0, "faults": [], "violations": 0,
+    }
+    with tempfile.TemporaryDirectory(prefix="fleetsan_rf_") as mailbox:
+        template = _payload(0, 0)
+
+        def make_replica() -> dict:
+            store = PolicyStore()
+            store.register("default", _StubSwapEngine(), _payload(0, 0))
+            return {
+                "store": store,
+                "syncer": MailboxPolicySyncer(
+                    store, "default", mailbox, rank=0, template=template
+                ),
+                # Newest resident version THIS process lifetime: the
+                # monotonicity witness (reset by a legitimate restart).
+                "last": 0,
+            }
+
+        fleet = {i: make_replica() for i in range(replicas)}
+
+        def check(idx: int, rep: dict) -> None:
+            handle = rep["store"].get("default")
+            if handle.version < rep["last"]:
+                report["violations"] += 1
+                raise FleetSanError(
+                    f"seed {seed}: replica {idx} regressed from version "
+                    f"{rep['last']} to {handle.version} — a reordered/"
+                    "duplicate snapshot got past the syncer's version "
+                    "clock"
+                )
+            w = np.asarray(handle.params["w"])
+            if handle.version > 0 and (
+                not bool(np.all(w == w.flat[0]))
+                or float(w.flat[0]) != _encode(0, handle.version)
+            ):
+                report["violations"] += 1
+                raise FleetSanError(
+                    f"seed {seed}: replica {idx} serves version "
+                    f"{handle.version} with value {float(w.flat[0])!r}, "
+                    f"expected {_encode(0, handle.version)} — a torn "
+                    "policy reached the store"
+                )
+            rep["last"] = handle.version
+
+        def poll(idx: int) -> None:
+            rep = fleet.get(idx)
+            if rep is None:  # killed — nothing to poll
+                return
+            if rep["syncer"].poll_once():
+                report["swaps"] += 1
+            check(idx, rep)
+
+        def publisher():
+            for v in range(1, versions + 1):
+                write_params(mailbox, 0, v, _payload(0, v))
+                report["published"] = v
+                yield f"publish:{v}"
+
+        saved: dict[int, bytes] = {}
+
+        def chaos():
+            # Same seeded menu as exercise_gateway: save early, maybe
+            # tear the live file, replay + duplicate the stale save
+            # after the final publish.
+            for _ in range(versions * 4):
+                if report["published"] >= 2:
+                    break
+                yield "idle"
+            path = params_file(mailbox, 0)
+            with open(path, "rb") as f:
+                saved[0] = f.read()
+            report["faults"].append("save")
+            yield "save"
+            if sched.rng.random() < 0.5:
+                size = os.path.getsize(path)
+                with open(path, "r+b") as f:
+                    f.truncate(sched.rng.randrange(1, max(size, 2)))
+                report["faults"].append("torn")
+                yield "torn"
+            for _ in range(versions * 4):
+                if report["published"] >= versions:
+                    break
+                yield "idle"
+            tmp = f"{path}.tmp.reorder"
+            # jaxlint: disable=mailbox-protocol (reorder injector)
+            with open(tmp, "wb") as f:
+                f.write(saved[0])
+            # jaxlint: disable=mailbox-protocol (injector rename)
+            os.replace(tmp, path)
+            report["faults"].append("replay")
+            yield "replay"
+
+        def killer():
+            """SIGKILL one replica at a seeded point mid-schedule and
+            restart it a seeded number of rounds later: the restart is
+            a COLD process (fresh store at version 0, syncer clock
+            reset), so if the mailbox currently holds the chaos
+            injector's stale replay, the rejoiner legitimately swaps it
+            in — and must still converge to the newest version at
+            drain."""
+            victim = sched.rng.randrange(replicas)
+            for _ in range(sched.rng.randrange(1, versions * 2)):
+                yield "idle"
+            fleet.pop(victim, None)
+            report["kills"] += 1
+            report["faults"].append(f"kill:{victim}")
+            yield f"kill:{victim}"
+            for _ in range(sched.rng.randrange(1, versions)):
+                yield "idle"
+            fleet[victim] = make_replica()
+            report["faults"].append(f"restart:{victim}")
+            yield f"restart:{victim}"
+
+        gens: dict[str, Any] = {
+            "publisher": publisher(), "chaos": chaos(), "killer": killer(),
+        }
+        live = dict(gens)
+        while live:
+            name = sorted(live)[sched.rng.randrange(len(live))]
+            try:
+                tag = next(live[name])
+                sched.trace.append((0, name, tag))
+            except StopIteration:
+                del live[name]
+                continue
+            # ONE seeded replica polls per action — replica consumes
+            # genuinely interleave with publishes, faults, and kills.
+            idx = sched.rng.randrange(replicas)
+            poll(idx)
+            sched.trace.append((0, f"replica{idx}", "poll"))
+        # Drain: repair the (possibly stale/torn) final file the way
+        # the next training publish would, and poll every survivor
+        # until the whole fleet converges — bounded.
+        for _ in range(versions * 20):
+            if all(r["last"] >= versions for r in fleet.values()):
+                break
+            write_params(mailbox, 0, versions, _payload(0, versions))
+            for idx in sorted(fleet):
+                poll(idx)
+        laggards = {
+            i: r["last"] for i, r in fleet.items() if r["last"] < versions
+        }
+        if laggards:
+            raise FleetSanError(
+                f"seed {seed}: replicas never converged to version "
+                f"{versions}: {laggards} — the propagation path lost "
+                "the newest snapshot"
+            )
+    report["trace"] = list(sched.trace)
+    report["trace_len"] = len(sched.trace)
+    return report
+
+
+# ---------------------------------------------------------------------------
 # sweep + the tier-1 quick profile
 # ---------------------------------------------------------------------------
 

@@ -48,6 +48,13 @@ bytes / recompiles per steady-state block). The four programs:
     serving_dispatch    PolicyEngine.act on a warmed bucket, including
                         a mid-stream hot-swap (prepare_params →
                         checkpoint.uncommit) that must not recompile
+    serving_overlap     the same act budget measured through a RUNNING
+                        MicroBatcher with max_inflight=2 (ISSUE 17):
+                        flight workers dispatch, so the overlapped
+                        machinery must add NO device work per act
+    serving_proxy_hop   one FleetProxy relay to a stub-engine replica
+                        gateway: an ALL-ZERO budget — the proxy hop
+                        carries no device state at all
     mixture_fleet_step  the heterogeneous mixture fleet's fused scan
                         block — zero transfers, one dispatch per call
 
@@ -79,6 +86,8 @@ PROGRAMS = (
     "ppo_update_device",
     "offpolicy_ingest",
     "serving_dispatch",
+    "serving_overlap",
+    "serving_proxy_hop",
     "mixture_fleet_step",
 )
 
@@ -675,6 +684,123 @@ def exercise_serving_dispatch(
             "counters": worst, "per_act": per_act}
 
 
+def exercise_serving_overlap(acts: int = 4, seed: int = 0) -> dict:
+    """The overlapped-dispatch act path (ISSUE 17 leg c): the SAME
+    per-act budget as serving_dispatch, measured through a RUNNING
+    `MicroBatcher` with `max_inflight=2` — packing, the 1-deep flight
+    handoff, shed checks and SLO accounting are all pure host work, so
+    the overlap machinery must add zero device work per act.
+
+    Requests are serialized (one outstanding at a time), so each
+    measured window holds exactly one single-row flush — the counters
+    stay structural/deterministic. The dispatch runs on a FLIGHT
+    thread: `jax.transfer_guard` scopes are thread-local, so the
+    disallow guard is applied process-globally for the measured windows
+    (explicit put/get stay sanctioned; an implicit coercion on the
+    flight thread raises there and surfaces as the request's error)."""
+    import jax
+
+    from actor_critic_tpu.serving import engine as serving_engine
+    from actor_critic_tpu.serving.batcher import MicroBatcher
+    from actor_critic_tpu.serving.policy_store import PolicyStore
+
+    spec, cfg, _, _, _ = _ppo_fixture()
+    engine = serving_engine.PolicyEngine(
+        spec, cfg, algo="ppo", buckets=(1, 4), seed=seed
+    )
+    params = serving_engine.init_params(spec, cfg, "ppo", seed=seed)
+    store = PolicyStore()
+    store.register("default", engine, params, version=1)
+    engine.warm(store.get("default").params)
+    batcher = MicroBatcher(store, max_wait_us=200.0, max_inflight=2)
+
+    rng = np.random.default_rng(seed)
+    per_act = []
+    jax.config.update("jax_transfer_guard", "disallow")
+    try:
+        for _ in range(max(acts, 1)):
+            obs = rng.normal(size=(1, 4)).astype(np.float32)
+            with measure() as c:
+                req = batcher.submit(obs, "default")
+                if not req.done.wait(timeout=30.0):
+                    raise PerfSanError(
+                        "serving_overlap: flight dispatch never "
+                        "completed (overlap machinery wedged)"
+                    )
+                if req.error is not None:
+                    raise req.error
+            per_act.append(c)
+    finally:
+        jax.config.update("jax_transfer_guard", "allow")
+        batcher.close()
+    worst = worst_of(per_act)
+    return {"program": "serving_overlap", "acts": len(per_act),
+            "counters": worst, "per_act": per_act}
+
+
+def exercise_serving_proxy_hop(relays: int = 4, seed: int = 0) -> dict:
+    """One FleetProxy relay to a single stub-engine replica gateway,
+    over real HTTP on loopback: the budget is ALL-ZERO — the fronting
+    proxy carries no device state, so a dispatch, transfer, or
+    recompile showing up in a relay window means device work leaked
+    into the scale-out hop (the whole point of fronting with a dumb
+    relay instead of a second engine)."""
+    import http.client
+    import json as _json
+
+    from actor_critic_tpu.serving.fleet_proxy import FleetProxy
+    from actor_critic_tpu.serving.gateway import ServeGateway
+    from actor_critic_tpu.serving.policy_store import PolicyStore
+
+    class _StubEngine:
+        max_rows = 8
+
+        def prepare_params(self, params):
+            return params
+
+        def act(self, params, obs):
+            return np.asarray(obs)[:, 0]
+
+    store = PolicyStore()
+    store.register("default", _StubEngine(), {"w": np.ones((1,), np.float32)})
+    gateway = ServeGateway(store, port=0)
+    proxy = FleetProxy([gateway.url], port=0, probe=False)
+    rng = np.random.default_rng(seed)
+    per_relay = []
+    try:
+        conn = http.client.HTTPConnection(proxy.host, proxy.port, timeout=10)
+        body0 = _json.dumps(
+            {"obs": rng.normal(size=(1, 4)).astype(np.float32).tolist()}
+        )
+        # Unmetered warm relay: first contact pays connection setup on
+        # both hops; steady-state is what the budget prices.
+        conn.request("POST", "/v1/act", body0,
+                     {"Content-Type": "application/json"})
+        conn.getresponse().read()
+        for _ in range(max(relays, 1)):
+            body = _json.dumps(
+                {"obs": rng.normal(size=(1, 4)).astype(np.float32).tolist()}
+            )
+            with measure() as c:
+                conn.request("POST", "/v1/act", body,
+                             {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                payload = _json.loads(resp.read())
+            if resp.status != 200:
+                raise PerfSanError(
+                    f"serving_proxy_hop: relay answered {resp.status}: "
+                    f"{payload}"
+                )
+            per_relay.append(c)
+        conn.close()
+    finally:
+        proxy.close()
+        gateway.close()
+    worst = worst_of(per_relay)
+    return {"program": "serving_proxy_hop", "relays": len(per_relay),
+            "counters": worst, "per_relay": per_relay}
+
+
 def exercise_mixture_fleet_step(
     calls: int = 3, seed: int = 0, iters_per_call: int = 4
 ) -> dict:
@@ -727,6 +853,8 @@ _EXERCISERS = {
     "ppo_update_device": exercise_ppo_update_device,
     "offpolicy_ingest": exercise_offpolicy_ingest,
     "serving_dispatch": exercise_serving_dispatch,
+    "serving_overlap": exercise_serving_overlap,
+    "serving_proxy_hop": exercise_serving_proxy_hop,
     "mixture_fleet_step": exercise_mixture_fleet_step,
 }
 

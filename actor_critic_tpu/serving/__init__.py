@@ -12,8 +12,13 @@ covers `serving/` and validates against it.
 from actor_critic_tpu.serving.batcher import (
     DispatcherDown,
     MicroBatcher,
+    Overloaded,
     QueueFull,
     ServingMetrics,
+)
+from actor_critic_tpu.serving.fleet_proxy import (
+    FleetProxy,
+    MailboxPolicySyncer,
 )
 from actor_critic_tpu.serving.engine import (
     DEFAULT_BUCKETS,
@@ -34,7 +39,10 @@ from actor_critic_tpu.serving.policy_store import (
 __all__ = [
     "DEFAULT_BUCKETS",
     "DispatcherDown",
+    "FleetProxy",
+    "MailboxPolicySyncer",
     "MicroBatcher",
+    "Overloaded",
     "PolicyEngine",
     "PolicyHandle",
     "PolicyStore",

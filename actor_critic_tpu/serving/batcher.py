@@ -15,11 +15,25 @@ Threading model (the jaxlint concurrency passes sweep this module):
   poll/block on the request's own `done` event;
 - the single `serve-dispatcher` thread: drains `_pending` under `_cv`,
   dispatches OUTSIDE the lock (an XLA dispatch must not block
-  enqueues), completes requests, and is the only writer of the
-  flush-progress fields;
+  enqueues), completes requests — or, with `max_inflight > 1`
+  (ISSUE 17), hands each packed flush to one of `max_inflight`
+  `serve-flight-*` worker threads through a 1-deep handoff queue, so
+  flush N+1 PACKS while flush N is on device (the continuous-batching
+  overlap; the handoff bound keeps at most `max_inflight` dispatches
+  in flight plus one packed and waiting);
 - metrics threads (sampler/exporter scrapes): read through
   `ServingMetrics.snapshot()` / `health()`, which lock or read
   GIL-atomic snapshots only.
+
+Admission control (ISSUE 17): alongside the queue-capacity reject
+(`QueueFull`), a burn-rate-aware shed path — when the queue is
+saturated past `shed_queue_frac` of its capacity AND the target
+policy's SLO burn rate is at/over `shed_burn_threshold`, `submit`
+raises `Overloaded` (503) instead of queueing a request that would
+blow its SLO anyway. Only SLO-classed policies shed at admission
+(there is no budget to protect otherwise); sheds count on the
+`record_shed` counter, rejects on `record_reject` — the two 503
+flavors stay distinguishable downstream.
 
 Requests are COPIED at submit (`np.array`) so the batcher owns every
 payload: a client reusing its obs buffer after submit() must not be
@@ -37,7 +51,9 @@ budget holds with tracing on).
 
 from __future__ import annotations
 
+import itertools
 import math
+import queue as _queue
 import threading
 import time
 from collections import deque
@@ -59,6 +75,14 @@ class QueueFull(RuntimeError):
 
 class DispatcherDown(RuntimeError):
     """The dispatcher thread is not running (gateway: HTTP 503)."""
+
+
+class Overloaded(RuntimeError):
+    """Shed at admission (gateway: HTTP 503): the queue is saturated
+    and the target policy is already burning its SLO error budget, so
+    queueing would only manufacture another violation. Distinct from
+    `QueueFull` — the queue still has room; the POLICY has no latency
+    budget left (counted on the shed counter, not the reject one)."""
 
 
 def _percentile(sorted_vals: list, p: float) -> float:
@@ -171,6 +195,18 @@ class ServingMetrics:
     def record_errors(self, n: int) -> None:
         with self._lock:
             self._errors += n
+
+    def burn_rate(self, policy_id: str) -> Optional[float]:
+        """Current SLO burn rate of one policy (violation fraction of
+        the burn window over the error budget), or None when the policy
+        has no SLO class / no window yet — the admission controller's
+        shed signal, read per-submit so it must stay a cheap lock +
+        window sum."""
+        with self._lock:
+            window = self._slo_window.get(policy_id)
+            if not window:
+                return None
+            return (sum(window) / len(window)) / SLO_ERROR_BUDGET
 
     def snapshot(self) -> dict:
         """Flat numeric dict for the sampler gauge registry (the
@@ -285,26 +321,54 @@ class MicroBatcher:
         queue_limit: int = 256,
         metrics: Optional[ServingMetrics] = None,
         start: bool = True,
+        max_inflight: int = 1,
+        shed_burn_threshold: Optional[float] = None,
+        shed_queue_frac: float = 0.5,
     ):
         if queue_limit < 1:
             raise ValueError(f"queue_limit must be >= 1, got {queue_limit}")
+        if max_inflight < 1:
+            raise ValueError(
+                f"max_inflight must be >= 1, got {max_inflight}"
+            )
+        if not (0.0 < shed_queue_frac <= 1.0):
+            raise ValueError(
+                f"shed_queue_frac must be in (0, 1], got {shed_queue_frac}"
+            )
         self._store = store
         self.max_wait_s = float(max_wait_us) / 1e6
         self._max_batch_rows = max_batch_rows
         self.queue_limit = int(queue_limit)
         self.metrics = metrics or ServingMetrics()
+        # Overlapped dispatch (ISSUE 17): >1 turns on the flight-worker
+        # pool; 1 keeps the classic single-thread pack+dispatch loop
+        # (and the racesan/sequential-baseline drive paths) unchanged.
+        self.max_inflight = int(max_inflight)
+        # Admission control: None disables the shed path entirely.
+        self.shed_burn_threshold = (
+            None if shed_burn_threshold is None else float(shed_burn_threshold)
+        )
+        self._shed_depth = max(1, int(self.queue_limit * shed_queue_frac))
         self._cv = threading.Condition()
         # Guarded by _cv: the request queue and the closed flag.
         self._pending: deque = deque()
         self._closed = False
-        # jaxlint: thread-owned=dispatcher (single writer: only the
-        # dispatcher thread stamps flush progress; health() reads the
-        # plain float GIL-atomically and tolerates one-flush staleness)
+        # jaxlint: thread-owned=dispatcher (single writer in the classic
+        # mode; in overlap mode flight workers also stamp it — a plain
+        # float rebind, GIL-atomic, and health() tolerates one-flush
+        # staleness either way)
         self._last_flush_t = time.monotonic()
-        # jaxlint: thread-owned=dispatcher (flush sequence number the
-        # trace emission labels serve_dispatch/serve_queue_wait spans
-        # with; only the dispatcher increments it)
-        self._flush_seq = 0
+        # Flush sequence numbers for trace labels: itertools.count is
+        # GIL-atomic, so concurrent flight workers each draw a unique
+        # seq without a lock (the classic mode draws from the same
+        # counter — one writer, same numbers as the old int += 1).
+        self._flush_counter = itertools.count(1)
+        self._flush_seq = 0  # latest drawn seq, for introspection only
+        # Overlap-mode plumbing (built in start() when max_inflight>1):
+        # a 1-deep handoff queue and the flight worker pool.
+        self._handoff: Optional[_queue.Queue] = None
+        self._flights: list[threading.Thread] = []
+        self._flight_error: Optional[BaseException] = None
         # Span-emission target override: the owning gateway points this
         # at its _trace_session so dispatcher-side hops land in the same
         # session as the gateway-thread hops even when that session is
@@ -316,6 +380,21 @@ class MicroBatcher:
             self.start()
 
     def start(self) -> "MicroBatcher":
+        if self.max_inflight > 1:
+            # 1-deep handoff: the dispatcher can pack ONE flush ahead
+            # of the busy flights — exactly "dispatch N+1 packs while N
+            # is on device", never an unbounded staging buffer that
+            # would swallow the whole request queue into flights.
+            self._handoff = _queue.Queue(maxsize=1)
+            self._flights = [
+                threading.Thread(
+                    target=self._flight_run, name=f"serve-flight-{i}",
+                    daemon=True,
+                )
+                for i in range(self.max_inflight)
+            ]
+            for t in self._flights:
+                t.start()
         self._thread = threading.Thread(
             target=self._run, name="serve-dispatcher", daemon=True
         )
@@ -357,6 +436,23 @@ class MicroBatcher:
                 raise QueueFull(
                     f"request queue at capacity ({self.queue_limit})"
                 )
+            # Shed-vs-queue (module docstring): under saturation, an
+            # SLO-classed policy already eating its error budget fails
+            # fast instead of queueing another violation-to-be. The
+            # _cv -> metrics-lock nesting matches record_reject above.
+            if (
+                self.shed_burn_threshold is not None
+                and getattr(handle, "slo_ms", None) is not None
+                and len(self._pending) >= self._shed_depth
+            ):
+                burn = self.metrics.burn_rate(handle.policy_id)
+                if burn is not None and burn >= self.shed_burn_threshold:
+                    self.metrics.record_shed()
+                    raise Overloaded(
+                        f"shedding {handle.policy_id!r}: queue depth "
+                        f"{len(self._pending)}/{self.queue_limit} and SLO "
+                        f"burn {burn:.2f} >= {self.shed_burn_threshold}"
+                    )
             self._pending.append(req)
             self._cv.notify_all()
         return req
@@ -384,33 +480,79 @@ class MicroBatcher:
         return limit
 
     def _run(self) -> None:
-        while self._flush_once(block=True):
-            pass
+        if self._handoff is None:
+            while self._flush_once(block=True):
+                pass
+            return
+        # Overlap mode: THIS thread only packs — the single packer
+        # keeps the grouping/ordering invariants of the classic loop —
+        # and the flight pool dispatches. put() blocks once the pool is
+        # saturated and one flush is staged, which is the backpressure
+        # that stops the packer from inhaling the whole request queue.
+        while True:
+            packed = self._collect_once(block=True)
+            if packed is not None:
+                self._handoff.put(packed)
+            with self._cv:
+                if self._closed and not self._pending:
+                    break
+        for _ in self._flights:
+            self._handoff.put(None)  # flight shutdown sentinels
+
+    def _flight_run(self) -> None:
+        try:
+            while True:
+                packed = self._handoff.get()
+                if packed is None:
+                    return
+                self._dispatch_batch(*packed)
+        except BaseException as e:  # surfaced through health()
+            self._flight_error = e
 
     def _flush_once(self, block: bool = True) -> bool:
-        """Collect one micro-batch and dispatch it. Returns False once
-        the batcher is closed AND drained (the dispatcher loop's exit),
-        True otherwise — including empty non-blocking polls."""
+        """Collect one micro-batch and dispatch it inline (the classic
+        single-thread loop; racesan drives this entry directly).
+        Returns False once the batcher is closed AND drained (the
+        dispatcher loop's exit), True otherwise — including empty
+        non-blocking polls."""
+        packed = self._collect_once(block=block)
+        if packed is None:
+            with self._cv:
+                return not self._closed
+        self._dispatch_batch(*packed)
+        return True
+
+    def _collect_once(self, block: bool = True):
+        """Pack one micro-batch: `(batch, rows, limit, policy_id)`, or
+        None when there is nothing to pack. Called only from the
+        dispatcher thread (or racesan's scheduler via _flush_once) —
+        the single packer is what lets `first` below survive the lock
+        gap."""
         with self._cv:
             if block:
                 while not self._pending and not self._closed:
                     self._cv.wait(0.05)
             if not self._pending:
-                return not self._closed
+                return None
             first = self._pending[0]
             policy_id = first.policy_id
         # Resolve the route OUTSIDE the queue lock: store.get takes the
         # store's lock, and nesting it under _cv would couple the
         # enqueue path to swap()'s critical section (racesan's batcher
-        # exerciser deadlocks on exactly that nesting). Only this
-        # thread pops, so `first` cannot vanish in between.
-        limit = self._row_limit(self._store.get(policy_id))
+        # exerciser deadlocks on exactly that nesting). Only the packer
+        # pops, so `first` cannot vanish in between.
+        route = self._store.get(policy_id)
+        limit = self._row_limit(route)
+        # Per-policy window (ISSUE 17 SLO classes): the handle's
+        # max_wait_us overrides the batcher's global one.
+        wait_us = getattr(route, "max_wait_us", None)
+        wait_s = self.max_wait_s if wait_us is None else float(wait_us) / 1e6
         with self._cv:
             if block:
                 # GA3C window: hold the flush up to max_wait past the
-                # FIRST request's enqueue while same-policy rows
+                # FIRST request's enqueue while more same-policy rows
                 # accumulate toward the row budget.
-                deadline = first.t_enq + self.max_wait_s
+                deadline = first.t_enq + wait_s
                 while not self._closed:
                     rows = sum(
                         r.rows for r in self._pending
@@ -435,6 +577,18 @@ class MicroBatcher:
                 else:
                     rest.append(r)
             self._pending.extend(rest)
+        return batch, rows, limit, policy_id
+
+    def _dispatch_batch(
+        self, batch: list, rows: int, limit: int, policy_id: str
+    ) -> None:
+        """Dispatch one packed micro-batch and complete its requests.
+        Classic mode runs this on the dispatcher thread; overlap mode
+        on a flight worker — everything here is either request-local,
+        lock-guarded (metrics), or GIL-atomic (the flush counter, the
+        last-flush stamp), and engine.act is safe to run concurrently
+        across flights (jit dispatch is thread-safe; the sample-mode
+        key counter is itertools.count)."""
         t_disp_pc = time.perf_counter()
         try:
             # Re-resolve the handle at flush time: a hot-swap that
@@ -471,17 +625,17 @@ class MicroBatcher:
                 occupancy=occupancy,
                 slo_ms=getattr(handle, "slo_ms", None),
             )
-            self._flush_seq += 1
+            seq = next(self._flush_counter)
+            self._flush_seq = seq
             self._emit_flush_trace(
                 batch, handle, rows, occupancy, t_disp_pc,
-                time.perf_counter(),
+                time.perf_counter(), seq,
             )
         self._last_flush_t = time.monotonic()
-        return True
 
     def _emit_flush_trace(
         self, batch, handle, rows: int, occupancy: float,
-        t_disp_pc: float, t_done_pc: float,
+        t_disp_pc: float, t_done_pc: float, seq: int,
     ) -> None:
         """Dispatcher-side hops of every traced request in one flush:
         a `serve_dispatch` span over the engine act, one
@@ -503,7 +657,7 @@ class MicroBatcher:
             {
                 "policy": handle.policy_id, "version": handle.version,
                 "rows": rows, "requests": len(batch),
-                "occupancy": round(occupancy, 4), "flush": self._flush_seq,
+                "occupancy": round(occupancy, 4), "flush": seq,
             },
         )
         for r in batch:
@@ -512,7 +666,7 @@ class MicroBatcher:
             tracer.complete(
                 "serve_queue_wait", r.t_enq_pc,
                 max(t_disp_pc - r.t_enq_pc, 0.0),
-                {"trace": r.trace_id, "flush": self._flush_seq,
+                {"trace": r.trace_id, "flush": seq,
                  "policy": r.policy_id},
             )
             # Flow step stamped INSIDE the dispatch span so the arrow
@@ -530,8 +684,12 @@ class MicroBatcher:
 
     def health(self) -> dict:
         """Dispatcher liveness for /healthz: alive flag, queue depth,
-        seconds since the last completed flush."""
+        seconds since the last completed flush. In overlap mode a dead
+        flight worker also reads as not-alive — a silently shrinking
+        pool would otherwise serve at degraded depth forever."""
         alive = self._thread is not None and self._thread.is_alive()
+        if self._flight_error is not None:
+            alive = False
         with self._cv:
             depth = len(self._pending)
             closed = self._closed
@@ -541,6 +699,7 @@ class MicroBatcher:
             "last_flush_age_s": round(
                 time.monotonic() - self._last_flush_t, 3
             ),
+            "max_inflight": self.max_inflight,
         }
 
     def gauge(self) -> dict:
@@ -563,6 +722,11 @@ class MicroBatcher:
             self._cv.notify_all()
         if self._thread is not None:
             self._thread.join(timeout)
+        # Flights exit on the sentinels the dispatcher sends after its
+        # own drain — join AFTER the dispatcher so a drain in progress
+        # finishes instead of stranding packed flushes.
+        for t in self._flights:
+            t.join(timeout)
         with self._cv:
             stranded = list(self._pending)
             self._pending.clear()

@@ -126,10 +126,19 @@ class PolicyEngine:
     architecture share one engine — and therefore one set of compiled
     programs; hot-swapping params never changes the program.
 
-    `act` is called from the micro-batcher's single dispatcher thread
-    only (the sample-mode flush counter below is unsynchronized by that
-    contract); construction/warmup happen on the owning thread before
-    the dispatcher starts.
+    `act` may be called concurrently from the micro-batcher's flight
+    workers (overlapped dispatch, ISSUE 17): jit dispatch is
+    thread-safe, the mirror closes over frozen numpy, and the
+    sample-mode flush counter is `itertools.count` (GIL-atomic) — no
+    other engine state is written after construction/warmup, which
+    happen on the owning thread before any dispatcher starts.
+
+    `backend="auto"` (ISSUE 17) defers the XLA-vs-mirror choice to
+    `resolve_backend(params)`: batch-1 dispatch walls of both paths
+    are measured against concrete params and the faster one is fixed —
+    batch-1 is the decisive shape because it is where the jit
+    dispatch envelope dominates an MLP forward (the same trade the
+    training loops make per-architecture, now measured per-host).
     """
 
     def __init__(
@@ -146,16 +155,22 @@ class PolicyEngine:
         buckets = tuple(sorted({int(b) for b in buckets}))
         if not buckets or buckets[0] < 1:
             raise ValueError(f"buckets must be positive ints, got {buckets!r}")
-        if backend not in ("xla", "mirror"):
+        if backend not in ("xla", "mirror", "auto"):
             raise ValueError(
-                f"backend must be 'xla' or 'mirror', got {backend!r}"
+                f"backend must be 'xla', 'mirror' or 'auto', got {backend!r}"
             )
         self.spec = spec
         self.cfg = cfg
         self.algo = algo
         self.sample = bool(sample)
         self.buckets = buckets
+        if backend == "auto" and self.sample:
+            # Mirror serves greedy only, so there is nothing to choose.
+            backend = "xla"
         self.backend = backend
+        # resolve_backend's measurement record ({'backend', 'xla_ms',
+        # 'mirror_ms'}); None until (unless) an auto choice runs.
+        self.auto_choice: Optional[dict] = None
         if backend == "mirror":
             # CPU-only serving hosts: the numpy greedy mirror
             # (models/host_actor) beats a batch-1 XLA dispatch on
@@ -186,9 +201,10 @@ class PolicyEngine:
         self.dispatch_pad_s = float(dispatch_pad_s)
         self._seed = int(seed)
         self._base_key = None  # lazy: jax.random.key allocates on-device
-        # jaxlint: thread-owned=dispatcher (single writer: only the
-        # micro-batcher's dispatcher thread calls act(); the counter
-        # exists to give each sampled flush a fresh fold_in key)
+        # jaxlint: thread-owned=dispatcher (itertools.count — next() is
+        # GIL-atomic, so concurrent flight workers each draw a unique
+        # flush key; the counter exists to give each sampled flush a
+        # fresh fold_in key)
         self._flush_counter = itertools.count()
 
     @property
@@ -205,6 +221,11 @@ class PolicyEngine:
         — are placed on device by the same path). Mirror backend: a
         frozen numpy snapshot (PolicyPublisher's contract) after a
         `supports_mirror` structure check."""
+        if self.backend == "auto":
+            raise RuntimeError(
+                "backend='auto' is unresolved — call "
+                "resolve_backend(params) before installing policies"
+            )
         if self.backend == "mirror":
             import jax
 
@@ -225,6 +246,70 @@ class PolicyEngine:
         from actor_critic_tpu.utils import checkpoint
 
         return checkpoint.uncommit(params)
+
+    def resolve_backend(self, params, trials: int = 7) -> str:
+        """Fix `backend='auto'` from measured batch-1 dispatch walls:
+        time `trials` single-row acts through the compiled XLA bucket-1
+        program and through the numpy greedy mirror (min-of-trials —
+        the envelope floor, robust to scheduler noise), pick the
+        faster, and record both walls on `self.auto_choice`. Params
+        whose structure the mirror cannot serve (conv torsos) resolve
+        to XLA without measuring. The bucket-1 compile happens OUTSIDE
+        the timed region, so the choice compares steady-state
+        dispatch, not compilation. Idempotent no-op on an already
+        concrete backend; the testbed `dispatch_pad_s` is excluded
+        (it pads both paths identically in act())."""
+        if self.backend != "auto":
+            return self.backend
+        import time as _time
+
+        import jax
+
+        from actor_critic_tpu.models import host_actor
+
+        obs = np.zeros(
+            (1, *self.spec.obs_shape), np.dtype(self.spec.obs_dtype)
+        )
+        np_params = jax.tree.map(np.array, jax.device_get(params))
+        if not host_actor.supports_mirror(np_params):
+            self.backend = "xla"
+            self.auto_choice = {"backend": "xla", "reason": "no mirror"}
+            return self.backend
+        for leaf in jax.tree.leaves(np_params):
+            leaf.flags.writeable = False
+        mirror = host_actor.greedy_mirror_for(self.spec, self.cfg, self.algo)
+        from actor_critic_tpu.utils import checkpoint
+
+        xla_params = checkpoint.uncommit(params)
+        padded, _ = compile_cache.pad_to_bucket(obs, self.buckets)
+
+        def xla_once():
+            out = self._program(xla_params, jax.device_put(padded))
+            return jax.device_get(out)
+
+        xla_once()  # bucket-1 compile + dispatch-cache warm, untimed
+
+        def wall(fn) -> float:
+            best = float("inf")
+            for _ in range(max(1, int(trials))):
+                t0 = _time.perf_counter()
+                fn()
+                best = min(best, _time.perf_counter() - t0)
+            return best
+
+        xla_ms = wall(xla_once) * 1e3
+        mirror_ms = wall(lambda: mirror(np_params, obs)) * 1e3
+        if mirror_ms < xla_ms:
+            self.backend = "mirror"
+            self._mirror = mirror
+        else:
+            self.backend = "xla"
+        self.auto_choice = {
+            "backend": self.backend,
+            "xla_ms": round(xla_ms, 4),
+            "mirror_ms": round(mirror_ms, 4),
+        }
+        return self.backend
 
     def _key_for_flush(self):
         import jax

@@ -48,6 +48,7 @@ import numpy as np
 from actor_critic_tpu.serving.batcher import (
     DispatcherDown,
     MicroBatcher,
+    Overloaded,
     QueueFull,
 )
 from actor_critic_tpu.serving.policy_store import PolicyStore, UnknownPolicy
@@ -274,6 +275,9 @@ class ServeGateway:
         threaded: bool = True,
         fleet=None,
         aggregator=None,
+        max_inflight: int = 1,
+        shed_burn_threshold: Optional[float] = None,
+        shed_queue_frac: float = 0.5,
     ):
         self.store = store
         self.session = session
@@ -304,6 +308,9 @@ class ServeGateway:
             max_wait_us=max_wait_us,
             max_batch_rows=max_batch_rows,
             queue_limit=queue_limit,
+            max_inflight=max_inflight,
+            shed_burn_threshold=shed_burn_threshold,
+            shed_queue_frac=shed_queue_frac,
         )
         # Dispatcher-side hops (serve_dispatch/serve_queue_wait) must
         # land in the SAME session as the gateway-thread hops, including
@@ -431,6 +438,8 @@ class ServeGateway:
             return 400, {"error": str(e)}
         except QueueFull as e:  # submit() already counted the reject
             return 503, {"error": str(e)}
+        except Overloaded as e:  # submit() already counted the shed
+            return 503, {"error": str(e), "shed": True}
         except DispatcherDown as e:
             self.batcher.metrics.record_shed()
             return 503, {"error": str(e)}

@@ -45,6 +45,12 @@ class PolicyHandle:
     # counted). Rides the handle so a hot-swap keeps the class and a
     # flush reads it with zero extra lookups.
     slo_ms: Optional[float] = None
+    # Per-policy micro-batch window override (microseconds, ISSUE 17):
+    # the OTHER half of the SLO class — a latency-tier policy trades
+    # occupancy for a shorter hold window, a batch-tier one the
+    # reverse. None = the batcher's global max_wait_us. Rides the
+    # handle like slo_ms: a hot-swap keeps the class.
+    max_wait_us: Optional[float] = None
 
 
 class PolicyStore:
@@ -64,15 +70,19 @@ class PolicyStore:
         default: bool = False,
         prepare: bool = True,
         slo_ms: Optional[float] = None,
+        max_wait_us: Optional[float] = None,
     ) -> PolicyHandle:
         """Install a new resident policy. The FIRST registration becomes
         the default route unless a later one claims `default=True`.
         `slo_ms` assigns the policy's SLO latency class (serve.py
-        --slo-ms; None = unclassed)."""
+        --slo-ms; None = unclassed); `max_wait_us` overrides the
+        batcher's global micro-batch window for this policy's flushes
+        (serve.py --max-wait-us ID=US)."""
         prepared = engine.prepare_params(params) if prepare else params
         handle = PolicyHandle(
             str(policy_id), int(version), prepared, engine,
             slo_ms=None if slo_ms is None else float(slo_ms),
+            max_wait_us=None if max_wait_us is None else float(max_wait_us),
         )
         with self._lock:
             if handle.policy_id in self._handles:
@@ -113,11 +123,11 @@ class PolicyStore:
             # the latest install, not this caller's possibly-stale read.
             cur = self._handles[old.policy_id]
             new_version = cur.version + 1 if version is None else int(version)
-            # The SLO class survives the swap: it classifies the route,
-            # not the checkpoint riding it.
+            # The SLO class (target AND window) survives the swap: it
+            # classifies the route, not the checkpoint riding it.
             handle = PolicyHandle(
                 cur.policy_id, new_version, prepared, cur.engine,
-                slo_ms=cur.slo_ms,
+                slo_ms=cur.slo_ms, max_wait_us=cur.max_wait_us,
             )
             self._handles[cur.policy_id] = handle
         return handle

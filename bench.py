@@ -162,6 +162,7 @@ def _error_line(msg: str, root: str | None = None) -> str:
 # tunnel no longer means an evidence-free round — host_pool_scaling,
 # startup_to_first_step, async_decoupling, update_wall,
 # replay_sample_throughput, multihost_scaling, serving_latency,
+# serving_fleet_scaling (N gateway replicas behind the fleet proxy),
 # scenario_fleet (heterogeneous mixture + the steps/s-vs-instance-count
 # sweep) and consumed_env_steps_per_s (host vs device data plane) are
 # measured on the CPU backend regardless. BENCH_CPU_METRICS overrides the set (comma
@@ -174,7 +175,7 @@ def _error_line(msg: str, root: str | None = None) -> str:
 DEFAULT_CPU_METRICS = (
     "host_pool_scaling,startup_to_first_step,async_decoupling,update_wall,"
     "replay_sample_throughput,multihost_scaling,serving_latency,"
-    "scenario_fleet,consumed_env_steps_per_s"
+    "serving_fleet_scaling,scenario_fleet,consumed_env_steps_per_s"
 )
 
 

@@ -1202,6 +1202,117 @@ def bench_serving_latency():
     }
 
 
+def bench_serving_fleet_scaling():
+    """Horizontal serving scale-out curve (ISSUE 17 acceptance row):
+    fleet actions/s at N gateway replicas behind the `FleetProxy`
+    fronting hop, same closed-loop client fleet, fixed concurrency.
+
+    Each replica owns its engine + dispatcher (exactly the process
+    shape of N `scripts/serve.py` instances; in-process here so one
+    bench subprocess hosts the whole fleet), every dispatch padded with
+    a 10 ms wall sleep modeling the host<->accelerator round trip
+    (`serving_latency`'s testbed: the pad releases the GIL, so replica
+    dispatchers genuinely overlap — what real tunnel round trips do).
+    Buckets cap at 8 rows so a single replica saturates at
+    ~max_rows/pad actions/s and the curve measures DISPATCHER
+    parallelism, not packing headroom. The headline value is the
+    3-replica / 1-replica actions/s ratio (target >= 1.6x); per-point
+    rows carry p50/p99, proxy relay stats, and the loadgen errors."""
+    import subprocess
+
+    from actor_critic_tpu import serving
+    from actor_critic_tpu.algos import ppo
+    from actor_critic_tpu.envs import make_cartpole
+
+    scripts_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts",
+    )
+    loadgen = os.path.join(scripts_dir, "serve_loadgen.py")
+    pad_ms, concurrency, duration_s = 10.0, 32, 6.0
+    buckets = (1, 2, 4, 8)
+    replica_counts = (1, 2, 3)
+    spec = make_cartpole().spec
+    cfg = ppo.PPOConfig(hidden=(64, 64))
+    params = serving.init_params(spec, cfg, "ppo", seed=0)
+
+    def fleet_point(replicas: int) -> dict:
+        gateways = []
+        proxy = None
+        try:
+            for _ in range(replicas):
+                engine = serving.PolicyEngine(
+                    spec, cfg, algo="ppo", buckets=buckets,
+                    dispatch_pad_s=pad_ms / 1e3,
+                )
+                engine.warm(engine.prepare_params(params))
+                store = serving.PolicyStore()
+                store.register("default", engine, params, slo_ms=100.0)
+                gateways.append(
+                    serving.ServeGateway(store, port=0, max_wait_us=2000.0)
+                )
+            proxy = serving.FleetProxy(
+                [gw.url for gw in gateways], port=0, probe=False
+            )
+            out = subprocess.run(
+                [sys.executable, loadgen, "--url", proxy.url,
+                 "--concurrency", str(concurrency),
+                 "--duration", str(duration_s),
+                 "--obs-dim", str(spec.obs_shape[0]),
+                 "--json", "--timeout", "60"],
+                capture_output=True, text=True, timeout=180,
+            )
+            if not out.stdout.strip():
+                raise RuntimeError(
+                    f"loadgen produced no report (rc {out.returncode}): "
+                    + (out.stderr or "").strip()[-500:]
+                )
+            rec = json.loads(out.stdout.strip().splitlines()[-1])
+            stats = proxy.stats()
+            return {
+                "replicas": replicas,
+                "actions_per_s": rec["actions_per_s"],
+                "p50_ms": rec["p50_ms"],
+                "p99_ms": rec["p99_ms"],
+                "requests": rec["requests"],
+                "errors": rec["errors"],
+                "proxy_relayed": stats["relayed"],
+                "proxy_failovers": stats["failovers"],
+                "replica_forwards": [
+                    r["forwards"] for r in stats["replicas"]
+                ],
+            }
+        finally:
+            if proxy is not None:
+                proxy.close()
+            for gw in gateways:
+                gw.close()
+
+    points = [fleet_point(r) for r in replica_counts]
+    by_r = {p["replicas"]: p for p in points}
+    scaling = round(
+        by_r[3]["actions_per_s"] / max(by_r[1]["actions_per_s"], 1e-9), 2
+    )
+    return {
+        "metric": "serving_fleet_scaling",
+        "value": scaling,
+        "unit": "x actions/s, 3 replicas vs 1 behind the fleet proxy "
+                f"({pad_ms:.0f} ms tunnel-padded dispatch, closed-loop "
+                f"concurrency {concurrency})",
+        "points": points,
+        "config": {
+            "dispatch_pad_ms": pad_ms,
+            "concurrency": concurrency,
+            "duration_s": duration_s,
+            "buckets": list(buckets),
+            "replica_counts": list(replica_counts),
+            "max_wait_us": 2000.0,
+            "hidden": [64, 64],
+            "proxy_policy": "least_loaded",
+        },
+    }
+
+
 BENCHES = {
     "a2c": bench_a2c,
     "ppo": bench_ppo,
@@ -1216,6 +1327,7 @@ BENCHES = {
     "replay_sample_throughput": bench_replay_sample_throughput,
     "multihost_scaling": bench_multihost_scaling,
     "serving_latency": bench_serving_latency,
+    "serving_fleet_scaling": bench_serving_fleet_scaling,
     "scenario_fleet": bench_scenario_fleet,
     "mujoco": bench_mujoco_host,
     "pallas": bench_pallas_ops,

@@ -200,6 +200,37 @@ def serving_cell(rec: dict | None, field: str) -> str:
     return _numeric_cell(sub.get(field))
 
 
+def fleet_replica_counts(recs: list[dict | None]) -> list[int]:
+    """Union of fleet-curve replica counts across rounds (the ISSUE 17
+    record nests per-count runs under `points`, keyed by `replicas`)."""
+    counts: set[int] = set()
+    for rec in recs:
+        entry, _ = _metric_entry(rec, "serving_fleet_scaling")
+        points = entry.get("points") if entry else None
+        if isinstance(points, list):
+            for p in points:
+                if isinstance(p, dict) and isinstance(
+                    p.get("replicas"), int
+                ):
+                    counts.add(p["replicas"])
+    return sorted(counts)
+
+
+def fleet_point_cell(rec: dict | None, n: int, field: str) -> str:
+    """One field of the n-replica fleet point (ISSUE 17: actions/s and
+    p99 per replica count trend per round)."""
+    entry, cell = _metric_entry(rec, "serving_fleet_scaling")
+    if entry is None:
+        return cell
+    points = entry.get("points")
+    if not isinstance(points, list):
+        return "?"
+    for p in points:
+        if isinstance(p, dict) and p.get("replicas") == n:
+            return _numeric_cell(p.get(field))
+    return "-"
+
+
 def scenario_mixture_types(recs: list[dict | None]) -> list[str]:
     """Union of mixture member names across rounds (the ISSUE 11 record
     nests per-type steps/s under `mixture.per_type_steps_per_s`)."""
@@ -452,6 +483,22 @@ def trend_rows(root: str) -> tuple[list[int], list[tuple[str, list[str]]]]:
                 rows.append((
                     f"serving_latency.{field}",
                     [serving_cell(r, field) for r in recs],
+                ))
+        if name == "serving_fleet_scaling":
+            # Fleet scale-out sub-rows (ISSUE 17): absolute actions/s
+            # and p99 at every replica count ever benchmarked, so a
+            # flat curve (replicas stopped helping) or a tail-latency
+            # regression at one fleet size is visible even when the
+            # headline 3-vs-1 ratio holds.
+            for n in fleet_replica_counts(recs):
+                rows.append((
+                    f"serving_fleet_scaling.r{n}",
+                    [fleet_point_cell(r, n, "actions_per_s")
+                     for r in recs],
+                ))
+                rows.append((
+                    f"serving_fleet_scaling.r{n}.p99_ms",
+                    [fleet_point_cell(r, n, "p99_ms") for r in recs],
                 ))
         if name == "consumed_env_steps_per_s":
             # Data-plane A/B sub-rows (ISSUE 13): each plane's absolute

@@ -48,10 +48,14 @@ def main(argv=None) -> int:
         "deterministic; a detected violation names its seed for replay)",
     )
     p.add_argument(
-        "--scenario", choices=("all", "fleet", "gateway", "process"),
+        "--scenario",
+        choices=("all", "fleet", "gateway", "replica", "process"),
         default="all",
         help="which unit to exercise (default: the quick profile; "
-        "'process' spawns REAL gossip workers and SIGKILLs one)",
+        "'replica' is the ISSUE 17 replica-kill-mid-swap schedule — "
+        "N MailboxPolicySyncer replicas under kill/restart + the "
+        "fault menu; 'process' spawns REAL gossip workers and "
+        "SIGKILLs one)",
     )
     p.add_argument(
         "--writer", choices=("atomic", "direct", "shared-tmp"),
@@ -103,6 +107,13 @@ def main(argv=None) -> int:
                 range(args.seed0, args.seed0 + args.schedules),
                 lambda s: fleetsan.exercise_gateway(
                     s, poller=args.poller
+                ),
+            )
+        elif args.scenario == "replica":
+            out = fleetsan.exercise_sweep(
+                range(args.seed0, args.seed0 + args.schedules),
+                lambda s: fleetsan.exercise_replica_fleet(
+                    s, replicas=args.world
                 ),
             )
         else:

@@ -38,7 +38,8 @@ takes_value() {
     --chunk|--eval-every|--eval-envs|--eval-steps|--workers|--ckpt-dir|\
     --compile-cache-dir|--save-every|--stall-timeout|--async-actors|\
     --updates-per-block|--max-staleness|--queue-depth|--async-correction|\
-    --replay-dtype|--curriculum|--data-plane|--data-plane-codec)
+    --replay-dtype|--curriculum|--data-plane|--data-plane-codec|\
+    --serve-port|--serve-buckets)
       return 0 ;;
   esac
   return 1

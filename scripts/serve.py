@@ -110,13 +110,29 @@ def main(argv=None) -> int:
         help="act bucket sizes, comma list (default 1,2,...,64)",
     )
     p.add_argument(
-        "--max-wait-us", type=float, default=2000.0,
+        "--max-wait-us", action="append", default=[], metavar="[ID=]US",
         help="micro-batch window: max µs the dispatcher holds a flush "
-        "while rows accumulate (p99 vs occupancy knob; default 2000)",
+        "while rows accumulate (p99 vs occupancy knob; default 2000). "
+        "Repeatable; ID=US sets a per-policy window that rides the "
+        "policy handle across hot-swaps (the SLO-class batching tier)",
     )
     p.add_argument(
         "--queue-limit", type=int, default=256,
         help="bounded request queue capacity; overflow answers 503",
+    )
+    p.add_argument(
+        "--max-inflight", type=int, default=1,
+        help="overlapping in-flight dispatches: >1 packs flush N+1 "
+        "while flush N is on device (default 1 — classic single-"
+        "dispatcher loop)",
+    )
+    p.add_argument(
+        "--shed-burn-threshold", type=float, default=None,
+        help="admission control: shed (503) new requests to an SLO-"
+        "classed policy whose burn rate is at/over this once the queue "
+        "passes half capacity, instead of queueing certain violations "
+        "(default off; 1.0 = shed once the policy eats budget at "
+        "exactly the budget rate)",
     )
     p.add_argument(
         "--sample", action="store_true",
@@ -124,10 +140,11 @@ def main(argv=None) -> int:
         "(PPO only)",
     )
     p.add_argument(
-        "--backend", choices=("xla", "mirror"), default="xla",
+        "--backend", choices=("xla", "mirror", "auto"), default="xla",
         help="acting backend: 'mirror' serves MLP policies through the "
         "numpy host mirror (models/host_actor) — no XLA dispatch, the "
-        "right trade on CPU-only serving hosts",
+        "right trade on CPU-only serving hosts; 'auto' measures batch-1 "
+        "dispatch walls of both at startup and picks the faster",
     )
     p.add_argument("--seed", type=int, default=0)
     p.add_argument(
@@ -177,6 +194,29 @@ def main(argv=None) -> int:
         help="peer mailbox age bound before /healthz degrades to 503 "
         "(default 30)",
     )
+    p.add_argument(
+        "--sync-mailbox", default=None, metavar="DIR",
+        help="replica-to-replica policy propagation (ISSUE 17): poll "
+        "this mailbox directory for published (version, params) "
+        "snapshots and hot-swap them into --sync-policy — version "
+        "updates reach every replica without a restart. Independent "
+        "of --distributed/--mailbox-dir (that one is fleet HEALTH; "
+        "this one is the params feed)",
+    )
+    p.add_argument(
+        "--sync-policy", default=None, metavar="ID",
+        help="--sync-mailbox: resident policy the snapshots swap into "
+        "(default: the default policy)",
+    )
+    p.add_argument(
+        "--sync-rank", type=int, default=0, metavar="R",
+        help="--sync-mailbox: publisher's mailbox rank to read "
+        "(default 0)",
+    )
+    p.add_argument(
+        "--sync-poll-s", type=float, default=0.25, metavar="S",
+        help="--sync-mailbox: poll interval in seconds (default 0.25)",
+    )
     args = p.parse_args(argv)
 
     if args.distributed and (args.mailbox_dir is None or args.world is None):
@@ -191,17 +231,27 @@ def main(argv=None) -> int:
     except ValueError as e:
         raise SystemExit(str(e))
 
-    slo_default = None
-    slo_by_id: dict[str, float] = {}
-    for item in args.slo_ms:
-        try:
-            if "=" in item:
-                pid, ms = item.split("=", 1)
-                slo_by_id[pid] = float(ms)
-            else:
-                slo_default = float(item)
-        except ValueError:
-            raise SystemExit(f"--slo-ms wants [ID=]MS, got {item!r}")
+    def parse_classed(items: list[str], flag: str, unit: str):
+        default = None
+        by_id: dict[str, float] = {}
+        for item in items:
+            try:
+                if "=" in item:
+                    pid, v = item.split("=", 1)
+                    by_id[pid] = float(v)
+                else:
+                    default = float(item)
+            except ValueError:
+                raise SystemExit(f"{flag} wants [ID=]{unit}, got {item!r}")
+        return default, by_id
+
+    slo_default, slo_by_id = parse_classed(args.slo_ms, "--slo-ms", "MS")
+    # The GLOBAL window feeds the batcher; per-policy ones ride handles.
+    wait_default, wait_by_id = parse_classed(
+        args.max_wait_us, "--max-wait-us", "US"
+    )
+    if wait_default is None:
+        wait_default = 2000.0
 
     from actor_critic_tpu import config as config_mod
     from actor_critic_tpu import serving
@@ -235,7 +285,7 @@ def main(argv=None) -> int:
         telemetry.set_current(session)
 
     runner = None
-    if not args.no_warmup and args.backend == "xla":
+    if not args.no_warmup and args.backend in ("xla", "auto"):
         ctx = compile_cache.WarmupContext(
             algo=preset.algo, fused=False, spec=spec, cfg=preset.config,
             serving_buckets=buckets, serving_sample=args.sample,
@@ -259,10 +309,18 @@ def main(argv=None) -> int:
         )
     template = serving.init_params(spec, preset.config, preset.algo,
                                    seed=args.seed)
+    if args.backend == "auto":
+        # Fix the backend from measured batch-1 walls BEFORE any
+        # policy installs (prepare_params needs a concrete backend).
+        # The init template shares the checkpoints' architecture, so
+        # the measurement transfers.
+        choice = engine.resolve_backend(template)
+        print(f"auto backend: {choice} ({engine.auto_choice})", flush=True)
     for pid, ckpt_dir in policies.items():
         params = serving.restore_policy_params(ckpt_dir, template)
         store.register(pid, engine, params, default=(pid == args.default),
-                       slo_ms=slo_by_id.get(pid, slo_default))
+                       slo_ms=slo_by_id.get(pid, slo_default),
+                       max_wait_us=wait_by_id.get(pid))
         print(f"policy {pid!r} <- {ckpt_dir}", flush=True)
     if args.random_init:
         # Without --default the FIRST registration keeps the route (a
@@ -270,13 +328,16 @@ def main(argv=None) -> int:
         # must never silently steal traffic from a real one.
         store.register("default", engine, template,
                        default=(args.default == "default"),
-                       slo_ms=slo_by_id.get("default", slo_default))
+                       slo_ms=slo_by_id.get("default", slo_default),
+                       max_wait_us=wait_by_id.get("default"))
         print("policy 'default' <- random init", flush=True)
-    unknown_slo = set(slo_by_id) - set(store.ids())
-    if unknown_slo:
-        raise SystemExit(
-            f"--slo-ms names no resident policy: {sorted(unknown_slo)}"
-        )
+    for flag, by_id in (("--slo-ms", slo_by_id),
+                        ("--max-wait-us", wait_by_id)):
+        unknown = set(by_id) - set(store.ids())
+        if unknown:
+            raise SystemExit(
+                f"{flag} names no resident policy: {sorted(unknown)}"
+            )
 
     if runner is not None:
         runner.wait(timeout=120)
@@ -310,10 +371,30 @@ def main(argv=None) -> int:
             )
         aggregator = FleetAggregator(mailbox_dir=args.mailbox_dir)
 
+    syncer = None
+    if args.sync_mailbox:
+        sync_pid = args.sync_policy or store.default_id
+        if sync_pid not in store.ids():
+            raise SystemExit(
+                f"--sync-policy {sync_pid!r} names no resident policy; "
+                f"resident: {sorted(store.ids())}"
+            )
+        syncer = serving.MailboxPolicySyncer(
+            store, sync_pid, args.sync_mailbox, rank=args.sync_rank,
+            template=template, poll_s=args.sync_poll_s,
+        ).start()
+        print(
+            f"policy sync: {sync_pid!r} <- {args.sync_mailbox} "
+            f"(rank {args.sync_rank}, every {args.sync_poll_s:g}s)",
+            flush=True,
+        )
+
     gateway = serving.ServeGateway(
         store, port=args.port, host=args.host, session=session,
-        max_wait_us=args.max_wait_us, queue_limit=args.queue_limit,
+        max_wait_us=wait_default, queue_limit=args.queue_limit,
         fleet=fleet, aggregator=aggregator,
+        max_inflight=args.max_inflight,
+        shed_burn_threshold=args.shed_burn_threshold,
     )
     # The ACTUAL bound port — with --port 0 this is the OS-assigned one.
     routes = "/v1/swap /v1/policies /metrics /healthz" + (
@@ -332,6 +413,8 @@ def main(argv=None) -> int:
         print("shutting down", flush=True)
     finally:
         gateway.close()
+        if syncer is not None:
+            syncer.close()
         if session is not None:
             session.close()
     return 0

@@ -1,17 +1,29 @@
 #!/usr/bin/env python
-"""Closed-loop load generator for the policy-serving gateway (ISSUE 10).
+"""Load generator for the policy-serving gateway (ISSUE 10/17).
 
     python scripts/serve_loadgen.py --url http://127.0.0.1:8000 \
         --concurrency 16 --duration 10 --obs-dim 4 [--rows 1] [--json]
 
-N worker threads each run a closed loop — POST /v1/act, wait for the
+    # open-loop: 200 requests/s fixed arrival schedule (ISSUE 17)
+    python scripts/serve_loadgen.py --url ... --rate 200 --duration 10
+
+Closed loop (default): N worker threads each POST /v1/act, wait for the
 reply, repeat — over ONE keep-alive connection each, so measured
 latency is the gateway's (queue wait + micro-batch window + dispatch),
 not TCP setup. Closed-loop at saturating concurrency is the SLO-bench
 shape: offered load adapts to service rate, and p50/p99 come from the
-per-request walls the workers record. `run_load` is the library entry
-`bench/suite.py serving_latency` drives.
-"""
+per-request walls the workers record.
+
+Open loop (`--rate R`): arrivals are pinned to a fixed schedule —
+request k fires at `k / R` seconds regardless of how the previous one
+fared (worker w takes arrivals w, w+C, w+2C, ...). Offered load does
+NOT adapt, so saturation shows up as queueing/shedding instead of a
+silently slowed generator: `late` counts arrivals that fired behind
+schedule (every connection busy past its slot — the open-loop
+saturation signal), and 503s are split into `shed` (the gateway's
+admission-control answer, body `shed: true`) vs plain `rejected_503`
+(queue-full). `run_load` is the library entry `bench/suite.py`
+drives."""
 
 from __future__ import annotations
 
@@ -47,10 +59,17 @@ def _worker(
     timeout_s: float,
     out: dict,
     start: threading.Event,
+    arrivals: tuple | None = None,
 ) -> None:
+    """One load worker. `arrivals=None` is the closed loop; an
+    `(offset_s, step_s)` pair is this worker's slice of the open-loop
+    schedule: its k-th request fires at `start + offset + k*step`."""
     parsed = urlparse(url)
     lat_ms: list[float] = []
     errors = 0
+    late = 0
+    shed = 0
+    rejected_503 = 0
 
     def connect() -> http.client.HTTPConnection:
         c = http.client.HTTPConnection(
@@ -65,8 +84,23 @@ def _worker(
     conn = None
     headers = {"Content-Type": "application/json"}
     start.wait()
+    t_base = time.monotonic()
+    k = 0
     try:
         while time.monotonic() < deadline:
+            if arrivals is not None:
+                # Fixed-arrival-rate pacing: sleep until this worker's
+                # next slot; firing past it means the previous request
+                # overran — the open-loop saturation signal.
+                t_next = t_base + arrivals[0] + k * arrivals[1]
+                if t_next >= deadline:
+                    break
+                now = time.monotonic()
+                if t_next > now:
+                    time.sleep(t_next - now)
+                else:
+                    late += 1
+                k += 1
             if conn is None:
                 # Inside the loop and counted: a dead/refusing gateway
                 # must surface as errors, not kill the worker before it
@@ -92,6 +126,17 @@ def _worker(
                     conn = None
                 if resp.status != 200:
                     errors += 1
+                    if resp.status == 503:
+                        # Discriminate the gateway's two 503 classes
+                        # (ISSUE 17): admission-control shed marks its
+                        # body; a plain 503 is queue-full/down.
+                        try:
+                            if json.loads(payload).get("shed"):
+                                shed += 1
+                            else:
+                                rejected_503 += 1
+                        except Exception:
+                            rejected_503 += 1
                     continue
                 json.loads(payload)
             except Exception:
@@ -116,6 +161,9 @@ def _worker(
         # its partial tallies readable instead of a silent clean zero.
         out["lat_ms"] = lat_ms
         out["errors"] = errors
+        out["late"] = late
+        out["shed"] = shed
+        out["rejected_503"] = rejected_503
         out["rows"] = rows
 
 
@@ -128,10 +176,15 @@ def run_load(
     rows: int = 1,
     policy: str | None = None,
     timeout_s: float = 30.0,
+    rate: float | None = None,
 ) -> dict:
-    """Drive the gateway closed-loop; returns the SLO summary
-    (requests, actions_per_s, p50/p99/max ms, errors). `obs` overrides
-    the generated [rows, obs_dim] zero observation batch."""
+    """Drive the gateway; returns the SLO summary (requests,
+    actions_per_s, p50/p99/max ms, errors). `obs` overrides the
+    generated [rows, obs_dim] zero observation batch. `rate` switches
+    to the open loop: requests/s offered on a fixed arrival schedule
+    striped across the workers (module docstring)."""
+    if rate is not None and rate <= 0:
+        raise ValueError(f"rate must be > 0 req/s, got {rate!r}")
     if obs is None:
         obs = [[0.1] * obs_dim for _ in range(rows)]
     body_obj: dict = {"obs": obs}
@@ -144,7 +197,9 @@ def run_load(
     threads = [
         threading.Thread(
             target=_worker,
-            args=(url, body, rows, deadline, timeout_s, results[i], start),
+            args=(url, body, rows, deadline, timeout_s, results[i], start,
+                  None if rate is None
+                  else (i / rate, concurrency / rate)),
             name=f"loadgen-{i}",
             daemon=True,
         )
@@ -161,8 +216,13 @@ def run_load(
     requests = len(lat)
     errors = sum(r.get("errors", 0) for r in results)
     return {
+        "mode": "closed" if rate is None else "open",
         "requests": requests,
         "errors": errors,
+        "late": sum(r.get("late", 0) for r in results),
+        "shed": sum(r.get("shed", 0) for r in results),
+        "rejected_503": sum(r.get("rejected_503", 0) for r in results),
+        "offered_per_s": None if rate is None else float(rate),
         "wall_s": round(wall, 3),
         "requests_per_s": round(requests / wall, 2) if wall > 0 else 0.0,
         "actions_per_s": round(requests * rows / wall, 2) if wall > 0 else 0.0,
@@ -173,6 +233,7 @@ def run_load(
             "concurrency": concurrency,
             "duration_s": duration_s,
             "rows": rows,
+            "rate": rate,
         },
     }
 
@@ -192,6 +253,12 @@ def main(argv=None) -> int:
     )
     p.add_argument("--policy", default=None, help="policy id to route to")
     p.add_argument("--timeout", type=float, default=30.0)
+    p.add_argument(
+        "--rate", type=float, default=None, metavar="R",
+        help="open-loop mode: offer R requests/s on a fixed arrival "
+        "schedule striped across --concurrency connections (default: "
+        "closed loop — each worker waits for its reply)",
+    )
     p.add_argument("--json", action="store_true", help="machine output")
     args = p.parse_args(argv)
     out = run_load(
@@ -202,15 +269,21 @@ def main(argv=None) -> int:
         rows=args.rows,
         policy=args.policy,
         timeout_s=args.timeout,
+        rate=args.rate,
     )
     if args.json:
         print(json.dumps(out))
     else:
+        extra = (
+            f"; offered {out['offered_per_s']}/s, late {out['late']}, "
+            f"shed {out['shed']}, rejected {out['rejected_503']}"
+            if out["mode"] == "open" else ""
+        )
         print(
             f"{out['requests']} requests ({out['errors']} errors) in "
             f"{out['wall_s']}s -> {out['actions_per_s']} actions/s; "
             f"p50 {out['p50_ms']} ms, p99 {out['p99_ms']} ms, "
-            f"max {out['max_ms']} ms"
+            f"max {out['max_ms']} ms{extra}"
         )
     return 0 if out["errors"] == 0 else 1
 

@@ -40,6 +40,15 @@ ls "$fleetdir"/host*/flight_dump_*.json >/dev/null 2>&1 || { echo "tier1: fleets
 rm -rf "$fleetdir"
 echo "tier1: fleetsan wall $(( $(date +%s) - t0 ))s"
 t0=$(date +%s)
+# Replica-kill-mid-swap schedule (ISSUE 17 leg b): 30 fixed-seed
+# schedules over the horizontal scale-out propagation path — N
+# MailboxPolicySyncer replicas consuming a publisher's mailbox under
+# replica SIGKILL/restart + torn/replayed snapshots; proves a torn
+# policy is never served and every replica (incl. the rejoiner)
+# converges. Own timeout like the other sanitizer steps.
+timeout -k 5 120 env JAX_PLATFORMS=cpu python scripts/fleetsan.py --scenario replica --schedules 30 || exit $?
+echo "tier1: fleetsan-replica wall $(( $(date +%s) - t0 ))s"
+t0=$(date +%s)
 # Numerics fault sanitizer quick profile (ISSUE 14): 16 fixed-seed
 # poison schedules (nan/±inf/denormal/int8-saturating) through the REAL
 # update/codec/publish/checkpoint objects — every poison must be

@@ -384,6 +384,83 @@ def test_scenario_fleet_sub_rows(tmp_path):
     assert "scenario_fleet.acrobot" in labels
 
 
+def test_serving_fleet_scaling_sub_rows(tmp_path):
+    """ISSUE 17 satellite: serving_fleet_scaling expands into per-
+    replica-count actions/s + p99 sub-rows (union across rounds); '-'
+    before the metric existed or a count was dropped, '?' for malformed
+    sub-records, 'err' for failed subprocesses."""
+    mod = _load()
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps({
+        "metric": "a2c", "value": 1.0,
+        "cpu_metrics": {"host_pool_scaling": {"value": 3.0}},
+    }) + "\n")
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps({
+        "metric": "a2c", "value": 1.0,
+        "cpu_metrics": {
+            "serving_fleet_scaling": {
+                "value": 1.96,
+                "points": [
+                    {"replicas": 1, "actions_per_s": 610.0,
+                     "p99_ms": 61.0},
+                    {"replicas": 2, "actions_per_s": 1001.4,
+                     "p99_ms": 55.3},
+                    {"replicas": 3, "actions_per_s": 1195.2,
+                     "p99_ms": 51.2},
+                ],
+            },
+        },
+    }) + "\n")
+    # r03: points block malformed; r04: a point carries a non-numeric
+    # field and a count (r2) is absent from the curve.
+    (tmp_path / "BENCH_r03.json").write_text(json.dumps({
+        "metric": "a2c", "value": 1.0,
+        "cpu_metrics": {
+            "serving_fleet_scaling": {"value": 0.9, "points": "oops"},
+        },
+    }) + "\n")
+    (tmp_path / "BENCH_r04.json").write_text(json.dumps({
+        "metric": "a2c", "value": 1.0,
+        "cpu_metrics": {
+            "serving_fleet_scaling": {
+                "value": 1.5,
+                "points": [
+                    {"replicas": 1, "actions_per_s": 600.0,
+                     "p99_ms": 62.0},
+                    {"replicas": 3, "actions_per_s": "garbage"},
+                ],
+            },
+        },
+    }) + "\n")
+    (tmp_path / "BENCH_r05.json").write_text(json.dumps({
+        "metric": "a2c", "value": 1.0,
+        "cpu_metrics": {"serving_fleet_scaling": {"error": "rc=1"}},
+    }) + "\n")
+    rounds, rows = mod.trend_rows(str(tmp_path))
+    assert rounds == [1, 2, 3, 4, 5]
+    table = dict(rows)
+    assert table["serving_fleet_scaling"] == [
+        "-", "1.96", "0.9", "1.5", "err",
+    ]
+    assert table["serving_fleet_scaling.r1"] == [
+        "-", "610", "?", "600", "err",
+    ]
+    assert table["serving_fleet_scaling.r2"] == [
+        "-", "1001.4", "?", "-", "err",
+    ]
+    assert table["serving_fleet_scaling.r3"] == [
+        "-", "1195.2", "?", "?", "err",
+    ]
+    # p99 of the r3 point is absent in r04 — malformed, not missing.
+    assert table["serving_fleet_scaling.r3.p99_ms"] == [
+        "-", "51.2", "?", "?", "err",
+    ]
+    labels = [label for label, _ in rows]
+    i = labels.index("serving_fleet_scaling")
+    assert labels[i + 1:i + 3] == [
+        "serving_fleet_scaling.r1", "serving_fleet_scaling.r1.p99_ms",
+    ]
+
+
 def _write_data_plane_rounds(root: Path):
     """r01 without the metric, r02 a full data-plane A/B record, r03 a
     malformed one, r04 unparseable."""

@@ -144,6 +144,34 @@ def test_guarded_gateway_poller_sweeps_clean():
         assert r["swaps"] > 0
 
 
+def test_seeded_replica_schedule_replays_bit_identically():
+    reports = [
+        fleetsan.exercise_replica_fleet(seed=5, versions=6, replicas=3)
+        for _ in range(2)
+    ]
+    assert reports[0]["trace"] == reports[1]["trace"]
+    assert reports[0]["swaps"] == reports[1]["swaps"]
+    assert reports[0]["faults"] == reports[1]["faults"]
+
+
+def test_replica_kill_mid_swap_schedules_sweep_clean():
+    """ISSUE 17 leg b: the replica-kill-mid-swap scenario — the REAL
+    MailboxPolicySyncer.poll_once into real PolicyStores under torn
+    files, stale replays, and a seeded replica SIGKILL+cold-restart —
+    never serves a torn policy, never regresses a version within one
+    process lifetime, and every replica (including the rejoiner)
+    converges to the final published version."""
+    kills = 0
+    for seed in range(6):
+        r = fleetsan.exercise_replica_fleet(seed=seed, versions=6,
+                                            replicas=3)
+        assert r["violations"] == 0
+        assert r["swaps"] > 0
+        assert r["published"] == 6
+        kills += r["kills"]
+    assert kills > 0, "no schedule exercised the replica kill"
+
+
 def test_quick_profile_sweeps_clean():
     """The exact fixed-seed profile scripts/tier1.sh runs (smaller
     schedule count here — the tier-1 step runs the full one)."""

@@ -94,6 +94,30 @@ def test_serving_dispatch_swap_never_recompiles():
     assert c.transfers == 2  # device_put obs in, device_get actions out
 
 
+def test_serving_overlap_within_budget():
+    """ISSUE 17: the overlapped act path (max_inflight flight workers
+    dispatching off the 1-deep handoff) keeps the per-act serving
+    budget — one dispatch, the two explicit crossings, zero recompiles
+    — with flight-thread work metered under the global transfer
+    guard."""
+    report = perfsan.run_program("serving_overlap", _budgets())
+    c = report["counters"]
+    assert c.dispatches == 1
+    assert c.transfers == 2
+    assert c.recompiles == 0
+
+
+def test_serving_proxy_hop_is_all_zero():
+    """ISSUE 17 leg b: the fleet-proxy relay carries NO device state —
+    the whole proxied request meters zero dispatches, zero crossings,
+    zero bytes, zero recompiles."""
+    report = perfsan.run_program("serving_proxy_hop", _budgets())
+    c = report["counters"]
+    assert c.dispatches == 0
+    assert c.transfers == 0 and c.transferred_bytes == 0
+    assert c.recompiles == 0
+
+
 def test_mixture_fleet_step_is_one_fused_program():
     report = perfsan.run_program("mixture_fleet_step", _budgets())
     c = report["counters"]

@@ -1,0 +1,566 @@
+"""Serve-while-training gateway fleet (ISSUE 17): the live learner
+publish hook feeds a resident gateway, N replicas behind the fronting
+proxy (relay, failover, health eviction/readmission, verbatim app-level
+503), mailbox-driven replica policy sync, continuous-batching
+refinements (overlapped dispatch, per-policy micro-batch windows, auto
+backend), the open-loop load generator, and the serve_fleet.py CLI."""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from actor_critic_tpu import serving
+from actor_critic_tpu.algos import ppo
+from actor_critic_tpu.envs import make_cartpole
+from actor_critic_tpu.envs.host_pool import HostEnvPool
+from actor_critic_tpu.parallel import multihost
+
+REPO = Path(__file__).parent.parent
+
+
+# ---------------------------------------------------------------- helpers
+
+
+class StubEngine:
+    """jax-free engine: action = obs[:, 0] * params['scale'][0], with an
+    optional dispatch pad and a max-concurrent-acts tracker (the overlap
+    witness)."""
+
+    max_rows = 8
+
+    def __init__(self, pad_s: float = 0.0):
+        self.pad_s = pad_s
+        self._lock = threading.Lock()
+        self._active = 0
+        self.max_concurrent = 0
+
+    def prepare_params(self, params):
+        return {k: np.array(v) for k, v in params.items()}
+
+    def act(self, params, obs):
+        with self._lock:
+            self._active += 1
+            self.max_concurrent = max(self.max_concurrent, self._active)
+        try:
+            if self.pad_s:
+                time.sleep(self.pad_s)
+            obs = np.asarray(obs)
+            return obs[:, 0] * params["scale"][0]
+        finally:
+            with self._lock:
+                self._active -= 1
+
+
+def _stub_store(scale: float = 2.0, pad_s: float = 0.0, **register_kw):
+    store = serving.PolicyStore()
+    engine = StubEngine(pad_s=pad_s)
+    store.register(
+        "default", engine,
+        {"scale": np.full(1, scale, np.float32)}, **register_kw,
+    )
+    return store, engine
+
+
+def _post(url: str, body: dict, timeout: float = 30.0):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(url: str, timeout: float = 10.0):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+class _CannedReplica:
+    """A stub upstream whose /v1/act answer is canned — the app-level
+    503 relay tests need a replica that sheds/rejects on demand while
+    its /healthz stays controllable."""
+
+    def __init__(self, act_status: int = 200, act_body: dict | None = None):
+        self.act_status = act_status
+        self.act_body = act_body if act_body is not None else {"actions": [0.0]}
+        self.healthy = True
+        replica = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def _send(self, status: int, payload: dict) -> None:
+                raw = (json.dumps(payload) + "\n").encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(raw)))
+                self.end_headers()
+                self.wfile.write(raw)
+
+            def do_GET(self):
+                if replica.healthy:
+                    self._send(200, {"ok": True})
+                else:
+                    self._send(503, {"ok": False})
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                if length:
+                    self.rfile.read(length)
+                self._send(replica.act_status, replica.act_body)
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._server.daemon_threads = True
+        self.url = f"http://127.0.0.1:{self._server.server_address[1]}"
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def close(self):
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5)
+
+
+# ---------------------------------------------------------------- proxy
+
+
+def test_proxy_round_robin_relays_and_counts():
+    stores = [_stub_store(scale=2.0) for _ in range(2)]
+    gws = [serving.ServeGateway(s, port=0, max_wait_us=0.0)
+           for s, _ in stores]
+    proxy = serving.FleetProxy(
+        [gw.url for gw in gws], port=0, policy="round_robin", probe=False
+    )
+    try:
+        for i in range(6):
+            status, body = _post(
+                proxy.url + "/v1/act", {"obs": [[float(i), 0.0]]}
+            )
+            assert status == 200, body
+            assert body["actions"] == [pytest.approx(2.0 * i)]
+        status, stats = _get(proxy.url + "/proxyz")
+        assert status == 200
+        assert stats["relayed"] == 6 and stats["failovers"] == 0
+        assert stats["healthy"] == 2
+        forwards = sorted(r["forwards"] for r in stats["replicas"])
+        assert forwards == [3, 3]  # round robin splits evenly
+    finally:
+        proxy.close()
+        for gw in gws:
+            gw.close()
+
+
+def test_proxy_failover_on_killed_replica():
+    """Transport failure mid-fleet: the dead replica is evicted on
+    first contact and every request still answers from the survivor —
+    the gateway never surfaces the kill to the client."""
+    stores = [_stub_store(scale=3.0) for _ in range(2)]
+    gws = [serving.ServeGateway(s, port=0, max_wait_us=0.0)
+           for s, _ in stores]
+    proxy = serving.FleetProxy(
+        [gw.url for gw in gws], port=0, policy="round_robin", probe=False
+    )
+    try:
+        status, _ = _post(proxy.url + "/v1/act", {"obs": [[1.0, 0.0]]})
+        assert status == 200
+        gws[1].close()  # SIGKILL stand-in: connection refused from now on
+        for i in range(8):
+            status, body = _post(
+                proxy.url + "/v1/act", {"obs": [[float(i), 0.0]]}
+            )
+            assert status == 200, body
+            assert body["actions"] == [pytest.approx(3.0 * i)]
+        status, stats = _get(proxy.url + "/proxyz")
+        dead = next(
+            r for r in stats["replicas"] if r["url"] == gws[1].url
+        )
+        assert not dead["healthy"] and dead["evictions"] >= 1
+        assert stats["failovers"] >= 1
+        assert stats["healthy"] == 1
+    finally:
+        proxy.close()
+        gws[0].close()
+
+
+def test_proxy_health_probe_evicts_and_readmits():
+    """/healthz probing: `unhealthy_after` consecutive failures evict
+    (a one-replica fleet then answers 503), one 200 readmits."""
+    replica = _CannedReplica(act_status=200, act_body={"actions": [1.5]})
+    proxy = serving.FleetProxy(
+        [replica.url], port=0, unhealthy_after=2, probe=False
+    )
+    try:
+        proxy.probe_once()
+        assert proxy.stats()["healthy"] == 1
+        replica.healthy = False
+        proxy.probe_once()
+        assert proxy.stats()["healthy"] == 1  # one failure: not yet
+        proxy.probe_once()
+        assert proxy.stats()["healthy"] == 0  # second consecutive: evicted
+        status, body = _post(proxy.url + "/v1/act", {"obs": [[0.0]]})
+        assert status == 503 and "no healthy replica" in body["error"]
+        replica.healthy = True
+        proxy.probe_once()  # a single 200 readmits immediately
+        assert proxy.stats()["healthy"] == 1
+        status, body = _post(proxy.url + "/v1/act", {"obs": [[0.0]]})
+        assert status == 200 and body["actions"] == [1.5]
+    finally:
+        proxy.close()
+        replica.close()
+
+
+def test_proxy_relays_app_503_verbatim_without_failover():
+    """A replica's admission-control shed is an APPLICATION answer: the
+    proxy relays the 503 + shed body untouched and does NOT fail over —
+    retrying a shed elsewhere would defeat the replica's admission
+    control."""
+    shedding = _CannedReplica(
+        act_status=503, act_body={"error": "shedding", "shed": True}
+    )
+    proxy = serving.FleetProxy(
+        [shedding.url], port=0, probe=False
+    )
+    try:
+        for _ in range(3):
+            status, body = _post(proxy.url + "/v1/act", {"obs": [[0.0]]})
+            assert status == 503
+            assert body.get("shed") is True and body["error"] == "shedding"
+        stats = proxy.stats()
+        assert stats["failovers"] == 0
+        assert stats["healthy"] == 1  # app-level 503 never evicts
+        assert stats["replicas"][0]["forwards"] == 3
+    finally:
+        proxy.close()
+        shedding.close()
+
+
+# ------------------------------------------------------- mailbox syncer
+
+
+def test_mailbox_syncer_monotone_and_torn_tolerant(tmp_path):
+    """poll_once consumes fresh versions, drops duplicates/stale
+    regressions, and tolerates a torn snapshot file with the previous
+    version still serving — the replica-side propagation contract
+    fleetsan's replica schedules sweep."""
+    mbox = str(tmp_path)
+    store, _ = _stub_store(scale=0.0)
+    template = {"scale": np.zeros(1, np.float32)}
+    syncer = serving.MailboxPolicySyncer(
+        store, "default", mbox, rank=0, template=template
+    )
+    assert syncer.poll_once() is False  # nothing published yet
+
+    multihost.write_params(
+        mbox, 0, 1, {"scale": np.full(1, 10.0, np.float32)}
+    )
+    assert syncer.poll_once() is True
+    assert store.get("default").version == 1
+    assert float(store.get("default").params["scale"][0]) == 10.0
+    assert syncer.poll_once() is False  # duplicate delivery dropped
+
+    multihost.write_params(
+        mbox, 0, 3, {"scale": np.full(1, 30.0, np.float32)}
+    )
+    assert syncer.poll_once() is True and syncer.version == 3
+
+    # Stale replay (an old snapshot re-landing in the mailbox) is
+    # dropped by the per-publisher version clock.
+    multihost.write_params(
+        mbox, 0, 2, {"scale": np.full(1, 20.0, np.float32)}
+    )
+    assert syncer.poll_once() is False
+    assert store.get("default").version == 3
+    assert float(store.get("default").params["scale"][0]) == 30.0
+
+    # Torn file: truncate the live snapshot mid-byte — read_params'
+    # tolerance turns it into a no-op poll, never a torn swap.
+    path = multihost.params_file(mbox, 0)
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(max(1, size // 2))
+    assert syncer.poll_once() is False
+    assert store.get("default").version == 3
+
+    multihost.write_params(
+        mbox, 0, 4, {"scale": np.full(1, 40.0, np.float32)}
+    )
+    assert syncer.poll_once() is True
+    assert store.get("default").version == 4 and syncer.swaps == 3
+
+
+# ------------------------------------------------- serve-while-training
+
+
+def test_serve_while_training_publishes_into_gateway():
+    """The tentpole e2e: `publish_hook` rides the async learner's
+    per-block publish into a resident gateway — served versions are
+    strictly the swap sequence (monotone, ending at `iterations`), and
+    the final served actions are bitwise the engine applied directly to
+    the final published snapshot."""
+    spec = make_cartpole().spec
+    cfg = ppo.PPOConfig(
+        num_envs=2, rollout_steps=4, epochs=1, num_minibatches=1,
+        hidden=(8,),
+    )
+    engine = serving.PolicyEngine(spec, cfg, algo="ppo", buckets=(1, 2))
+    store = serving.PolicyStore()
+    template = serving.init_params(spec, cfg, "ppo", seed=0)
+    store.register("learner", engine, template, default=True)
+    engine.warm(store.get().params)
+    gw = serving.ServeGateway(store, port=0, max_wait_us=0.0)
+
+    obs = np.array([[0.02, -0.01, 0.03, 0.01]], np.float32)
+    published: dict[int, object] = {}
+    served_versions: list[int] = []
+
+    def publish_hook(it: int, np_params) -> None:
+        import jax
+
+        published[it + 1] = jax.tree.map(np.array, np_params)
+        store.swap("learner", np_params, version=it + 1)
+        status, body = _post(gw.url + "/v1/act", {"obs": obs.tolist()})
+        assert status == 200, body
+        served_versions.append(body["version"])
+
+    pool = HostEnvPool("CartPole-v1", num_envs=2, seed=0)
+    try:
+        ppo.train_host_async(
+            [pool], cfg, 3, seed=0, log_every=0, queue_depth=1,
+            publish_hook=publish_hook,
+        )
+        assert sorted(published) == [1, 2, 3]
+        # Monotone: a later act never serves an older policy.
+        assert served_versions == sorted(served_versions)
+        assert store.get("learner").version == 3
+
+        status, body = _post(gw.url + "/v1/act", {"obs": obs.tolist()})
+        assert status == 200 and body["version"] == 3
+        direct = engine.act(engine.prepare_params(published[3]), obs)
+        np.testing.assert_array_equal(
+            np.asarray(body["actions"]), np.asarray(direct)
+        )
+    finally:
+        gw.close()
+        pool.close()
+
+
+# ------------------------------------------- continuous-batching knobs
+
+
+def test_overlap_mode_correct_and_actually_concurrent():
+    """max_inflight=2: flight workers dispatch concurrently (the stub
+    engine witnesses >= 2 in-flight acts) and every request still gets
+    exactly its own rows back."""
+    store, engine = _stub_store(scale=2.0, pad_s=0.05)
+    batcher = serving.MicroBatcher(
+        store, max_wait_us=0.0, max_batch_rows=1, max_inflight=2
+    )
+    try:
+        assert batcher.health()["max_inflight"] == 2
+        reqs = [
+            batcher.submit(np.full((1, 2), float(i), np.float32))
+            for i in range(8)
+        ]
+        for i, req in enumerate(reqs):
+            actions, _version = batcher.wait(req, timeout=30.0)
+            assert actions == [pytest.approx(2.0 * i)]
+        assert engine.max_concurrent >= 2, (
+            "flight workers never overlapped a dispatch"
+        )
+    finally:
+        batcher.close()
+
+
+def test_per_policy_max_wait_overrides_global_window():
+    """An SLO-classed policy's `max_wait_us` beats the batcher's global
+    window: a zero-wait policy flushes immediately even when the global
+    window would hold the flush far longer."""
+    store = serving.PolicyStore()
+    engine = StubEngine()
+    store.register(
+        "fast", engine, {"scale": np.ones(1, np.float32)},
+        max_wait_us=0.0,
+    )
+    store.register("slow", engine, {"scale": np.ones(1, np.float32)})
+    batcher = serving.MicroBatcher(store, max_wait_us=400_000.0)
+    try:
+        t0 = time.monotonic()
+        req = batcher.submit(np.ones((1, 2), np.float32), "fast")
+        batcher.wait(req, timeout=5.0)
+        assert time.monotonic() - t0 < 0.25  # no 0.4 s global hold
+        t0 = time.monotonic()
+        req = batcher.submit(np.ones((1, 2), np.float32), "slow")
+        batcher.wait(req, timeout=5.0)
+        # The un-overridden policy still pays the global window (the
+        # single 1-row request can never fill the 8-row budget).
+        assert time.monotonic() - t0 >= 0.3
+    finally:
+        batcher.close()
+
+
+def test_auto_backend_resolves_from_measured_walls():
+    spec = make_cartpole().spec
+    cfg = ppo.PPOConfig(hidden=(8,))
+    engine = serving.PolicyEngine(
+        spec, cfg, algo="ppo", buckets=(1, 2), backend="auto"
+    )
+    params = serving.init_params(spec, cfg, "ppo", seed=0)
+    with pytest.raises(RuntimeError, match="unresolved"):
+        engine.prepare_params(params)
+    chosen = engine.resolve_backend(params, trials=3)
+    assert chosen in ("xla", "mirror")
+    assert engine.backend == chosen
+    assert engine.auto_choice["backend"] == chosen
+    assert engine.auto_choice["xla_ms"] > 0.0
+    assert engine.auto_choice["mirror_ms"] > 0.0
+    assert engine.resolve_backend(params) == chosen  # idempotent
+
+    # The resolved engine serves exactly what a concretely-constructed
+    # engine of the chosen backend serves.
+    ref = serving.PolicyEngine(
+        spec, cfg, algo="ppo", buckets=(1, 2), backend=chosen
+    )
+    obs = np.array(
+        [[0.02, -0.01, 0.03, 0.01], [0.1, 0.0, -0.05, 0.2]], np.float32
+    )
+    np.testing.assert_array_equal(
+        engine.act(engine.prepare_params(params), obs),
+        ref.act(ref.prepare_params(params), obs),
+    )
+
+
+def test_auto_backend_with_sampling_fixes_xla():
+    """The mirror serves greedy only, so a sampling engine has nothing
+    to measure: backend='auto' degrades straight to the XLA path."""
+    spec = make_cartpole().spec
+    cfg = ppo.PPOConfig(hidden=(8,))
+    engine = serving.PolicyEngine(
+        spec, cfg, algo="ppo", backend="auto", sample=True
+    )
+    assert engine.backend == "xla"
+
+
+# ------------------------------------------------------------- loadgen
+
+
+def _load_loadgen():
+    spec = importlib.util.spec_from_file_location(
+        "serve_loadgen", REPO / "scripts" / "serve_loadgen.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_open_loop_loadgen_paces_to_fixed_rate():
+    """`--rate R` offers R req/s on a fixed arrival schedule: the
+    request count tracks rate x duration (not the service rate), and
+    the open-loop accounting fields ride the summary."""
+    loadgen = _load_loadgen()
+    store, _ = _stub_store(scale=1.0)
+    gw = serving.ServeGateway(store, port=0, max_wait_us=0.0)
+    try:
+        out = loadgen.run_load(
+            gw.url, concurrency=4, duration_s=1.2, obs_dim=2,
+            rate=50.0,
+        )
+        assert out["mode"] == "open"
+        assert out["offered_per_s"] == 50.0
+        assert out["errors"] == 0
+        # The schedule admits ~rate*duration arrivals; a closed loop on
+        # this near-zero-latency stub would fire thousands.
+        assert 40 <= out["requests"] <= 65, out
+        for key in ("late", "shed", "rejected_503"):
+            assert key in out
+        with pytest.raises(ValueError, match="rate"):
+            loadgen.run_load(gw.url, duration_s=0.1, rate=-1.0)
+    finally:
+        gw.close()
+
+
+def test_loadgen_discriminates_shed_from_plain_503():
+    """The worker splits 503s by their body's `shed` marker — the
+    admission-control shed and the queue-full reject stay separate all
+    the way into the load report."""
+    loadgen = _load_loadgen()
+    shedding = _CannedReplica(
+        act_status=503, act_body={"error": "shedding", "shed": True}
+    )
+    rejecting = _CannedReplica(
+        act_status=503, act_body={"error": "queue full"}
+    )
+    try:
+        out = loadgen.run_load(
+            shedding.url, concurrency=2, duration_s=0.4, obs_dim=2
+        )
+        assert out["shed"] > 0 and out["rejected_503"] == 0
+        assert out["errors"] == out["shed"]
+        out = loadgen.run_load(
+            rejecting.url, concurrency=2, duration_s=0.4, obs_dim=2
+        )
+        assert out["rejected_503"] > 0 and out["shed"] == 0
+    finally:
+        shedding.close()
+        rejecting.close()
+
+
+# ----------------------------------------------------------------- CLI
+
+
+def test_serve_fleet_cli_relays_and_shuts_down():
+    store, _ = _stub_store(scale=4.0)
+    gw = serving.ServeGateway(store, port=0, max_wait_us=0.0)
+    proc = subprocess.Popen(
+        [
+            sys.executable, str(REPO / "scripts" / "serve_fleet.py"),
+            "--replica", gw.url, "--port", "0",
+            "--health-interval", "0.2",
+        ],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    try:
+        line = proc.stdout.readline()
+        m = re.search(r"fleet proxy on (http://[\d.]+:\d+)", line)
+        assert m, f"no proxy URL in startup line: {line!r}"
+        url = m.group(1)
+        status, body = _post(url + "/v1/act", {"obs": [[2.0, 0.0]]})
+        assert status == 200 and body["actions"] == [pytest.approx(8.0)]
+        status, stats = _get(url + "/proxyz")
+        assert status == 200 and stats["relayed"] >= 1
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=15)
+        assert proc.returncode == 0
+        assert "fleet proxy closed" in out
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate(timeout=10)
+        gw.close()

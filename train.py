@@ -557,6 +557,51 @@ def resolve_staleness(args, algo: str):
     return args.max_staleness if args.max_staleness >= 0 else None
 
 
+def start_serving_sidecar(preset, spec, args):
+    """Serve-while-training (ISSUE 17): a resident policy-serving
+    gateway whose single 'learner' policy tracks the training run.
+
+    Built BEFORE training starts so every act bucket is compiled while
+    the env pools are still spawning — the publish hook then only ever
+    hot-swaps params through the `checkpoint.uncommit` route (frozen
+    host snapshot re-placed as uncommitted device buffers: same program,
+    0 recompiles, perfsan's committed serving budget). Versioning:
+    the init placeholder registers at version 0; block `it`'s publish
+    swaps to version `it + 1`, so /v1/act's `version` field is strictly
+    monotone and equals blocks-consumed + 1.
+
+    Returns `(gateway, publish_hook)`; the caller owns gateway.close().
+    """
+    from actor_critic_tpu import serving
+
+    buckets = tuple(
+        int(b) for b in args.serve_buckets.split(",") if b.strip()
+    )
+    engine = serving.PolicyEngine(
+        spec, preset.config, algo=preset.algo, buckets=buckets,
+        seed=args.seed,
+    )
+    store = serving.PolicyStore()
+    template = serving.init_params(
+        spec, preset.config, preset.algo, seed=args.seed
+    )
+    store.register("learner", engine, template, default=True)
+    n_warm = engine.warm(template)
+    gateway = serving.ServeGateway(store, port=args.serve_port)
+    print(
+        f"serving learner on http://127.0.0.1:{gateway.port} "
+        f"(warm: {n_warm} act buckets)",
+        flush=True,
+    )
+
+    def publish_hook(it: int, np_params) -> None:
+        # The publisher freezes its own copy, so handing the same tree
+        # to the store is safe; swap numguards + re-places per policy.
+        store.swap("learner", np_params, version=it + 1)
+
+    return gateway, publish_hook
+
+
 def run_host_async(pools, preset, args, logger) -> dict:
     from actor_critic_tpu.algos import ddpg, ppo, sac
 
@@ -573,6 +618,11 @@ def run_host_async(pools, preset, args, logger) -> dict:
     ckpt = Checkpointer(args.ckpt_dir) if args.ckpt_dir else None
     if ckpt is not None and args.resume and ckpt.latest_step() is not None:
         print(f"resuming from block {ckpt.latest_step()}", flush=True)
+    gateway, publish_hook = None, None
+    if args.serve_port is not None:
+        gateway, publish_hook = start_serving_sidecar(
+            preset, pools[0].spec, args
+        )
     try:
         if preset.algo == "ppo":
             ppo.train_host_async(
@@ -587,6 +637,7 @@ def run_host_async(pools, preset, args, logger) -> dict:
                 data_plane=args.data_plane,
                 plane_codec=args.data_plane_codec,
                 ckpt=ckpt, save_every=args.save_every, resume=args.resume,
+                publish_hook=publish_hook,
             )
         else:
             # Off-policy (ddpg/td3/sac): replay absorbs behavior
@@ -603,8 +654,11 @@ def run_host_async(pools, preset, args, logger) -> dict:
                 max_staleness=resolve_staleness(args, preset.algo),
                 data_plane=args.data_plane,
                 plane_codec=args.data_plane_codec,
+                publish_hook=publish_hook,
             )
     finally:
+        if gateway is not None:
+            gateway.close()
         if ckpt is not None:
             ckpt.close()
     return last
@@ -815,6 +869,23 @@ def main(argv=None) -> int:
         "would bias the V-trace correction itself.",
     )
     p.add_argument(
+        "--serve-port", type=int, default=None, metavar="PORT",
+        help="async mode: serve-while-training — bind a resident "
+        "policy-serving gateway (serving/) on PORT (0 = OS-assigned, "
+        "printed) whose 'learner' policy hot-swaps to every published "
+        "learner snapshot: /v1/act answers with the CURRENT training "
+        "params, version = blocks consumed + 1. Swaps ride the "
+        "checkpoint.uncommit route — steady-state serving never "
+        "recompiles",
+    )
+    p.add_argument(
+        "--serve-buckets", default="1,4,16", metavar="B,B,..",
+        help="--serve-port: act bucket sizes for the resident gateway "
+        "(default 1,4,16 — smaller than scripts/serve.py's ladder; the "
+        "sidecar warms before training starts, so startup cost is "
+        "on the training critical path)",
+    )
+    p.add_argument(
         "--async-correction", choices=("vtrace", "none"), default="vtrace",
         help="async mode: staleness correction — 'vtrace' (clipped "
         "importance-weighted targets under the learner's params, "
@@ -1012,6 +1083,22 @@ def main(argv=None) -> int:
                 "--distributed sync learner builds its global batch from "
                 "host arrays (make_array_from_process_local_data) — drop "
                 "--distributed or use --data-plane host"
+            )
+
+    if args.serve_port is not None:
+        # Serve-while-training rides the async publish cadence: the
+        # lockstep/fused paths have no PolicyPublisher to hook.
+        if args.async_actors <= 0:
+            raise SystemExit(
+                "--serve-port hooks the async learner's per-block "
+                "publish (PolicyPublisher) — pass --async-actors N"
+            )
+        if args.distributed:
+            raise SystemExit(
+                "--serve-port is single-host (the resident gateway "
+                "swaps from THIS process's publish hook); a fleet "
+                "serves through scripts/serve.py --distributed + "
+                "scripts/serve_fleet.py instead"
             )
 
     if args.distributed:
