@@ -28,6 +28,7 @@ from actor_critic_tpu.algos.common import (
     Transition,
     anneal_fraction,
     episode_metrics_update,
+    gae_targets as gae,
     init_rollout,
     linear_anneal,
     rollout_scan,
@@ -36,7 +37,6 @@ from actor_critic_tpu.algos.common import (
 from actor_critic_tpu.algos.metrics import aggregate_metrics
 from actor_critic_tpu.envs.jax_env import JaxEnv
 from actor_critic_tpu.models.networks import ActorCriticDiscrete, ActorCriticGaussian
-from actor_critic_tpu.ops.pallas_scan import gae_auto as gae
 from actor_critic_tpu.ops.returns import normalize_advantages
 from actor_critic_tpu.parallel import mesh as pmesh
 
@@ -168,19 +168,25 @@ def a2c_loss(
 
     dist, value = apply_fn(params, obs)
     log_prob = dist.log_prob(actions)
-    entropy = jnp.mean(dist.entropy())
+    # Explicit fp32 accumulators on every reduction: bit-identical in
+    # fp32 mode (the heads cast up), precision-discipline-required under
+    # --update-dtype bf16 (bf16 compute, fp32 accumulation).
+    entropy = jnp.mean(dist.entropy(), dtype=jnp.float32)
 
-    pg_loss = -jnp.mean(jax.lax.stop_gradient(adv) * log_prob)
+    pg_loss = -jnp.mean(
+        jax.lax.stop_gradient(adv) * log_prob, dtype=jnp.float32
+    )
     ret = jax.lax.stop_gradient(ret)
     if cfg.value_huber_delta > 0:
         # d/dv huber(v - ret) = clip(v - ret, ±delta): a per-sample bound
         # on the value step (see the config-field comment for why PPO's
         # clip-vs-old cannot work in A2C's single-step regime).
         v_loss = jnp.mean(
-            optax.losses.huber_loss(value, ret, delta=cfg.value_huber_delta)
+            optax.losses.huber_loss(value, ret, delta=cfg.value_huber_delta),
+            dtype=jnp.float32,
         )
     else:
-        v_loss = 0.5 * jnp.mean((value - ret) ** 2)
+        v_loss = 0.5 * jnp.mean((value - ret) ** 2, dtype=jnp.float32)
     loss = pg_loss + cfg.value_coef * v_loss - entropy_coef * entropy
     return loss, {
         "loss": loss,

@@ -156,6 +156,34 @@ def offpolicy_rollout(
     return rstate, env_steps, traj
 
 
+def gae_targets(
+    rewards: jax.Array,
+    values: jax.Array,
+    dones: jax.Array,
+    bootstrap_value: jax.Array,
+    gamma: float,
+    lam: float,
+    time_axis_name: Optional[str] = None,
+) -> tuple[jax.Array, jax.Array]:
+    """THE on-policy advantage seam (ISSUE 19): every trainer's GAE /
+    λ-return target computation routes through here, so the estimator
+    lowers through the Pallas kernel layer — `ops.pallas_scan.gae_auto`
+    picks the fused in-VMEM reverse scan on TPU backends and the lax.scan
+    reference everywhere else, keeping the whole update ONE program under
+    jit on both planes. `time_axis_name` selects the sequence-parallel
+    variant inside shard_map. Returns (advantages, returns)."""
+    if time_axis_name is not None:
+        from actor_critic_tpu.parallel.seqpar import seqpar_gae
+
+        return seqpar_gae(
+            rewards, values, dones, bootstrap_value, gamma, lam,
+            axis_name=time_axis_name,
+        )
+    from actor_critic_tpu.ops.pallas_scan import gae_auto as _gae
+
+    return _gae(rewards, values, dones, bootstrap_value, gamma, lam)
+
+
 def corrected_advantages(
     target_log_probs: jax.Array,
     behavior_log_probs: jax.Array,
@@ -192,10 +220,7 @@ def corrected_advantages(
     `time_axis_name` runs the recurrences sequence-parallel inside
     shard_map via `parallel.seqpar` (the impala sp learner's path).
     """
-    from actor_critic_tpu.ops.pallas_scan import (
-        gae_auto as _gae,
-        vtrace_auto as _vtrace,
-    )
+    from actor_critic_tpu.ops.pallas_scan import vtrace_auto as _vtrace
 
     if correction == "vtrace":
         if time_axis_name is not None:
@@ -214,17 +239,10 @@ def corrected_advantages(
             )
         return vt.pg_advantages, vt.vs, jnp.mean(vt.clipped_rhos)
     if correction == "none":
-        if time_axis_name is not None:
-            from actor_critic_tpu.parallel.seqpar import seqpar_gae
-
-            pg_advantages, value_targets = seqpar_gae(
-                rewards, values, dones, bootstrap_value, gamma, lam,
-                axis_name=time_axis_name,
-            )
-        else:
-            pg_advantages, value_targets = _gae(
-                rewards, values, dones, bootstrap_value, gamma, lam
-            )
+        pg_advantages, value_targets = gae_targets(
+            rewards, values, dones, bootstrap_value, gamma, lam,
+            time_axis_name=time_axis_name,
+        )
         return pg_advantages, value_targets, jnp.ones(())
     raise ValueError(f"unknown correction: {correction!r}")
 

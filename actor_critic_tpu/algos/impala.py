@@ -169,7 +169,10 @@ def impala_loss(
     dist, values = apply_fn(params, obs)
     target_log_probs = dist.log_prob(actions).reshape(T, E)
     values = values.reshape(T, E)
-    entropy = jnp.mean(dist.entropy())
+    # Explicit fp32 accumulators on every reduction: bit-identical in
+    # fp32 mode (the heads cast up), precision-discipline-required under
+    # --update-dtype bf16 (bf16 compute, fp32 accumulation).
+    entropy = jnp.mean(dist.entropy(), dtype=jnp.float32)
     _, bootstrap_value = apply_fn(params, bootstrap_obs)
 
     if can_truncate:
@@ -200,8 +203,14 @@ def impala_loss(
         time_axis_name=time_axis_name,
     )
 
-    pg_loss = -jnp.mean(jax.lax.stop_gradient(pg_advantages) * target_log_probs)
-    v_loss = 0.5 * jnp.mean((values - jax.lax.stop_gradient(value_targets)) ** 2)
+    pg_loss = -jnp.mean(
+        jax.lax.stop_gradient(pg_advantages) * target_log_probs,
+        dtype=jnp.float32,
+    )
+    v_loss = 0.5 * jnp.mean(
+        (values - jax.lax.stop_gradient(value_targets)) ** 2,
+        dtype=jnp.float32,
+    )
     loss = pg_loss + cfg.value_coef * v_loss - cfg.entropy_coef * entropy
     return loss, {
         "loss": loss,

@@ -30,6 +30,7 @@ from actor_critic_tpu.algos.common import (
     TrainState,
     anneal_fraction,
     episode_metrics_update,
+    gae_targets as gae,
     init_rollout,
     linear_anneal,
     rollout_scan,
@@ -38,7 +39,6 @@ from actor_critic_tpu.algos.common import (
 from actor_critic_tpu.algos.metrics import aggregate_metrics
 from actor_critic_tpu.envs.jax_env import JaxEnv
 from actor_critic_tpu.models.networks import ActorCriticDiscrete, ActorCriticGaussian
-from actor_critic_tpu.ops.pallas_scan import gae_auto as gae
 from actor_critic_tpu.ops.returns import LOG_RATIO_CAP, normalize_advantages
 from actor_critic_tpu.parallel import mesh as pmesh
 from actor_critic_tpu.utils import compile_cache as _compile_cache
@@ -150,7 +150,12 @@ def ppo_loss(
         entropy_coef = jnp.asarray(cfg.entropy_coef)
     dist, value = apply_fn(params, batch.obs)
     log_prob = dist.log_prob(batch.action)
-    entropy = jnp.mean(dist.entropy())
+    # All loss reductions carry an explicit fp32 accumulator: the network
+    # heads already cast their outputs up, so this is bit-identical in
+    # fp32 mode, and under --update-dtype bf16 it pins the precision-
+    # discipline contract (bf16 compute, fp32 accumulation) at the site
+    # where a future bf16-typed operand would otherwise narrow the sum.
+    entropy = jnp.mean(dist.entropy(), dtype=jnp.float32)
 
     adv = batch.advantage
     if cfg.normalize_adv:
@@ -164,21 +169,22 @@ def ppo_loss(
     ratio = jnp.exp(jnp.minimum(log_ratio, LOG_RATIO_CAP))
     surr1 = ratio * adv
     surr2 = jnp.clip(ratio, 1.0 - clip_eps, 1.0 + clip_eps) * adv
-    pg_loss = -jnp.mean(jnp.minimum(surr1, surr2))
+    pg_loss = -jnp.mean(jnp.minimum(surr1, surr2), dtype=jnp.float32)
 
     if cfg.vf_clip > 0:
         v_clipped = batch.value_old + jnp.clip(
             value - batch.value_old, -cfg.vf_clip, cfg.vf_clip
         )
         v_loss = 0.5 * jnp.mean(
-            jnp.maximum((value - batch.ret) ** 2, (v_clipped - batch.ret) ** 2)
+            jnp.maximum((value - batch.ret) ** 2, (v_clipped - batch.ret) ** 2),
+            dtype=jnp.float32,
         )
     else:
-        v_loss = 0.5 * jnp.mean((value - batch.ret) ** 2)
+        v_loss = 0.5 * jnp.mean((value - batch.ret) ** 2, dtype=jnp.float32)
 
     loss = pg_loss + cfg.value_coef * v_loss - entropy_coef * entropy
     # Schulman's low-variance KL estimator: E[(r-1) - log r].
-    approx_kl = jnp.mean((ratio - 1.0) - log_ratio)
+    approx_kl = jnp.mean((ratio - 1.0) - log_ratio, dtype=jnp.float32)
     clip_frac = jnp.mean((jnp.abs(ratio - 1.0) > clip_eps).astype(jnp.float32))
     return loss, {
         "loss": loss,

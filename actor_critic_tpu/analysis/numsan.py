@@ -38,12 +38,18 @@ missing/reverted guard); a clean schedule appends to `report["trace"]`,
 which is bit-identical per seed (same seed → same poisons, same leaf
 positions, same observed values — replay a named seed to reproduce).
 **Reverted-guard modes** prove the detectors work: `revert="publish"` /
-`revert="checkpoint"` no-op `numguard.check_finite` (the one seam every
-production gate routes through) and numsan must then CATCH the poison
-on the far side of the sink; `revert="codec-wrap"` runs the pre-fix
-encoder (`round(x).astype(int8)` — wraps) against the saturation
-checker. All three are caught deterministically on every schedule and
-regression-tested.
+`revert="checkpoint"` / `revert="bf16-update"` no-op
+`numguard.check_finite` (the one seam every production gate routes
+through) and numsan must then CATCH the poison on the far side of the
+sink; `revert="codec-wrap"` runs the pre-fix encoder
+(`round(x).astype(int8)` — wraps) against the saturation checker. All
+are caught deterministically on every schedule and regression-tested.
+
+ISSUE 19 adds the **bf16-update schedule**: the `--update-dtype bf16`
+program (`bf16_compute=True` — bf16 matmuls, fp32 master params /
+optimizer state / loss accumulators) must produce a FINITE loss on
+clean data, and its poisoned post-update params must hit the same
+publish/checkpoint/serve wall as the fp32 plane's.
 
 `quick_profile` is the fixed-seed sweep `scripts/tier1.sh` runs between
 fleetsan and pytest, under its own timeout.
@@ -497,6 +503,224 @@ def exercise_checkpoint(seed: int, revert: bool = False) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# bf16-update exerciser: the --update-dtype bf16 program feeds the gates
+# ---------------------------------------------------------------------------
+
+_BF16_UPDATE_FIXTURE = None
+
+
+def _bf16_update_fixture():
+    """The `--update-dtype bf16` twin of `_update_fixture`: the same
+    tiny REAL program with `bf16_compute=True` (bf16 matmuls, fp32
+    master params / optimizer state / loss accumulators), compiled once
+    per process."""
+    global _BF16_UPDATE_FIXTURE
+    if _BF16_UPDATE_FIXTURE is not None:
+        return _BF16_UPDATE_FIXTURE
+    import jax
+
+    from actor_critic_tpu.algos import ppo
+    from actor_critic_tpu.envs.jax_env import EnvSpec
+
+    spec = EnvSpec(
+        obs_shape=(4,), action_dim=2, discrete=True,
+        obs_dtype=np.float32, can_truncate=True,
+    )
+    cfg = ppo.PPOConfig(
+        num_envs=2, rollout_steps=4, epochs=1, num_minibatches=1,
+        hidden=(8,), bf16_compute=True,
+    )
+    key = jax.random.key(0)
+    params, opt_state = ppo.init_host_params(spec, cfg, key)
+    update = ppo.make_host_update_step(spec, cfg)
+    _BF16_UPDATE_FIXTURE = (cfg, params, opt_state, update, key)
+    return _BF16_UPDATE_FIXTURE
+
+
+def _numpy_tree(tree):
+    """Writable-numpy deep copy of a params pytree (nested dicts of
+    arrays) — the shape `_poison_tree` mutates."""
+    if isinstance(tree, dict):
+        return {k: _numpy_tree(v) for k, v in tree.items()}
+    return np.array(tree)
+
+
+class _TreeStubEngine:
+    """`_StubEngine` for NESTED (real-network) param trees: prepare
+    flattens to a path->array dict so the far side of
+    `PolicyStore.swap` stays leaf-checkable under the reverted-guard
+    mode."""
+
+    max_rows = 8
+
+    def prepare_params(self, params):
+        out = {p: np.array(a) for p, a in _flat_float_leaves(params)}
+        for v in out.values():
+            v.flags.writeable = False
+        return out
+
+    def act(self, params, obs):
+        first = sorted(params)[0]
+        return np.asarray(obs)[:, 0] * float(params[first].flat[0])
+
+
+def exercise_bf16_update(seed: int, revert: bool = False) -> dict:
+    """ISSUE 19's bf16-update poison schedule. First the REAL
+    `bf16_compute=True` update program runs on a CLEAN block and its
+    loss must come out finite (the fp32-accumulator discipline: bf16
+    matmuls may not manufacture non-finites at fixture scale). Then the
+    POST-UPDATE fp32 master params — the tree a bf16 divergence would
+    hand downstream — are poisoned, and the same commit gates the fp32
+    plane relies on must refuse them at every sink: PUBLISHED
+    (`PolicyPublisher.publish`, `write_params`), CHECKPOINTED (a real
+    `Checkpointer`), and SERVED (`PolicyStore.swap`). Denormals pass
+    everywhere (no over-firing). `revert=True` no-ops the gates and the
+    checker must CATCH the poison on the far side of each sink."""
+    import jax
+
+    from actor_critic_tpu.algos.traj_queue import PolicyPublisher
+    from actor_critic_tpu.parallel.multihost import (
+        read_params,
+        write_params,
+    )
+    from actor_critic_tpu.serving.policy_store import PolicyStore
+    from actor_critic_tpu.utils.checkpoint import Checkpointer
+
+    rng = random.Random(seed)
+    menu = NONFINITE if revert else (NONFINITE + ("denormal",))
+    poison = menu[rng.randrange(len(menu))]
+    report = {
+        "seed": seed, "scenario": "bf16-update", "poison": poison,
+        "trace": [], "rejections": 0, "refusals": 0, "violations": 0,
+    }
+    cfg, params, opt_state, update, key = _bf16_update_fixture()
+    block = _synth_block(cfg, np.random.default_rng(seed * 47 + 1))
+    new_params, _, metrics = update(
+        params, opt_state, block["obs"], block["action"],
+        block["log_prob"], block["value"], block["reward"],
+        block["done"], block["terminated"], block["final_obs"],
+        block["last_obs"], key,
+    )
+    loss = float(jax.device_get(metrics["loss"]))
+    if not math.isfinite(loss):
+        report["violations"] += 1
+        raise NumSanError(
+            f"seed {seed}: the bf16 update produced a non-finite loss "
+            f"({loss!r}) on CLEAN data — the fp32-accumulator "
+            "discipline is missing/reverted"
+        )
+    good = _numpy_tree(jax.device_get(new_params))
+    poisoned = _numpy_tree(good)
+    path, idx = _poison_tree(poisoned, rng, poison)
+
+    publisher = PolicyPublisher(good, version=1)
+    store = PolicyStore()
+    store.register("default", _TreeStubEngine(), good, version=1)
+    with tempfile.TemporaryDirectory(
+        prefix="numsan_bf16_mbox_"
+    ) as mailbox, tempfile.TemporaryDirectory(
+        prefix="numsan_bf16_ckpt_"
+    ) as ckroot:
+        write_params(mailbox, 0, 1, good)
+        with Checkpointer(ckroot, max_to_keep=2) as ckpt:
+            ckpt.save(0, {"params": good}, force=True)
+            ckpt.wait()
+
+            def attempt(name, fn, counter):
+                try:
+                    fn()
+                except numguard.NonFiniteError:
+                    report[counter] += 1
+                    return "rejected"
+                return "accepted"
+
+            def save_poisoned():
+                ckpt.save(1, {"params": poisoned}, force=True)
+                ckpt.wait()
+
+            sinks = [
+                ("publish",
+                 lambda: publisher.publish(poisoned, 2), "rejections"),
+                ("write_params",
+                 lambda: write_params(mailbox, 0, 2, poisoned),
+                 "rejections"),
+                ("swap",
+                 lambda: store.swap("default", poisoned, version=2),
+                 "rejections"),
+                ("checkpoint", save_poisoned, "refusals"),
+            ]
+            if revert:
+                with _guards_disabled():
+                    for name, fn, counter in sinks:
+                        outcome = attempt(name, fn, counter)
+                        report["trace"].append(
+                            (name, poison, path, idx, outcome)
+                        )
+                # The detector: gates no-op'd, so the nonfinite poison
+                # must now be CAUGHT past every sink.
+                leaked = []
+                if numguard.nonfinite_leaves(publisher.get()[1]):
+                    leaked.append("publisher")
+                out = read_params(mailbox, 0, good)
+                if out is not None and numguard.nonfinite_leaves(out[1]):
+                    leaked.append("mailbox")
+                if numguard.nonfinite_leaves(
+                    dict(store.get("default").params)
+                ):
+                    leaked.append("store")
+                if ckpt.latest_step() == 1 and numguard.nonfinite_leaves(
+                    ckpt.restore({"params": _numpy_tree(good)}, 1)[
+                        "params"
+                    ]
+                ):
+                    leaked.append("checkpoint")
+                if leaked:
+                    report["violations"] += 1
+                    raise NumSanError(
+                        f"seed {seed}: REVERTED GUARD DETECTED — "
+                        f"{poison} poison at {path}[{idx}] of the bf16 "
+                        f"update's params reached {'/'.join(leaked)} "
+                        "with check_finite no-op'd (a diverged bf16 "
+                        "learner must hit the same wall as the fp32 "
+                        "plane)"
+                    )
+                return report
+            for name, fn, counter in sinks:
+                outcome = attempt(name, fn, counter)
+                report["trace"].append((name, poison, path, idx, outcome))
+                if poison in NONFINITE and outcome != "rejected":
+                    report["violations"] += 1
+                    raise NumSanError(
+                        f"seed {seed}: {name} ACCEPTED the bf16 "
+                        f"update's {poison}-poisoned params "
+                        f"({path}[{idx}]) — the finiteness gate is "
+                        "missing/reverted on the bf16 path"
+                    )
+                if poison == "denormal" and outcome != "accepted":
+                    report["violations"] += 1
+                    raise NumSanError(
+                        f"seed {seed}: {name} rejected a denormal from "
+                        "the bf16 update — the gate over-fires"
+                    )
+            if poison in NONFINITE:
+                # every good snapshot must have survived the refusals
+                version, pub = publisher.get()
+                mbox = read_params(mailbox, 0, good)
+                if (
+                    version != 1 or numguard.nonfinite_leaves(pub)
+                    or mbox is None or mbox[0] != 1
+                    or numguard.nonfinite_leaves(mbox[1])
+                    or store.get("default").version != 1
+                    or ckpt.latest_step() != 0
+                ):
+                    raise NumSanError(
+                        f"seed {seed}: a refusal did not preserve the "
+                        "previous good bf16 snapshot"
+                    )
+    return report
+
+
+# ---------------------------------------------------------------------------
 # codec exerciser: saturation semantics, host mirror == device
 # ---------------------------------------------------------------------------
 
@@ -651,13 +875,16 @@ def exercise_sweep(seeds: Iterable[int], scenario) -> dict:
 
 def quick_profile(schedules: int = 16, seed0: int = 0) -> dict:
     """The tier-1 fast profile: `schedules` seeded fault schedules split
-    across the four exercisers — every guard class must both FIRE on
-    nonfinite poisons and stay QUIET on tolerated ones. The update
-    program compiles once per process; everything else is
-    tmpfs/numpy-speed."""
-    n = max(schedules // 4, 1)
+    across the five exercisers — every guard class must both FIRE on
+    nonfinite poisons and stay QUIET on tolerated ones. The two update
+    programs (fp32 and bf16) compile once per process; everything else
+    is tmpfs/numpy-speed."""
+    n = max(schedules // 5, 1)
     update = exercise_sweep(
         range(seed0, seed0 + n), lambda s: exercise_update(s)
+    )
+    bf16 = exercise_sweep(
+        range(seed0, seed0 + n), lambda s: exercise_bf16_update(s)
     )
     publish = exercise_sweep(
         range(seed0, seed0 + n), lambda s: exercise_publish(s)
@@ -666,18 +893,16 @@ def quick_profile(schedules: int = 16, seed0: int = 0) -> dict:
         range(seed0, seed0 + n), lambda s: exercise_checkpoint(s)
     )
     codec = exercise_sweep(
-        range(seed0, seed0 + (schedules - 3 * n)),
+        range(seed0, seed0 + (schedules - 4 * n)),
         lambda s: exercise_codec(s),
     )
+    parts = (update, bf16, publish, checkpoint, codec)
     return {
-        "schedules": sum(
-            x["schedules"] for x in (update, publish, checkpoint, codec)
-        ),
+        "schedules": sum(x["schedules"] for x in parts),
         "update": update,
+        "bf16_update": bf16,
         "publish": publish,
         "checkpoint": checkpoint,
         "codec": codec,
-        "violations": sum(
-            x["violations"] for x in (update, publish, checkpoint, codec)
-        ),
+        "violations": sum(x["violations"] for x in parts),
     }

@@ -61,7 +61,10 @@ bytes / recompiles per steady-state block). The four programs:
 **Reverted modes** prove the meter works, deterministically on every
 run: `revert="host-gather"` re-introduces the pre-PR-13 per-block host
 gather (device_get + re-upload inside the learner scope) and must blow
-the device plane's transfer budget; `revert="uncommit"` installs an
+the device plane's transfer budget; `revert="unfused"` splits the
+ISSUE-19 fused consume back into an advantage program plus an update
+program per block and must blow the fused plane's dispatch budget;
+`revert="uncommit"` installs an
 orbax-restored (committed) tree into the gateway with `prepare=False`
 — dropping `checkpoint.uncommit` from the swap — and the next dispatch
 must blow the 0-recompile budget (committed arrays lower byte-different
@@ -84,6 +87,7 @@ import numpy as np
 PROGRAMS = (
     "ppo_update_host",
     "ppo_update_device",
+    "ppo_update_fused",
     "offpolicy_ingest",
     "serving_dispatch",
     "serving_overlap",
@@ -537,6 +541,107 @@ def exercise_ppo_update_device(
         ring.close()
 
 
+def exercise_ppo_update_fused(
+    blocks: int = 3, seed: int = 0, revert: Optional[str] = None
+) -> dict:
+    """ISSUE 19's fused consume: gather + decode + ADVANTAGES (the
+    `common.gae_targets` seam lowering through the Pallas layer) +
+    update as ONE program under `correction="none"` — the same budget
+    shape as ppo_update_device, now with the advantage scan inside the
+    measured dispatch. `revert="unfused"` splits the advantage
+    computation back out into its own jitted dispatch per block (the
+    pre-ISSUE-19 two-program consume) — 2 dispatches against a budget
+    of 1, caught on every run."""
+    import jax
+    import jax.numpy as jnp
+
+    from actor_critic_tpu.algos import ppo
+    from actor_critic_tpu.algos.common import gae_targets
+    from actor_critic_tpu.data_plane import ring as dp_ring
+
+    spec, cfg, params, opt_state, key = _ppo_fixture()
+    block_spec = ppo.async_block_spec(spec, cfg, 1, "none")
+    ring = dp_ring.DeviceTrajRing(
+        depth=2, block_spec=block_spec, codec="fp32",
+        register_gauge=False,
+    )
+    try:
+        update = ppo.make_device_update_step(
+            spec, cfg, ring.codecs, correction="none"
+        )
+
+        @jax.jit
+        def advantages_only(state, c_slot):
+            # The split-out advantage program the fused path removed:
+            # its existence per consumed block IS the regression.
+            block = dp_ring.gather_block(state, c_slot, ring.codecs)
+            return gae_targets(
+                block["reward"], block["value"], block["done"],
+                block["bootstrap_value"], cfg.gamma, cfg.gae_lambda,
+            )
+
+        def block_for(i):
+            rng = np.random.default_rng(seed + i)
+            block = _ppo_block(cfg, seed + i)
+            T, E = cfg.rollout_steps, cfg.num_envs
+            block["final_values"] = rng.normal(size=(T, E)).astype(
+                np.float32
+            )
+            block["bootstrap_value"] = rng.normal(size=(E,)).astype(
+                np.float32
+            )
+            return block
+
+        # warm both programs (the dispatch meter fires on cache hits)
+        ring.put(block_for(0), version=0)
+        lease = ring.get(timeout=5.0)
+        slot_dev = jax.device_put(np.int32(lease.slot))
+        if revert == "unfused":
+            adv = ring.run(lambda s: advantages_only(s, slot_dev))
+            jax.block_until_ready(adv)
+        out = ring.run(
+            lambda s: update(params, opt_state, s, slot_dev, key)
+        )
+        jax.block_until_ready(out)
+        ring.release(lease)
+
+        per_block = []
+        for i in range(blocks):
+            ring.put(block_for(i + 1), version=i + 1)
+            lease = ring.get(timeout=5.0)
+            with measure(guard="disallow") as c:
+                # jaxlint: disable=transfer-discipline (the ONE
+                # sanctioned transfer — the staged slot scalar, priced
+                # by the meter: this IS the measurement)
+                slot_dev = jax.device_put(np.int32(lease.slot))
+                if revert == "unfused":
+                    adv = ring.run(
+                        lambda s: advantages_only(s, slot_dev)
+                    )
+                    # jaxlint: disable=transfer-discipline (the
+                    # reverted two-dispatch shape under test — its
+                    # extra fence is the regression being metered)
+                    jax.block_until_ready(adv)
+                out = ring.run(
+                    lambda s: update(params, opt_state, s, slot_dev, key)
+                )
+                # jaxlint: disable=transfer-discipline (measurement
+                # fence: the counter window must close on a finished
+                # block, not an enqueued one)
+                jax.block_until_ready(out)
+            ring.release(lease)
+            per_block.append(c)
+        worst = worst_of(per_block)
+        return {
+            "program": "ppo_update_fused",
+            "blocks": blocks,
+            "counters": worst,
+            "per_block": per_block,
+        }
+    finally:
+        ring.close()
+
+
 def exercise_offpolicy_ingest(blocks: int = 3, seed: int = 0) -> dict:
     """DDPG's fused device-plane ingest: gather + decode + scatter into
     the donated replay ring + the whole update loop, ONE program per
@@ -851,6 +956,7 @@ def exercise_mixture_fleet_step(
 _EXERCISERS = {
     "ppo_update_host": exercise_ppo_update_host,
     "ppo_update_device": exercise_ppo_update_device,
+    "ppo_update_fused": exercise_ppo_update_fused,
     "offpolicy_ingest": exercise_offpolicy_ingest,
     "serving_dispatch": exercise_serving_dispatch,
     "serving_overlap": exercise_serving_overlap,
@@ -912,6 +1018,9 @@ def run_reverted(mode: str, manifest_path: Optional[str] = None) -> None:
 
     - "host-gather": the pre-PR-13 per-block host gather inside the
       device-plane learner scope → transfer-budget violation;
+    - "unfused": the pre-ISSUE-19 two-program consume (advantage scan
+      dispatched separately from the update) → dispatch-budget
+      violation;
     - "uncommit": a gateway swap installing a committed orbax restore
       with prepare=False → recompile-budget violation.
     """
@@ -921,6 +1030,13 @@ def run_reverted(mode: str, manifest_path: Optional[str] = None) -> None:
         check_budget("ppo_update_device", report["counters"], budgets)
         raise PerfSanError(
             "host-gather revert escaped the transfer budget — the "
+            "meter is blind"
+        )
+    if mode == "unfused":
+        report = exercise_ppo_update_fused(revert="unfused")
+        check_budget("ppo_update_fused", report["counters"], budgets)
+        raise PerfSanError(
+            "unfused revert escaped the dispatch budget — the "
             "meter is blind"
         )
     if mode == "uncommit":

@@ -1,3 +1,8 @@
+from actor_critic_tpu.ops.pallas_scan import (
+    gae_auto,
+    lambda_returns_auto,
+    vtrace_auto,
+)
 from actor_critic_tpu.ops.polyak import hard_update, polyak_update
 from actor_critic_tpu.ops.returns import (
     VTraceOutput,
@@ -13,10 +18,13 @@ __all__ = [
     "VTraceOutput",
     "discounted_returns",
     "gae",
+    "gae_auto",
     "hard_update",
     "lambda_returns",
+    "lambda_returns_auto",
     "n_step_returns",
     "normalize_advantages",
     "polyak_update",
     "vtrace",
+    "vtrace_auto",
 ]

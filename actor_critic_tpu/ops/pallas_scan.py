@@ -1,4 +1,4 @@
-"""Pallas TPU kernels for the hot trajectory scans (GAE, V-trace).
+"""Pallas TPU kernels for the hot trajectory scans (GAE, λ-returns, V-trace).
 
 The fused trainers spend their non-matmul time in `lax.scan(reverse=True)`
 over T with tiny per-step VPU work (ops/returns.py). These kernels run the
@@ -8,6 +8,12 @@ walks T in-kernel, and the env batch is tiled across the 128-lane axis —
 one kernel launch, three input streams read once, two outputs written
 once, no per-step XLA loop overhead (pallas_guide.md: Grid/BlockSpec,
 Control Flow).
+
+Env batches that are not a multiple of the 128-lane Mosaic tile are
+zero-padded on the env axis before the launch and sliced back after: each
+env column is an independent recurrence, so padded lanes compute junk that
+is finite (all-zero inputs) and discarded. Only a T too long for any
+VMEM-resident tile still falls back to lax.scan.
 
 Numerics match `ops.returns.gae` / `ops.returns.vtrace` exactly (same
 recurrences, f32 accumulation; golden-tested in tests/test_pallas_scan.py
@@ -52,29 +58,50 @@ _VMEM_BUDGET_BYTES = 10 * 2**20
 
 
 # Live (T, be) f32 blocks per op: inputs + outputs + carries.
-_N_ARRAYS = {"gae": 7, "vtrace": 11}
+# "lambda" rides the GAE kernel (same streams; the advantage output is
+# simply discarded), so it prices identically.
+_N_ARRAYS = {"gae": 7, "lambda": 7, "vtrace": 11}
 
 
 def kernel_block(op: str, T: int, E: int, block_envs: int = _DEFAULT_BLOCK_E) -> int:
-    """The env-lane tile the `op` ("gae" | "vtrace") kernel would use on a
-    [T, E] f32 batch — 0 means the call would silently fall back to the
-    lax.scan reference (T too long for any VMEM-resident tile). Public so
-    benches and tests can ASSERT the kernel actually engages before
-    attributing a measurement to it."""
+    """The env-lane tile the `op` ("gae" | "lambda" | "vtrace") kernel
+    would use on a [T, E] f32 batch — 0 means the call would silently fall
+    back to the lax.scan reference (T too long for any VMEM-resident tile;
+    ragged/small E no longer falls back, it is lane-padded to the next
+    128 multiple first). Public so benches and tests can ASSERT the kernel
+    actually engages before attributing a measurement to it."""
     return _pick_block(E, block_envs, T, _N_ARRAYS[op])
 
 
+def _pad_env(E: int) -> int:
+    """E rounded up to the 128-lane f32 Mosaic tile the kernels run on."""
+    return max(-(-E // 128) * 128, 128)
+
+
 def _pick_block(E: int, block_e: int, T: int, n_arrays: int) -> int:
-    """Env-lane tile that (a) divides E, (b) is a multiple of the 128-lane
-    f32 Mosaic tile (narrower/ragged blocks only ever compile on real TPU
-    — CI runs interpret mode — so they'd be untested padding behavior),
-    and (c) keeps n_arrays live (T, be) f32 blocks inside the VMEM budget.
+    """Env-lane tile that (a) divides the LANE-PADDED env batch (`_pad_env`
+    — ragged E is zero-padded before launch, so the tile never sees a
+    partial block), (b) is a multiple of the 128-lane f32 Mosaic tile, and
+    (c) keeps n_arrays live (T, be) f32 blocks inside the VMEM budget.
     Returns 0 if no such tile exists (caller falls back to lax.scan)."""
+    Ep = _pad_env(E)
     max_be = _VMEM_BUDGET_BYTES // (max(T, 1) * 4 * n_arrays)
-    b = (min(block_e, E, max(max_be, 0)) // 128) * 128
-    while b >= 128 and E % b:
+    b = (min(block_e, Ep, max(max_be, 0)) // 128) * 128
+    while b >= 128 and Ep % b:
         b -= 128
     return b if b >= 128 else 0
+
+
+def _pad_lanes(Ep: int, *arrays: jax.Array) -> list[jax.Array]:
+    """Zero-pad the trailing env axis of each [T, E] / [1, E] array to Ep
+    lanes. Zeros are safe: every kernel recurrence is independent per env
+    column, and all-zero inputs produce finite (all-zero or rho=1) junk in
+    the padded lanes, which the caller slices away."""
+    out = []
+    for a in arrays:
+        pad = Ep - a.shape[-1]
+        out.append(jnp.pad(a, ((0, 0), (0, pad))) if pad else a)
+    return out
 
 
 def _gae_kernel(gamma, lam, r_ref, v_ref, d_ref, b_ref, adv_ref, ret_ref):
@@ -114,14 +141,20 @@ def gae(
     be = _pick_block(E, block_envs, T, _N_ARRAYS["gae"])  # 3 in + 2 out + 2 carry
     if be == 0:  # T too long for any VMEM-resident tile
         return _returns.gae(rewards, values, dones, bootstrap_value, gamma, lam)
-    dones = dones.astype(jnp.float32)
-    boot = bootstrap_value.reshape(1, E)
+    Ep = _pad_env(E)
+    rewards, values, dones, boot = _pad_lanes(
+        Ep,
+        rewards,
+        values,
+        dones.astype(jnp.float32),
+        bootstrap_value.reshape(1, E),
+    )
 
     kernel = functools.partial(_gae_kernel, float(gamma), float(lam))
     row = lambda i: (0, i)  # block i owns rows [0,T), env cols [i*be,(i+1)*be)
     adv, ret = pl.pallas_call(
         kernel,
-        grid=(E // be,),
+        grid=(Ep // be,),
         in_specs=[
             pl.BlockSpec((T, be), row, memory_space=pltpu.VMEM),
             pl.BlockSpec((T, be), row, memory_space=pltpu.VMEM),
@@ -133,12 +166,35 @@ def gae(
             pl.BlockSpec((T, be), row, memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((T, E), jnp.float32),
-            jax.ShapeDtypeStruct((T, E), jnp.float32),
+            jax.ShapeDtypeStruct((T, Ep), jnp.float32),
+            jax.ShapeDtypeStruct((T, Ep), jnp.float32),
         ],
         interpret=_use_interpret(),
     )(rewards, values, dones, boot)
-    return adv, ret
+    return (adv[:, :E], ret[:, :E]) if Ep != E else (adv, ret)
+
+
+def lambda_returns(
+    rewards: jax.Array,
+    values: jax.Array,
+    dones: jax.Array,
+    bootstrap_value: jax.Array,
+    gamma: float,
+    lam: float,
+    *,
+    block_envs: int = _DEFAULT_BLOCK_E,
+) -> jax.Array:
+    """Drop-in for `ops.returns.lambda_returns` via the GAE kernel — the
+    λ-return IS the GAE return plane (adv + V), so the same fused program
+    serves both; the advantage output is discarded."""
+    if rewards.ndim != 2 or rewards.dtype != jnp.float32:
+        return _returns.lambda_returns(
+            rewards, values, dones, bootstrap_value, gamma, lam
+        )
+    return gae(
+        rewards, values, dones, bootstrap_value, gamma, lam,
+        block_envs=block_envs,
+    )[1]
 
 
 def gae_auto(*args, **kwargs):
@@ -155,6 +211,14 @@ def gae_auto(*args, **kwargs):
     if _use_interpret():
         return _returns.gae(*args, **kwargs)
     return gae(*map(_detach, args), **kwargs)
+
+
+def lambda_returns_auto(*args, **kwargs):
+    """`lambda_returns` with the same backend dispatch (and input detach
+    rationale) as `gae_auto`."""
+    if _use_interpret():
+        return _returns.lambda_returns(*args, **kwargs)
+    return lambda_returns(*map(_detach, args), **kwargs)
 
 
 def vtrace_auto(*args, **kwargs):
@@ -234,8 +298,16 @@ def vtrace(
             target_log_probs, behaviour_log_probs, rewards, values, dones,
             bootstrap_value, gamma, rho_bar, c_bar, lam,
         )
-    dones = dones.astype(jnp.float32)
-    boot = bootstrap_value.reshape(1, E)
+    Ep = _pad_env(E)
+    tlp, blp, rewards, values, dones, boot = _pad_lanes(
+        Ep,
+        target_log_probs,
+        behaviour_log_probs,
+        rewards,
+        values,
+        dones.astype(jnp.float32),
+        bootstrap_value.reshape(1, E),
+    )
 
     kernel = functools.partial(
         _vtrace_kernel, float(gamma), float(rho_bar), float(c_bar), float(lam)
@@ -244,10 +316,12 @@ def vtrace(
     spec = pl.BlockSpec((T, be), row, memory_space=pltpu.VMEM)
     vs, pg, rho = pl.pallas_call(
         kernel,
-        grid=(E // be,),
+        grid=(Ep // be,),
         in_specs=[spec] * 5 + [pl.BlockSpec((1, be), row, memory_space=pltpu.VMEM)],
         out_specs=[spec] * 3,
-        out_shape=[jax.ShapeDtypeStruct((T, E), jnp.float32)] * 3,
+        out_shape=[jax.ShapeDtypeStruct((T, Ep), jnp.float32)] * 3,
         interpret=_use_interpret(),
-    )(target_log_probs, behaviour_log_probs, rewards, values, dones, boot)
+    )(tlp, blp, rewards, values, dones, boot)
+    if Ep != E:
+        vs, pg, rho = vs[:, :E], pg[:, :E], rho[:, :E]
     return _returns.VTraceOutput(vs=vs, pg_advantages=pg, clipped_rhos=rho)

@@ -174,8 +174,9 @@ def _error_line(msg: str, root: str | None = None) -> str:
 # costs on this host — hence the 480 s default per-metric timeout.
 DEFAULT_CPU_METRICS = (
     "host_pool_scaling,startup_to_first_step,async_decoupling,update_wall,"
-    "replay_sample_throughput,multihost_scaling,serving_latency,"
-    "serving_fleet_scaling,scenario_fleet,consumed_env_steps_per_s"
+    "fused_update_wall,replay_sample_throughput,multihost_scaling,"
+    "serving_latency,serving_fleet_scaling,scenario_fleet,"
+    "consumed_env_steps_per_s"
 )
 
 

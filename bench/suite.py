@@ -15,6 +15,7 @@ host, SURVEY.md §7.0).
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import sys
@@ -583,6 +584,148 @@ def bench_update_wall():
         "vtrace_overhead_x": round(vtrace_s / plain_s, 2),
         "device_plane_ms": round(device_s * 1e3, 2),
         "device_gather_overhead_x": round(device_s / vtrace_s, 2),
+    }
+
+
+def bench_fused_update_wall():
+    """ISSUE 19: the fused consume wall — gather + decode + advantages
+    (the `common.gae_targets` seam lowering through ops/pallas_scan) +
+    update as ONE device-plane program under `correction="none"` —
+    against the same consume with the advantage scan split into its own
+    dispatch (the pre-fusion two-program shape, perfsan's `--revert
+    unfused`), plus the bf16-vs-fp32 host update walls behind
+    `train.py --update-dtype`. CPU numbers run the lax fallback /
+    interpret path (the *_auto contract); the TPU re-measure is the
+    results/pallas_rows_tpu rider."""
+    from actor_critic_tpu.algos import ppo
+    from actor_critic_tpu.algos.common import gae_targets
+    from actor_critic_tpu.analysis import perfsan as _perfsan
+    from actor_critic_tpu.data_plane import ring as dp_ring
+    from actor_critic_tpu.envs.jax_env import EnvSpec
+
+    spec = EnvSpec(
+        obs_shape=(4,), action_dim=2, discrete=True,
+        obs_dtype=np.float32, can_truncate=True,
+    )
+    cfg = ppo.PPOConfig(
+        num_envs=8, rollout_steps=64, epochs=4, num_minibatches=4,
+        hidden=(64, 64),
+    )
+    T, E = cfg.rollout_steps, cfg.num_envs
+    rng = np.random.default_rng(0)
+    key = jax.random.key(0)
+    params, opt_state = ppo.init_host_params(spec, cfg, key)
+    obs = np.asarray(rng.normal(size=(T, E, 4)), np.float32)
+    block = {
+        "obs": obs,
+        "action": rng.integers(0, 2, (T, E)),
+        "log_prob": np.asarray(rng.normal(size=(T, E)) * 0.1 - 0.69,
+                               np.float32),
+        "value": np.asarray(rng.normal(size=(T, E)), np.float32),
+        "reward": np.ones((T, E), np.float32),
+        "done": np.zeros((T, E), np.float32),
+        "terminated": np.zeros((T, E), np.float32),
+        "final_obs": obs.copy(),
+        "last_obs": np.asarray(rng.normal(size=(E, 4)), np.float32),
+        "final_values": np.asarray(rng.normal(size=(T, E)), np.float32),
+        "bootstrap_value": np.asarray(rng.normal(size=(E,)), np.float32),
+    }
+
+    block_spec = ppo.async_block_spec(spec, cfg, 1, "none")
+    ring = dp_ring.DeviceTrajRing(
+        depth=2, block_spec=block_spec, codec="fp32",
+        register_gauge=False,
+    )
+    ring.put(block, version=0)
+    lease = ring.get(timeout=1.0)
+    dev_update = ppo.make_device_update_step(
+        spec, cfg, ring.codecs, correction="none"
+    )
+    slot = np.int32(lease.slot)
+
+    @jax.jit
+    def advantages_only(state, c_slot):
+        blk = dp_ring.gather_block(state, c_slot, ring.codecs)
+        return gae_targets(
+            blk["reward"], blk["value"], blk["done"],
+            blk["bootstrap_value"], cfg.gamma, cfg.gae_lambda,
+        )
+
+    def fused_call():
+        return ring.run(
+            lambda state: dev_update(params, opt_state, state, slot, key)
+        )
+
+    def unfused_call():
+        adv = ring.run(lambda state: advantages_only(state, slot))
+        jax.block_until_ready(adv)
+        return fused_call()
+
+    def timeit(call, reps=20):
+        jax.block_until_ready(call())  # warm
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            jax.block_until_ready(call())
+        return (time.perf_counter() - t0) / reps
+
+    fused_s = timeit(fused_call)
+    unfused_s = timeit(unfused_call)
+
+    # Budget-counter actuals on one fenced fused consume (the meters
+    # perfsan gates tier-1 with). Warm the staged-slot signature first:
+    # the meter reads the C++ fastpath's post_hook, which only fires on
+    # cache-hit dispatches — the timing loop above fed a host scalar,
+    # which is a different jit signature.
+    slot_dev = jax.device_put(np.int32(lease.slot))
+    out = ring.run(
+        lambda state: dev_update(params, opt_state, state, slot_dev, key)
+    )
+    jax.block_until_ready(out)
+    with _perfsan.measure() as c_fused:
+        slot_dev = jax.device_put(np.int32(lease.slot))
+        out = ring.run(
+            lambda state: dev_update(params, opt_state, state, slot_dev, key)
+        )
+        jax.block_until_ready(out)
+    ring.release(lease)
+    ring.close()
+
+    # bf16-vs-fp32 update compute (--update-dtype) on the HOST update
+    # program at the same shape — params/accumulators fp32 both ways.
+    dtype_walls = {}
+    for mode, bf16 in (("fp32", False), ("bf16", True)):
+        mcfg = dataclasses.replace(cfg, bf16_compute=bf16)
+        mparams, mopt = ppo.init_host_params(spec, mcfg, key)
+        update = ppo.make_host_update_step(spec, mcfg)
+        # jaxlint: disable=transfer-discipline (one-time bench staging
+        # per dtype mode, OUTSIDE the timed region)
+        jobs = jnp.asarray(block["obs"])
+        # jaxlint: disable=transfer-discipline (one-time bench staging
+        # per dtype mode, OUTSIDE the timed region)
+        jargs = (
+            mparams, mopt, jobs, jnp.asarray(block["action"]),
+            jnp.asarray(block["log_prob"]), jnp.asarray(block["value"]),
+            jnp.asarray(block["reward"]), jnp.asarray(block["done"]),
+            jnp.asarray(block["terminated"]), jobs,
+            jnp.asarray(block["last_obs"]), key,
+        )
+        dtype_walls[mode] = timeit(lambda: update(*jargs))
+
+    return {
+        "metric": "fused_update_wall",
+        "value": round(fused_s * 1e3, 2),
+        "unit": "ms per fused device-plane consume ([64, 8] block, "
+                "gather + decode + advantages + update, fenced)",
+        "fused_ms": round(fused_s * 1e3, 2),
+        "unfused_ms": round(unfused_s * 1e3, 2),
+        "speedup_x": round(unfused_s / fused_s, 2),
+        "dispatches_per_block": c_fused.dispatches,
+        "transferred_bytes_per_block": c_fused.transferred_bytes,
+        "fp32_ms": round(dtype_walls["fp32"] * 1e3, 2),
+        "bf16_ms": round(dtype_walls["bf16"] * 1e3, 2),
+        "bf16_speedup_x": round(
+            dtype_walls["fp32"] / dtype_walls["bf16"], 2
+        ),
     }
 
 
@@ -1323,6 +1466,7 @@ BENCHES = {
     "host_pool_scaling": bench_host_pool_scaling,
     "async_decoupling": bench_async_decoupling,
     "update_wall": bench_update_wall,
+    "fused_update_wall": bench_fused_update_wall,
     "consumed_env_steps_per_s": bench_data_plane,
     "replay_sample_throughput": bench_replay_sample_throughput,
     "multihost_scaling": bench_multihost_scaling,

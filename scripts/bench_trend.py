@@ -7,9 +7,9 @@ even though PR 6 started attaching a CPU-measured `cpu_metrics` block to
 EVERY record. This script is the second half of ROADMAP's "Bench
 resilience" item: it trends the WHOLE block across rounds, so
 regressions in host_pool_scaling / startup_to_first_step /
-async_decoupling / update_wall / replay_sample_throughput /
-multihost_scaling are visible even across rounds whose TPU headline
-never ran. The multihost record additionally expands into
+async_decoupling / update_wall / fused_update_wall /
+replay_sample_throughput / multihost_scaling are visible even across
+rounds whose TPU headline never ran. The multihost record additionally expands into
 per-process-count sub-rows (its sync scaling curve) and the straggler
 gossip-over-sync ratio.
 
@@ -316,6 +316,18 @@ def update_wall_field_cell(rec: dict | None, field: str) -> str:
     return _numeric_cell(entry.get(field))
 
 
+def fused_update_wall_cell(rec: dict | None, field: str) -> str:
+    """A field of the ISSUE 19 fused-consume record (`fused_ms` /
+    `bf16_ms` / `speedup_x`; `-` before the metric existed, `?`
+    malformed)."""
+    entry, cell = _metric_entry(rec, "fused_update_wall")
+    if entry is None:
+        return cell
+    if field not in entry:
+        return "-"
+    return _numeric_cell(entry.get(field))
+
+
 def data_plane_measured_cell(rec: dict | None, field: str) -> str:
     """A METERED transfer actual from the data-plane record's
     `per_block_transfer_bytes` row (ISSUE 15: `host_measured` /
@@ -449,6 +461,18 @@ def trend_rows(root: str) -> tuple[list[int], list[tuple[str, list[str]]]]:
                 rows.append((
                     f"update_wall.{field}",
                     [update_wall_field_cell(r, field) for r in recs],
+                ))
+        if name == "fused_update_wall":
+            # Fused-consume sub-rows (ISSUE 19): the one-program
+            # gather+decode+advantages+update wall, the bf16 update
+            # wall behind --update-dtype, and the fused-vs-unfused
+            # speedup — so the fusion silently splitting back into two
+            # dispatches (speedup collapsing) or the bf16 path
+            # regressing trends next to the walls they tax.
+            for field in ("fused_ms", "bf16_ms", "speedup_x"):
+                rows.append((
+                    f"fused_update_wall.{field}",
+                    [fused_update_wall_cell(r, field) for r in recs],
                 ))
         if name == "scenario_fleet":
             # Scenario-universe sub-rows (ISSUE 11): the heterogeneous

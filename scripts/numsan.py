@@ -14,6 +14,10 @@ training-serving stack (ISSUE 14).
     python scripts/numsan.py --scenario codec --revert
                                                    # pre-fix wrapping
                                                    # encoder (exit 1)
+    python scripts/numsan.py --scenario bf16-update --revert
+                                                   # reverted gates on
+                                                   # the bf16 update's
+                                                   # params (exit 1)
     python scripts/numsan.py --json                # machine output
 
 Exit codes (scripts/tier1.sh runs the quick profile between fleetsan
@@ -44,7 +48,8 @@ def main(argv=None) -> int:
     p.add_argument(
         "--schedules", type=int, default=16,
         help="seeded fault schedules to sweep (default 16, the tier-1 "
-        "quick profile: split across update/publish/checkpoint/codec)",
+        "quick profile: split across update/bf16-update/publish/"
+        "checkpoint/codec)",
     )
     p.add_argument(
         "--seed0", type=int, default=0,
@@ -54,20 +59,25 @@ def main(argv=None) -> int:
     )
     p.add_argument(
         "--scenario",
-        choices=("all", "update", "publish", "checkpoint", "codec"),
+        choices=(
+            "all", "update", "bf16-update", "publish", "checkpoint",
+            "codec",
+        ),
         default="all",
         help="which unit to exercise (default: the quick profile; "
         "'update' drives the real jitted PPO update + "
-        "DivergenceMonitor, 'publish' the PolicyPublisher/mailbox/"
+        "DivergenceMonitor, 'bf16-update' the bf16_compute update "
+        "program against every publish/checkpoint/serve gate "
+        "(ISSUE 19), 'publish' the PolicyPublisher/mailbox/"
         "PolicyStore gates, 'checkpoint' a real orbax commit, 'codec' "
         "the int8/f16 saturation contract)",
     )
     p.add_argument(
         "--revert", action="store_true",
         help="reverted-guard mode (expected exit 1): no-op the "
-        "check_finite gates (publish/checkpoint) or run the pre-fix "
-        "wrapping encoder (codec) — numsan must detect the leak on "
-        "every schedule",
+        "check_finite gates (publish/checkpoint/bf16-update) or run "
+        "the pre-fix wrapping encoder (codec) — numsan must detect "
+        "the leak on every schedule",
     )
     p.add_argument("--json", action="store_true", help="machine output")
     args = p.parse_args(argv)
@@ -77,8 +87,8 @@ def main(argv=None) -> int:
     if args.revert and args.scenario in ("all", "update"):
         print(
             "numsan: error: --revert needs --scenario "
-            "publish|checkpoint|codec (the update scenario's guard is "
-            "the DivergenceMonitor itself)",
+            "bf16-update|publish|checkpoint|codec (the update "
+            "scenario's guard is the DivergenceMonitor itself)",
             file=sys.stderr,
         )
         return 2
@@ -91,6 +101,9 @@ def main(argv=None) -> int:
         else:
             scenario = {
                 "update": lambda s: numsan.exercise_update(s),
+                "bf16-update": lambda s: numsan.exercise_bf16_update(
+                    s, revert=args.revert
+                ),
                 "publish": lambda s: numsan.exercise_publish(
                     s, revert=args.revert
                 ),

@@ -6,6 +6,8 @@ programs (ISSUE 15).
     python scripts/perfsan.py --program ppo_update_device
     python scripts/perfsan.py --revert host-gather # pre-PR-13 host
                                                    # gather (exit 1)
+    python scripts/perfsan.py --revert unfused     # split advantage
+                                                   # dispatch (exit 1)
     python scripts/perfsan.py --revert uncommit    # uncommit-less swap
                                                    # (exit 1)
     python scripts/perfsan.py --json               # machine output
@@ -47,11 +49,14 @@ def main(argv=None) -> int:
         "offpolicy_ingest / serving_dispatch / mixture_fleet_step)",
     )
     p.add_argument(
-        "--revert", choices=("host-gather", "uncommit"), default=None,
+        "--revert", choices=("host-gather", "unfused", "uncommit"),
+        default=None,
         help="reverted-regression mode (expected exit 1): re-introduce "
-        "the pre-PR-13 per-block host gather, or install a committed "
-        "orbax restore into the gateway without checkpoint.uncommit — "
-        "perfsan must catch either on every run",
+        "the pre-PR-13 per-block host gather, split the ISSUE-19 fused "
+        "consume back into a separate advantage dispatch, or install a "
+        "committed orbax restore into the gateway without "
+        "checkpoint.uncommit — perfsan must catch any of them on every "
+        "run",
     )
     p.add_argument(
         "--manifest", default=None,

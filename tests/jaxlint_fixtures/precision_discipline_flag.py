@@ -31,3 +31,15 @@ def decode(kind, q):
     if kind == "raw":
         return q
     return q.astype(jnp.float32)
+
+
+def update_loss_terms(log_probs, ratio, adv):
+    # the ISSUE 19 update shape, reverted: bf16 activations reach the
+    # loss reductions with NO fp32 accumulator — entropy and the pg
+    # term both accumulate in bf16 and truncate
+    lp = log_probs.astype(jnp.bfloat16)
+    r = ratio.astype(jnp.bfloat16)
+    a = adv.astype(jnp.bfloat16)
+    entropy = -jnp.mean(lp)
+    pg = -jnp.mean(r * a)
+    return pg, entropy

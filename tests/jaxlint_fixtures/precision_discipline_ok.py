@@ -38,3 +38,14 @@ def decode(kind, q):
     if kind == "raw":
         return q.astype(jnp.float32)
     return q.astype(jnp.float32)
+
+
+def update_loss_terms(log_probs, ratio, adv):
+    # ISSUE 19's sanctioned update spelling: bf16 operands are fine as
+    # long as every loss reduction names its fp32 accumulator
+    lp = log_probs.astype(jnp.bfloat16)
+    r = ratio.astype(jnp.bfloat16)
+    a = adv.astype(jnp.bfloat16)
+    entropy = -jnp.mean(lp, dtype=jnp.float32)
+    pg = -jnp.mean(r * a, dtype=jnp.float32)
+    return pg, entropy

@@ -144,6 +144,57 @@ def test_update_wall_budget_counter_sub_rows(tmp_path):
     ]
 
 
+def _write_fused_update_rounds(root: Path):
+    """r01 before the metric existed, r02 a full fused-consume record,
+    r03 malformed (walls are strings / None), r04 a failed subprocess."""
+    (root / "BENCH_r01.json").write_text(json.dumps({
+        "metric": "a2c", "value": 1.0,
+        "cpu_metrics": {"update_wall": {"value": 8.0}},
+    }) + "\n")
+    (root / "BENCH_r02.json").write_text(json.dumps({
+        "metric": "a2c", "value": 1.0,
+        "cpu_metrics": {"fused_update_wall": {
+            "value": 4.2, "fused_ms": 4.2, "unfused_ms": 4.9,
+            "speedup_x": 1.17, "bf16_ms": 3.6, "fp32_ms": 4.1,
+        }},
+    }) + "\n")
+    (root / "BENCH_r03.json").write_text(json.dumps({
+        "metric": "a2c", "value": 1.0,
+        "cpu_metrics": {"fused_update_wall": {
+            "value": 4.3, "fused_ms": "oops", "speedup_x": None,
+            "bf16_ms": {"nested": True},
+        }},
+    }) + "\n")
+    (root / "BENCH_r04.json").write_text(json.dumps({
+        "metric": "a2c", "value": 1.0,
+        "cpu_metrics": {"fused_update_wall": {"error": "rc=1: boom"}},
+    }) + "\n")
+
+
+def test_fused_update_wall_sub_rows(tmp_path):
+    """ISSUE 19 satellite: the fused-consume record expands into
+    fused_ms / bf16_ms / speedup_x sub-rows — '-' before the metric
+    existed, '?' where malformed, 'err' when the subprocess failed."""
+    mod = _load()
+    _write_fused_update_rounds(tmp_path)
+    _rounds, rows = mod.trend_rows(str(tmp_path))
+    table = dict(rows)
+    assert table["fused_update_wall"] == ["-", "4.2", "4.3", "err"]
+    assert table["fused_update_wall.fused_ms"] == ["-", "4.2", "?", "err"]
+    assert table["fused_update_wall.bf16_ms"] == ["-", "3.6", "?", "err"]
+    assert table["fused_update_wall.speedup_x"] == [
+        "-", "1.17", "?", "err",
+    ]
+    # sub-rows sit directly under their parent row
+    labels = [name for name, _ in rows]
+    i = labels.index("fused_update_wall")
+    assert labels[i + 1:i + 4] == [
+        "fused_update_wall.fused_ms",
+        "fused_update_wall.bf16_ms",
+        "fused_update_wall.speedup_x",
+    ]
+
+
 def _write_multihost_rounds(root: Path):
     """r01 without the metric, r02 a full multihost record, r03 a
     malformed one (sync curve not a dict), r04 an unparseable file."""

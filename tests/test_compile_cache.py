@@ -390,6 +390,107 @@ def test_quantized_ingest_warmup_steady_state_zero_recompiles(tmp_path):
     assert counts[-1] == counts[1], records
 
 
+def test_fused_device_update_steady_state_zero_recompiles(tmp_path):
+    """ISSUE 19: the FUSED device-plane consume (ring gather + codec
+    decode + the `common.gae_targets` advantage seam + update, one
+    program under correction='none') keeps the compile-once contract —
+    the registered `ppo.make_device_update_step` planner derives the
+    abstract ring state, warmup's one true compile makes the live
+    loop's first dispatch a persistent-cache hit, and consuming more
+    blocks compiles NOTHING."""
+    _require_introspection()
+    from actor_critic_tpu.algos import ppo
+    from actor_critic_tpu.data_plane import ring as dp_ring
+    from actor_critic_tpu.envs.jax_env import EnvSpec
+
+    spec = EnvSpec(
+        obs_shape=(4,), action_dim=2, discrete=True,
+        obs_dtype=np.float32, can_truncate=True,
+    )
+    cfg = ppo.PPOConfig(
+        num_envs=4, rollout_steps=8, epochs=1, num_minibatches=1,
+        hidden=(16,),
+    )
+    with compile_cache.temporary_cache(tmp_path / "cc"):
+        ctx = compile_cache.WarmupContext(
+            algo="ppo", fused=False, spec=spec, cfg=cfg,
+            eval_every=0, overlap=True, async_actors=1,
+            async_correction="none", data_plane="device",
+            plane_codec="fp32", queue_depth=2,
+        )
+        plan = compile_cache.plan_warmup(ctx)
+        fused_entries = [
+            e for e in plan if e[0] == "ppo.make_device_update_step"
+        ]
+        assert fused_entries, [n for n, _ in plan]
+        n0 = profiler.compile_event_count()
+        runner = compile_cache.WarmupRunner(fused_entries).start()
+        assert runner.wait(300) and "error" not in runner.results[0], (
+            runner.results
+        )
+
+        # The live loop's own jit object (fresh trace, same HLO).
+        block_spec = ppo.async_block_spec(spec, cfg, 1, "none")
+        ring = dp_ring.DeviceTrajRing(
+            depth=2, block_spec=block_spec, codec="fp32",
+            register_gauge=False,
+        )
+        try:
+            update = ppo.make_device_update_step(
+                spec, cfg, ring.codecs, correction="none"
+            )
+            key = jax.random.key(0)
+            params, opt_state = ppo.init_host_params(spec, cfg, key)
+            T, E = cfg.rollout_steps, cfg.num_envs
+
+            def block_for(i):
+                rng = np.random.default_rng(i)
+                obs = rng.normal(size=(T, E, 4)).astype(np.float32)
+                return {
+                    "obs": obs,
+                    "action": rng.integers(0, 2, (T, E)),
+                    "log_prob": (
+                        rng.normal(size=(T, E)) * 0.1 - 0.69
+                    ).astype(np.float32),
+                    "value": rng.normal(size=(T, E)).astype(np.float32),
+                    "reward": np.ones((T, E), np.float32),
+                    "done": np.zeros((T, E), np.float32),
+                    "terminated": np.zeros((T, E), np.float32),
+                    "final_obs": obs.copy(),
+                    "last_obs": rng.normal(size=(E, 4)).astype(
+                        np.float32
+                    ),
+                    "final_values": rng.normal(size=(T, E)).astype(
+                        np.float32
+                    ),
+                    "bootstrap_value": rng.normal(size=(E,)).astype(
+                        np.float32
+                    ),
+                }
+
+            counts = []
+            for i in range(4):
+                ring.put(block_for(i), version=i)
+                lease = ring.get(timeout=5.0)
+                slot_dev = jax.device_put(np.int32(lease.slot))
+                out = ring.run(
+                    lambda s: update(params, opt_state, s, slot_dev, key)
+                )
+                jax.block_until_ready(out)
+                ring.release(lease)
+                counts.append(profiler.compile_event_count())
+        finally:
+            ring.close()
+
+    records = _new_records(n0)
+    evs = [r for r in records if r["name"] == "jit_device_update"]
+    real = [r for r in evs if not r.get("cache_hit")]
+    assert len(real) == 1, evs          # warmup's one true compile
+    assert any(r.get("cache_hit") for r in evs), evs  # live loop hit it
+    # Steady state: blocks past the first compile NOTHING.
+    assert counts[-1] == counts[0], records
+
+
 def test_restore_normalizes_for_compile_cache(tmp_path):
     """A restored state must (a) carry UNCOMMITTED, XLA-owned leaves —
     orbax's committed arrays lower byte-different HLO (per-arg

@@ -987,6 +987,50 @@ def test_bf16_accumulator_revert_trips_precision_discipline(tmp_path):
     assert _run_snippet(tmp_path, fixed) == []
 
 
+# The per-algo loss reductions exactly as they would read with
+# ISSUE 19's fp32 accumulators dropped: under --update-dtype bf16 the
+# activations reach every jnp.mean bare and the entropy/pg terms
+# accumulate in bf16.
+_PRE_FIX_UPDATE_LOSS = (
+    "import jax.numpy as jnp\n"
+    "def update_loss(log_probs_f32, ratio_f32, adv_f32):\n"
+    "    log_probs = log_probs_f32.astype(jnp.bfloat16)\n"
+    "    ratio = ratio_f32.astype(jnp.bfloat16)\n"
+    "    adv = adv_f32.astype(jnp.bfloat16)\n"
+    "    entropy = -jnp.mean(log_probs)\n"
+    "    pg_loss = -jnp.mean(ratio * adv)\n"
+    "    return pg_loss + entropy\n"
+)
+
+
+def test_update_loss_accumulator_revert_trips_precision_discipline(
+    tmp_path,
+):
+    """ISSUE 19: dropping the explicit fp32 accumulators from the
+    update-shaped loss reductions is caught per-site, and the LANDED
+    per-algo loss modules (which spell every reduction with
+    dtype=jnp.float32) sweep clean."""
+    flagged = _run_snippet(tmp_path, _PRE_FIX_UPDATE_LOSS)
+    assert flagged and all(
+        f.check == "precision-discipline" for f in flagged
+    )
+    assert sum(
+        "accumulate" in f.message.lower() for f in flagged
+    ) == 2  # one finding per bare reduction: entropy AND pg_loss
+    assert (
+        analysis.analyze_paths(
+            [
+                "actor_critic_tpu/algos/ppo.py",
+                "actor_critic_tpu/algos/a2c.py",
+                "actor_critic_tpu/algos/impala.py",
+            ],
+            str(REPO),
+            checks=["precision-discipline"],
+        )
+        == []
+    )
+
+
 # telemetry/sampler._emit as it was BEFORE the ISSUE 14 fix: the strict
 # allow_nan=False dumps — one NaN gauge raises ValueError on every tick
 # and resource sampling silently ends for the rest of the run.

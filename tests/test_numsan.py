@@ -33,12 +33,19 @@ def _load_cli():
 
 
 def test_quick_profile_sweeps_clean():
-    out = numsan.quick_profile(schedules=8, seed0=0)
+    out = numsan.quick_profile(schedules=10, seed0=0)
     assert out["violations"] == 0
-    assert out["schedules"] == 8
-    # at least one guard of each publish/checkpoint shape fired across
-    # the sweep (nonfinite poisons dominate the menu)
-    assert out["publish"]["rejections"] + out["checkpoint"]["refusals"] > 0
+    assert out["schedules"] == 10
+    # at least one publish/checkpoint-shaped guard fired across the
+    # sweep (nonfinite poisons dominate the menus; the bf16-update
+    # schedules drive the same sinks)
+    fired = (
+        out["publish"]["rejections"]
+        + out["checkpoint"]["refusals"]
+        + out["bf16_update"]["rejections"]
+        + out["bf16_update"]["refusals"]
+    )
+    assert fired > 0
 
 
 def test_update_poisons_fire_divergence_monitor():
@@ -49,6 +56,20 @@ def test_update_poisons_fire_divergence_monitor():
     )
     assert out["violations"] == 0
     assert out["divergence_events"] > 0
+
+
+def test_bf16_update_poisons_refused_at_every_sink():
+    """ISSUE 19: the bf16_compute update program's poisoned params must
+    be refused by publish/mailbox/swap/checkpoint exactly like the fp32
+    plane's — and the clean bf16 loss itself must be finite (the
+    fp32-accumulator discipline)."""
+    out = numsan.exercise_sweep(
+        range(0, 4), lambda s: numsan.exercise_bf16_update(s)
+    )
+    assert out["violations"] == 0
+    # nonfinite poisons dominate the menu: the rejection/refusal
+    # counters must have fired across the sweep
+    assert out["rejections"] + out["refusals"] > 0
 
 
 def test_codec_saturations_observed():
@@ -68,6 +89,7 @@ def test_codec_saturations_observed():
     "fn",
     [
         numsan.exercise_update,
+        numsan.exercise_bf16_update,
         numsan.exercise_publish,
         numsan.exercise_checkpoint,
         numsan.exercise_codec,
@@ -98,6 +120,12 @@ def test_reverted_publish_guard_detected(seed):
 def test_reverted_checkpoint_guard_detected(seed):
     with pytest.raises(numsan.NumSanError, match="REVERTED GUARD"):
         numsan.exercise_checkpoint(seed, revert=True)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_reverted_bf16_update_guard_detected(seed):
+    with pytest.raises(numsan.NumSanError, match="REVERTED GUARD"):
+        numsan.exercise_bf16_update(seed, revert=True)
 
 
 @pytest.mark.parametrize("seed", [0, 1, 2])
@@ -156,6 +184,9 @@ def test_cli_exit_codes(capsys):
     ) == 1
     assert cli.main(
         ["--scenario", "publish", "--revert", "--schedules", "2"]
+    ) == 1
+    assert cli.main(
+        ["--scenario", "bf16-update", "--revert", "--schedules", "2"]
     ) == 1
     # --revert without a gated scenario is a usage crash, not a clean run
     assert cli.main(["--revert"]) == 2

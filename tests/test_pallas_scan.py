@@ -42,12 +42,15 @@ def test_gae_multi_block(traj):
     np.testing.assert_allclose(np.asarray(adv), np.asarray(adv_g), rtol=1e-6, atol=1e-6)
 
 
-def test_gae_small_batch_fallback_block(traj):
-    """E not divisible by the default block → smaller power-of-two block."""
+def test_gae_small_batch_lane_padded(traj):
+    """E below one 128-lane tile → zero-padded to one tile, sliced back;
+    the kernel must ENGAGE (ISSUE 19), not silently fall back."""
     rewards, values, dones = (a[:, :96] for a in traj[:3])
     bootstrap = traj[3][:96]
+    assert pallas_scan.kernel_block("gae", rewards.shape[0], 96) == 128
     adv_g, _ = returns.gae(rewards, values, dones, bootstrap, GAMMA, LAM)
     adv, _ = pallas_scan.gae(rewards, values, dones, bootstrap, GAMMA, LAM)
+    assert adv.shape == rewards.shape
     np.testing.assert_allclose(np.asarray(adv), np.asarray(adv_g), rtol=1e-6, atol=1e-6)
 
 
@@ -121,7 +124,95 @@ def test_kernel_block_engagement():
     assert ps.kernel_block("vtrace", 1024, 256) == 128
     # 7-array GAE fits at T=2048.
     assert ps.kernel_block("gae", 2048, 256) == 128
+    # λ-returns ride the GAE kernel, so they price identically.
+    assert ps.kernel_block("lambda", 2048, 256) == 128
     # Headline trainer shape: full default tile.
     assert ps.kernel_block("gae", 32, 4096) == 512
-    # E not a multiple of 128 → no legal tile.
-    assert ps.kernel_block("gae", 32, 100) == 0
+    # Ragged/small E lane-pads to the next 128 multiple (ISSUE 19):
+    # the kernel now ENGAGES instead of silently falling back.
+    assert ps.kernel_block("gae", 32, 100) == 128
+    assert ps.kernel_block("gae", 32, 8) == 128
+    assert ps.kernel_block("vtrace", 64, 200) == 256  # pads 200 → 256, one block
+    # Only an impossible T still reports the lax.scan fallback.
+    assert ps.kernel_block("gae", 1 << 20, 256) == 0
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 19 boundary-shape golden parity: T=1, E below one lane tile,
+# non-divisible E/block, and done-at-t0, for all three fused scans.
+# ---------------------------------------------------------------------------
+
+
+def _rand_batch(T, E, seed=7):
+    rng = np.random.default_rng(seed)
+    rewards = jnp.asarray(rng.normal(size=(T, E)), jnp.float32)
+    values = jnp.asarray(rng.normal(size=(T, E)), jnp.float32)
+    dones = jnp.asarray(rng.random(size=(T, E)) < 0.15, jnp.float32)
+    bootstrap = jnp.asarray(rng.normal(size=(E,)), jnp.float32)
+    return rewards, values, dones, bootstrap
+
+
+@pytest.mark.parametrize(
+    "T,E",
+    [(1, 128), (1, 7), (5, 96), (3, 300), (17, 640)],
+    ids=["T1-tile", "T1-tiny", "E-sub-tile", "E-ragged", "E-nondiv-block"],
+)
+def test_boundary_shapes_gae_lambda_golden(T, E):
+    rewards, values, dones, bootstrap = _rand_batch(T, E)
+    adv_g, ret_g = returns.gae(rewards, values, dones, bootstrap, GAMMA, LAM)
+    adv, ret = pallas_scan.gae(rewards, values, dones, bootstrap, GAMMA, LAM)
+    np.testing.assert_allclose(np.asarray(adv), np.asarray(adv_g), rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(ret), np.asarray(ret_g), rtol=1e-6, atol=1e-6)
+    lam_g = returns.lambda_returns(rewards, values, dones, bootstrap, GAMMA, LAM)
+    lam_k = pallas_scan.lambda_returns(rewards, values, dones, bootstrap, GAMMA, LAM)
+    assert lam_k.shape == (T, E)
+    np.testing.assert_allclose(np.asarray(lam_k), np.asarray(lam_g), rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize(
+    "T,E", [(1, 128), (1, 7), (5, 96), (3, 300)],
+    ids=["T1-tile", "T1-tiny", "E-sub-tile", "E-ragged"],
+)
+def test_boundary_shapes_vtrace_golden(T, E):
+    rewards, values, dones, bootstrap = _rand_batch(T, E, seed=11)
+    rng = np.random.default_rng(13)
+    tlp = jnp.asarray(rng.normal(size=(T, E)) * 0.3, jnp.float32)
+    blp = jnp.asarray(rng.normal(size=(T, E)) * 0.3, jnp.float32)
+    golden = returns.vtrace(tlp, blp, rewards, values, dones, bootstrap,
+                            GAMMA, rho_bar=1.0, c_bar=1.0, lam=0.9)
+    got = pallas_scan.vtrace(tlp, blp, rewards, values, dones, bootstrap,
+                             GAMMA, rho_bar=1.0, c_bar=1.0, lam=0.9)
+    for name in ("vs", "pg_advantages", "clipped_rhos"):
+        assert getattr(got, name).shape == (T, E)
+        np.testing.assert_allclose(
+            np.asarray(getattr(got, name)), np.asarray(getattr(golden, name)),
+            rtol=1e-5, atol=1e-6, err_msg=name,
+        )
+
+
+def test_done_at_t0_golden():
+    """done on the very first row must cut the recurrence exactly as the
+    lax reference does (the carry enters the loop non-zero)."""
+    T, E = 4, 128
+    rewards, values, _, bootstrap = _rand_batch(T, E, seed=17)
+    dones = jnp.zeros((T, E), jnp.float32).at[0, :].set(1.0)
+    adv_g, ret_g = returns.gae(rewards, values, dones, bootstrap, GAMMA, LAM)
+    adv, ret = pallas_scan.gae(rewards, values, dones, bootstrap, GAMMA, LAM)
+    np.testing.assert_allclose(np.asarray(adv), np.asarray(adv_g), rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(ret), np.asarray(ret_g), rtol=1e-6, atol=1e-6)
+    got = pallas_scan.vtrace(values * 0.1, rewards * 0.1, rewards, values,
+                             dones, bootstrap, GAMMA)
+    golden = returns.vtrace(values * 0.1, rewards * 0.1, rewards, values,
+                            dones, bootstrap, GAMMA)
+    np.testing.assert_allclose(np.asarray(got.vs), np.asarray(golden.vs),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_lambda_returns_auto_dispatch(traj):
+    """lambda_returns_auto falls back to the lax reference off-TPU and
+    matches it bitwise there (the interpret-mode kernel is test-only)."""
+    rewards, values, dones, bootstrap = traj
+    got = pallas_scan.lambda_returns_auto(rewards, values, dones, bootstrap,
+                                          GAMMA, LAM)
+    ref = returns.lambda_returns(rewards, values, dones, bootstrap, GAMMA, LAM)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))

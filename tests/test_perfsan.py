@@ -80,6 +80,19 @@ def test_ppo_update_device_within_budget_and_zero_transfer():
     ]
 
 
+def test_ppo_update_fused_within_budget():
+    """ISSUE 19: the fused consume (gather + decode + advantages via the
+    common.gae_targets seam + update, correction='none') meters the SAME
+    one-program contract as the device plane — the advantage scan costs
+    no extra dispatch, crossing, or recompile."""
+    report = perfsan.run_program("ppo_update_fused", _budgets())
+    c = report["counters"]
+    assert c.dispatches == 1
+    assert c.transfers == 1
+    assert c.transferred_bytes == 4
+    assert c.recompiles == 0
+
+
 def test_offpolicy_ingest_within_budget():
     report = perfsan.run_program("offpolicy_ingest", _budgets())
     assert report["counters"].dispatches == 1
@@ -207,6 +220,17 @@ def test_reverted_host_gather_detected(run):
         perfsan.run_reverted("host-gather", str(MANIFEST))
 
 
+@pytest.mark.parametrize("run", [0, 1])
+def test_reverted_unfused_detected(run):
+    """Splitting the advantage program back out of the fused consume
+    (the pre-ISSUE-19 two-dispatch shape) trips the dispatch budget on
+    every run."""
+    with pytest.raises(
+        perfsan.PerfSanError, match="max_dispatches_per_block"
+    ):
+        perfsan.run_reverted("unfused", str(MANIFEST))
+
+
 def test_reverted_uncommit_detected():
     with pytest.raises(
         perfsan.PerfSanError, match="max_recompiles"
@@ -279,6 +303,9 @@ def test_cli_exit_codes(capsys, tmp_path):
 def test_cli_revert_modes_exit_one(capsys):
     cli = _load_cli()
     assert cli.main(["--revert", "uncommit"]) == 1
+    out = capsys.readouterr()
+    assert "VIOLATION DETECTED" in out.err
+    assert cli.main(["--revert", "unfused"]) == 1
     out = capsys.readouterr()
     assert "VIOLATION DETECTED" in out.err
 

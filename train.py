@@ -938,6 +938,15 @@ def main(argv=None) -> int:
         "--set replay_dtype=...; never flip it on a resumed run whose "
         "checkpoint carries a full ring (the template dtype must match).",
     )
+    p.add_argument(
+        "--update-dtype", choices=("fp32", "bf16"), default=None,
+        help="update-compute precision (ISSUE 19). 'bf16' runs the "
+        "network torso/head matmuls in bfloat16 with params, optimizer "
+        "state, and every loss reduction kept fp32 (explicit fp32 "
+        "accumulators; the heads cast outputs up before the loss); "
+        "default fp32. Equivalent to --set bf16_compute=true. Eval "
+        "parity vs fp32 is gated per algo in tests/test_bf16.py.",
+    )
     p.add_argument("--quiet", action="store_true", help="no stdout metric echo")
     p.add_argument(
         "--no-overlap", action="store_true",
@@ -1030,6 +1039,18 @@ def main(argv=None) -> int:
             preset,
             config=dataclasses.replace(
                 preset.config, replay_dtype=args.replay_dtype
+            ),
+        )
+    if args.update_dtype is not None:
+        if not hasattr(preset.config, "bf16_compute"):
+            raise SystemExit(
+                f"--update-dtype has no effect on {preset.algo}: its "
+                "config carries no bf16_compute switch"
+            )
+        preset = dataclasses.replace(
+            preset,
+            config=dataclasses.replace(
+                preset.config, bf16_compute=(args.update_dtype == "bf16")
             ),
         )
     if args.iterations is None:
