@@ -1,13 +1,13 @@
 """jaxlint: repo-wide JAX correctness analyzer (ISSUE 5, extended with
 concurrency passes + racesan in ISSUE 7, distributed passes + fleetsan
-in ISSUE 12, numerics passes + numsan in ISSUE 14, and performance
-passes + perfsan in ISSUE 15).
+in ISSUE 12, numerics passes + numsan in ISSUE 14, performance passes
++ perfsan in ISSUE 15, and shape/padding passes + padsan in ISSUE 20).
 
 AST-based static analysis over this repo's JAX code — pure stdlib
 `ast`, no new dependencies, and (except the `warmup-registry` pass,
 which validates against the live registry, and the numerics passes'
 optional `jax.eval_shape` grounding) no imports of the code it scans.
-Eighteen registered passes, each grounded in a failure this codebase
+Twenty-one registered passes, each grounded in a failure this codebase
 actually hit or observes at runtime:
 
     donation-aliasing     donated jit args fed restore-aliased/still-
@@ -50,14 +50,25 @@ actually hit or observes at runtime:
     dispatch-granularity  Python reductions over device values, eager
                           device math, and multi-program chains inside
                           per-step loops — one fused program's work
+    pad-mask-discipline   reductions over a padding-widened axis with
+                          neither a mask multiply/where nor a
+                          valid-slice (shape_model.py)
+    mask-propagation      padded arrays crossing function/jit seams
+                          without their mask riding along or a
+                          downstream slice-back
+    slice-before-commit   padded buffers reaching commit points
+                          (publish/save/enqueue/serving response)
+                          with their junk lanes intact
 
 Runtime companions, each gating tier-1 under its own timeout:
 `analysis/racesan.py` (seeded cooperative-schedule race exerciser),
 `analysis/fleetsan.py` (seeded multi-process chaos),
 `analysis/numsan.py` (seeded NaN/Inf/saturation fault injection over
-the real update/codec/publish/checkpoint objects), and
+the real update/codec/publish/checkpoint objects),
 `analysis/perfsan.py` (dispatch/transfer/recompile budget metering of
-the real steady-state programs against `perf_budgets.json`).
+the real steady-state programs against `perf_budgets.json`), and
+`analysis/padsan.py` (seeded padding-lane poisoner asserting valid-lane
+outputs of the real padded programs are bitwise pad-invariant).
 
 CLI: `python scripts/jaxlint.py` (tier-1-gated via
 tests/test_jaxlint.py and scripts/tier1.sh). Per-line suppression:

@@ -389,6 +389,7 @@ def _ensure_builtin_checks() -> None:
         perf,
         prng,
         recompile,
+        shapes,
         tracer_leak,
         warmup,
     )

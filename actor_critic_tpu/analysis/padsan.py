@@ -1,0 +1,915 @@
+"""padsan: deterministic padding-lane poison sanitizer (ISSUE 20
+runtime half).
+
+numsan proved the stack's response to poisoned VALUES; this module
+proves the stack's *indifference* to poisoned PADDING. Every
+shape-stabilization seam in the repo widens a ragged batch to a
+compiled shape — bucket rows (`pad_to_bucket`), Mosaic lanes
+(`pallas_scan._pad_lanes`), parked mixture members, fixed-shape
+data-plane slots, masked chunk tails — and the mask discipline the
+static passes lint (`pad-mask-discipline` / `mask-propagation` /
+`slice-before-commit` in analysis/shapes.py) claims the junk lanes are
+NEVER observable. padsan tests that claim the only way it can be
+tested: run each REAL steady-state program TWICE per seeded schedule —
+once with the pad lanes zeroed (the production fill) and once with
+them poisoned from the menu
+
+    nan      quiet NaN (the loudest possible junk: one leak NaN-ifies
+             a reduction)
+    big      +3e38 (near-f32-max: overflows any sum it touches)
+    -big     -3e38
+    int8sat  127.0, and the int-storage saturation point (±127/-128)
+             for integer lanes the float menu cannot express
+
+— and assert the valid-lane outputs are BITWISE identical. Zero vs
+NaN vs 3e38 in a lane that is truly masked/sliced/unselected cannot
+change a single output byte; any divergence is a junk-lane leak and
+raises `PadSanError` naming the seed/scenario/poison for replay.
+
+The five guarded programs (the steady-state paths, not toys):
+
+- **chunked** — `make_chunked_step(...).masked`: the tail/realignment
+  dispatch pads to the full stride and cuts with a traced `n_valid`;
+  poisoned post-`n_valid` scan slots are computed-then-discarded by a
+  select, which must be lane-exact even for NaN.
+- **pallas** — the `ops.pallas_scan` GAE/λ/V-trace kernels at ragged
+  E ∈ {7, 96, 200} (lane-padded to 128/128/256): poison is injected
+  through the `_pad_lanes` seam and the sliced [:, :E] outputs must
+  not move (per-env-column recurrences are independent by design).
+- **mixture** — the heterogeneous fleet's `lax.switch` step: the
+  3 parked member states are poison-filled and the live member's
+  transition plus the mask-multiplied padded obs must be unchanged.
+- **serving** — `PolicyEngine.act` across buckets with ragged n
+  (standby backfill rows): poison rides the `pad_to_bucket` seam and
+  the first-n actions must match the zero-fill dispatch bitwise.
+- **device-plane** — `DeviceTrajRing` + in-jit `gather_block`: every
+  slot EXCEPT the leased one is poison-filled and the gathered decode
+  must be unchanged (the slot gather reads exactly one row).
+
+Every schedule also routes a guard summary of the padded buffer
+through the `masked_summary` seam (the sanctioned where-select masked
+mean). **Reverted modes** prove the detectors work: `revert=
+"unmasked-mean"` swaps the seam for a plain mean — the zero-fill and
+poison-fill summaries then differ on every schedule and padsan must
+CATCH it; `revert="no-slice"` (pallas, serving) compares the FULL
+padded width instead of the valid slice — the junk lanes differ by
+construction and must be caught. Both are regression-tested like
+racesan/numsan/perfsan's reverted modes.
+
+A clean schedule appends to `report["trace"]`, and `report["digest"]`
+is a sha256 over the trace that is bit-identical per seed (same seed →
+same poisons, same lanes, same observed bytes — replay a named seed to
+reproduce). `quick_profile` is the fixed-seed sweep `scripts/tier1.sh`
+runs between perfsan and the multihost smoke, under its own timeout.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+from typing import Iterable, Optional
+
+import numpy as np
+
+POISONS = ("nan", "big", "-big", "int8sat")
+_VALUES = {
+    "nan": float("nan"),
+    "big": 3.0e38,
+    "-big": -3.0e38,
+    "int8sat": 127.0,
+}
+
+# Which reverted-guard modes each scenario supports: every scenario
+# carries a masked summary (so unmasked-mean is universal); only the
+# two slice-back seams have a full-width output to "forget" to slice.
+SCENARIO_REVERTS = {
+    "chunked": ("unmasked-mean",),
+    "pallas": ("unmasked-mean", "no-slice"),
+    "mixture": ("unmasked-mean",),
+    "serving": ("unmasked-mean", "no-slice"),
+    "device-plane": ("unmasked-mean",),
+}
+
+
+class PadSanError(RuntimeError):
+    """A junk lane leaked into a valid-lane output — or a reverted
+    mask/slice guard's leak was detected (the sanitizer working)."""
+
+
+def _check_revert(scenario: str, revert: Optional[str]) -> None:
+    if revert is not None and revert not in SCENARIO_REVERTS[scenario]:
+        raise ValueError(
+            f"scenario {scenario!r} supports revert modes "
+            f"{SCENARIO_REVERTS[scenario]}, got {revert!r}"
+        )
+
+
+def _fill(poison: str, dtype) -> float:
+    """The poison fill for one storage dtype. Float lanes take the menu
+    value; integer lanes (int8 ring storage, int action planes) take
+    the dtype's saturation point — NaN/3e38 are not representable and a
+    silent numpy wrap would make the poison seed-dependent garbage."""
+    dt = np.dtype(dtype)
+    if np.issubdtype(dt, np.floating):
+        return _VALUES[poison]
+    info = np.iinfo(dt)
+    return float(info.min if poison == "-big" else info.max)
+
+
+def masked_summary(x, mask, revert: Optional[str] = None) -> bytes:
+    """The guard summary every schedule routes its padded buffer
+    through: a where-select masked mean (the idiom
+    `pad-mask-discipline` sanctions — NaN-safe, a multiply-mask would
+    propagate 0*NaN). Returns the f64 BYTES so the A/B comparison is
+    bitwise, NaN included. `revert="unmasked-mean"` is the reverted
+    guard: a plain mean that reads the junk lanes."""
+    x = np.asarray(x, np.float64)
+    mask = np.broadcast_to(np.asarray(mask, np.float64), x.shape)
+    if revert == "unmasked-mean":
+        out = np.float64(np.mean(x))
+    else:
+        kept = np.where(mask > 0.0, x, 0.0)
+        out = np.float64(np.sum(kept) / max(float(np.sum(mask)), 1.0))
+    return out.tobytes()
+
+
+def _assert_bitwise(a, b, what: str, seed: int, scenario: str,
+                    poison: str, report: dict) -> None:
+    a, b = np.asarray(a), np.asarray(b)
+    same = (
+        a.dtype == b.dtype and a.shape == b.shape
+        and a.tobytes() == b.tobytes()
+    )
+    if not same:
+        report["violations"] += 1
+        raise PadSanError(
+            f"seed {seed}: {scenario}/{poison} poison LEAKED into "
+            f"{what} — zero-fill and poison-fill runs differ "
+            "(a junk lane is observable; the mask/slice/select "
+            "discipline is broken at this seam)"
+        )
+
+
+def _assert_summary(sa: bytes, sb: bytes, seed: int, scenario: str,
+                    poison: str, revert: Optional[str],
+                    report: dict) -> None:
+    """The masked-summary detector: under the real seam A == B; under
+    the reverted unmasked mean the poison is visible and MUST differ."""
+    if revert == "unmasked-mean":
+        if sa != sb:
+            report["violations"] += 1
+            raise PadSanError(
+                f"seed {seed}: REVERTED GUARD DETECTED — the unmasked "
+                f"mean read the {poison} junk lanes of the {scenario} "
+                "pad buffer (zero-fill and poison-fill summaries "
+                "differ); the masked where-select summary is the only "
+                "thing keeping pad lanes unobservable"
+            )
+        raise PadSanError(  # pragma: no cover - poison fills are nonzero
+            f"seed {seed}: {scenario} unmasked-mean revert NOT caught"
+        )
+    if sa != sb:
+        report["violations"] += 1
+        raise PadSanError(
+            f"seed {seed}: {scenario}/{poison} poison moved the MASKED "
+            "summary — the where-select mask is not covering the pad "
+            "lanes"
+        )
+
+
+def _is_float_leaf(a) -> bool:
+    """True for float-dtype array leaves; typed PRNG keys (whose
+    extended dtype `np.dtype` rejects) and int/bool leaves are not
+    poison targets."""
+    try:
+        return np.issubdtype(np.dtype(a.dtype), np.floating)
+    except TypeError:
+        return False
+
+
+def _leaf_np(leaf):
+    """Host bytes of one pytree leaf — typed PRNG keys go through
+    `key_data` so they stay byte-comparable."""
+    import jax
+
+    try:
+        np.dtype(leaf.dtype)
+    except TypeError:
+        leaf = jax.random.key_data(leaf)
+    return np.asarray(jax.device_get(leaf))
+
+
+def _digest(report: dict) -> str:
+    return hashlib.sha256(
+        repr((report["seed"], report["scenario"], report["trace"]))
+        .encode()
+    ).hexdigest()
+
+
+def _sha(a) -> str:
+    a = np.asarray(a)
+    return hashlib.sha256(a.tobytes()).hexdigest()[:16]
+
+
+# ---------------------------------------------------------------------------
+# chunked exerciser: make_chunked_step's masked tail program
+# ---------------------------------------------------------------------------
+
+_CHUNK_STRIDE, _CHUNK_D = 8, 6
+_CHUNK_FIXTURE = None
+
+
+def _chunk_fixture():
+    """One REAL masked chunk program (compile_cache.make_chunked_step),
+    compiled once per process: state carries the per-slot input plane
+    `xs` so poisoned post-`n_valid` rows flow through the
+    computed-then-discarded branch of the select."""
+    global _CHUNK_FIXTURE
+    if _CHUNK_FIXTURE is not None:
+        return _CHUNK_FIXTURE
+    import jax.numpy as jnp
+
+    from actor_critic_tpu.utils import compile_cache
+
+    def raw_step(s):
+        x = s["xs"][s["i"]]
+        acc = s["acc"] + jnp.tanh(x) * 0.5
+        new = {"i": s["i"] + 1, "xs": s["xs"], "acc": acc}
+        return new, {"acc_sum": jnp.sum(acc)}
+
+    _CHUNK_FIXTURE = compile_cache.make_chunked_step(
+        raw_step, _CHUNK_STRIDE
+    )
+    return _CHUNK_FIXTURE
+
+
+def exercise_chunked(seed: int, revert: Optional[str] = None,
+                     rounds: int = 2) -> dict:
+    """Poisoned tail slots through the REAL masked chunk dispatch: the
+    scan applies `raw_step` to every slot and discards the post-
+    `n_valid` carries with a select, so a poisoned slot's NaN/3e38 is
+    computed and thrown away — the final carry and the last-valid
+    metrics slice must be bitwise those of the zero-padded run."""
+    _check_revert("chunked", revert)
+    import jax
+    import jax.numpy as jnp
+
+    step = _chunk_fixture()
+    rng = random.Random(seed)
+    report = {
+        "seed": seed, "scenario": "chunked", "revert": revert,
+        "programs": 0, "violations": 0, "trace": [],
+    }
+    for round_ in range(rounds):
+        nprng = np.random.default_rng(seed * 61 + round_)
+        k = rng.randrange(1, _CHUNK_STRIDE)  # always a partial chunk
+        poison = POISONS[rng.randrange(len(POISONS))]
+        xs = (nprng.normal(size=(_CHUNK_STRIDE, _CHUNK_D)) * 0.5).astype(
+            np.float32
+        )
+        xs[k:] = 0.0
+        xs_p = xs.copy()
+        xs_p[k:] = _fill(poison, np.float32)
+        outs = []
+        for buf in (xs, xs_p):
+            # fresh state per run: both programs donate their carry
+            state = {
+                "i": jnp.zeros((), jnp.int32),
+                "xs": jnp.asarray(buf),
+                "acc": jnp.zeros((_CHUNK_D,), jnp.float32),
+            }
+            state, metrics = step(state, k)
+            outs.append((
+                np.asarray(jax.device_get(state["acc"])),
+                np.asarray(jax.device_get(metrics["acc_sum"])),
+            ))
+            report["programs"] += 1
+        (acc_a, m_a), (acc_b, m_b) = outs
+        _assert_bitwise(
+            acc_a, acc_b, "the masked chunk carry", seed, "chunked",
+            poison, report,
+        )
+        _assert_bitwise(
+            m_a, m_b, "the last-valid metrics slice", seed, "chunked",
+            poison, report,
+        )
+        row_mask = (np.arange(_CHUNK_STRIDE) < k).astype(np.float64)
+        _assert_summary(
+            masked_summary(xs, row_mask[:, None], revert),
+            masked_summary(xs_p, row_mask[:, None], revert),
+            seed, "chunked", poison, revert, report,
+        )
+        report["trace"].append((round_, k, poison, _sha(acc_a), _sha(m_a)))
+    report["digest"] = _digest(report)
+    return report
+
+
+# ---------------------------------------------------------------------------
+# pallas exerciser: the GAE/λ/V-trace kernels at ragged env batches
+# ---------------------------------------------------------------------------
+
+_PALLAS_ES = (7, 96, 200)  # lane-padded to 128 / 128 / 256
+_PALLAS_T = 4
+_PALLAS_OPS = ("gae", "lambda", "vtrace")
+
+
+def _pallas_inputs(op: str, E: int, nprng) -> dict:
+    T = _PALLAS_T
+    f = lambda scale: (nprng.normal(size=(T, E)) * scale).astype(
+        np.float32
+    )
+    ins = {
+        "rewards": f(1.0),
+        "values": f(0.5),
+        "dones": (nprng.random((T, E)) < 0.15).astype(np.float32),
+        "bootstrap_value": (nprng.normal(size=(E,)) * 0.5).astype(
+            np.float32
+        ),
+    }
+    if op == "vtrace":
+        ins["target_log_probs"] = f(0.1) - 0.7
+        ins["behaviour_log_probs"] = f(0.1) - 0.7
+    return ins
+
+
+def _pallas_call(op: str, ins: dict):
+    from actor_critic_tpu.ops import pallas_scan
+
+    if op == "gae":
+        return pallas_scan.gae(
+            ins["rewards"], ins["values"], ins["dones"],
+            ins["bootstrap_value"], 0.99, 0.95,
+        )
+    if op == "lambda":
+        return (pallas_scan.lambda_returns(
+            ins["rewards"], ins["values"], ins["dones"],
+            ins["bootstrap_value"], 0.99, 0.95,
+        ),)
+    return tuple(pallas_scan.vtrace(
+        ins["target_log_probs"], ins["behaviour_log_probs"],
+        ins["rewards"], ins["values"], ins["dones"],
+        ins["bootstrap_value"], 0.99,
+    ))
+
+
+def exercise_pallas(seed: int, revert: Optional[str] = None,
+                    rounds: int = 2) -> dict:
+    """Poison through the `_pad_lanes` seam of the REAL Pallas scans at
+    ragged E (the slice-back always engages): the B-run monkeypatches
+    `pallas_scan._pad_lanes` to fill the added lanes with the poison
+    instead of zeros, and the sliced [:, :E] outputs must not move —
+    each env column is an independent recurrence, so a pad-lane value
+    can only be observed if the slice-back or lane tiling is broken.
+    `revert="no-slice"` replays the missing-slice bug explicitly: the
+    kernel is launched at the already-padded width (no internal
+    pad/slice) and the FULL-width outputs are compared — the junk lanes
+    differ by construction and must be caught."""
+    _check_revert("pallas", revert)
+    import jax.numpy as jnp
+
+    from actor_critic_tpu.ops import pallas_scan
+
+    rng = random.Random(seed)
+    report = {
+        "seed": seed, "scenario": "pallas", "revert": revert,
+        "programs": 0, "violations": 0, "trace": [],
+    }
+    for round_ in range(rounds):
+        nprng = np.random.default_rng(seed * 67 + round_)
+        op = _PALLAS_OPS[rng.randrange(len(_PALLAS_OPS))]
+        E = _PALLAS_ES[rng.randrange(len(_PALLAS_ES))]
+        poison = POISONS[rng.randrange(len(POISONS))]
+        fill = _fill(poison, np.float32)
+        ins = _pallas_inputs(op, E, nprng)
+        Ep = pallas_scan._pad_env(E)
+        assert pallas_scan.kernel_block(
+            "lambda" if op == "lambda" else op, _PALLAS_T, E
+        ) > 0, "kernel must engage for the schedule to test anything"
+
+        if revert == "no-slice":
+            # Explicit replica of the missing slice-back: launch at the
+            # padded width (Ep is already a 128 multiple, so the kernel
+            # neither pads nor slices) and compare EVERY lane.
+            outs = []
+            for pad_fill in (0.0, fill):
+                wide = {
+                    k: _np_pad_lanes(v, Ep, pad_fill)
+                    for k, v in ins.items()
+                }
+                outs.append(_pallas_call(op, {
+                    k: jnp.asarray(v) for k, v in wide.items()
+                }))
+                report["programs"] += 1
+            for a, b in zip(*outs):
+                a, b = np.asarray(a), np.asarray(b)
+                if a.tobytes() != b.tobytes():
+                    report["violations"] += 1
+                    raise PadSanError(
+                        f"seed {seed}: REVERTED GUARD DETECTED — "
+                        f"committing the full Ep={Ep} width of the "
+                        f"{op} kernel exposes the {poison} pad lanes "
+                        "(zero-fill and poison-fill outputs differ); "
+                        "the [:, :E] slice-back is the guard"
+                    )
+            raise PadSanError(  # pragma: no cover - lanes always differ
+                f"seed {seed}: pallas no-slice revert NOT caught"
+            )
+
+        orig = pallas_scan._pad_lanes
+        try:
+            out_a = _pallas_call(
+                op, {k: jnp.asarray(v) for k, v in ins.items()}
+            )
+            report["programs"] += 1
+
+            def poisoned_pad_lanes(ep, *arrays):
+                out = []
+                for a in arrays:
+                    pad = ep - a.shape[-1]
+                    out.append(jnp.concatenate(
+                        [a, jnp.full(
+                            a.shape[:-1] + (pad,), fill, a.dtype
+                        )],
+                        axis=-1,
+                    ) if pad else a)
+                return out
+
+            pallas_scan._pad_lanes = poisoned_pad_lanes
+            out_b = _pallas_call(
+                op, {k: jnp.asarray(v) for k, v in ins.items()}
+            )
+            report["programs"] += 1
+        finally:
+            pallas_scan._pad_lanes = orig
+        for i, (a, b) in enumerate(zip(out_a, out_b)):
+            _assert_bitwise(
+                a, b, f"{op} output {i} (valid lanes)", seed, "pallas",
+                poison, report,
+            )
+        lane_mask = (np.arange(Ep) < E).astype(np.float64)
+        wide_a = _np_pad_lanes(ins["rewards"], Ep, 0.0)
+        wide_b = _np_pad_lanes(ins["rewards"], Ep, fill)
+        _assert_summary(
+            masked_summary(wide_a, lane_mask[None, :], revert),
+            masked_summary(wide_b, lane_mask[None, :], revert),
+            seed, "pallas", poison, revert, report,
+        )
+        report["trace"].append(
+            (round_, op, E, poison, [_sha(a) for a in out_a])
+        )
+    report["digest"] = _digest(report)
+    return report
+
+
+def _np_pad_lanes(a: np.ndarray, Ep: int, fill: float) -> np.ndarray:
+    """Host-side twin of `pallas_scan._pad_lanes` with a chosen fill."""
+    pad = Ep - a.shape[-1]
+    if pad == 0:
+        return a
+    wide = np.full(a.shape[:-1] + (Ep,), fill, a.dtype)
+    wide[..., : a.shape[-1]] = a
+    return wide
+
+
+# ---------------------------------------------------------------------------
+# mixture exerciser: parked members of the lax.switch fleet step
+# ---------------------------------------------------------------------------
+
+_MIX_FIXTURE = None
+
+
+def _mixture_fixture():
+    """The REAL 4-type mixture env with jitted reset/step, built once
+    per process (one switch program covers every type — the traced
+    type_id compile-once contract)."""
+    global _MIX_FIXTURE
+    if _MIX_FIXTURE is not None:
+        return _MIX_FIXTURE
+    import jax
+
+    from actor_critic_tpu.envs.mixture import make_mixture
+
+    env = make_mixture("cartpole,pendulum,acrobot,maze")
+    _MIX_FIXTURE = (
+        env, jax.jit(env.reset_typed), jax.jit(env.step)
+    )
+    return _MIX_FIXTURE
+
+
+def _fill_members(members, live: int, fill: float):
+    """Every float leaf of every PARKED member state set to `fill`
+    (non-float leaves — step counters, PRNG keys — pass through)."""
+    import jax
+    import jax.numpy as jnp
+
+    def one(m):
+        return jax.tree.map(
+            lambda a: jnp.full_like(a, fill) if _is_float_leaf(a) else a,
+            m,
+        )
+
+    return tuple(
+        m if i == live else one(m) for i, m in enumerate(members)
+    )
+
+
+def _member_float_plane(members, live: int):
+    """(flat f64 values, validity mask) over every float leaf of every
+    member — the padded buffer the guard summary reads (live lanes
+    valid, parked lanes junk)."""
+    import jax
+
+    vals, mask = [], []
+    for i, m in enumerate(members):
+        for leaf in jax.tree.leaves(m):
+            if not _is_float_leaf(leaf):
+                continue
+            flat = np.asarray(jax.device_get(leaf), np.float64).ravel()
+            vals.append(flat)
+            mask.append(np.full(flat.shape, float(i == live)))
+    return np.concatenate(vals), np.concatenate(mask)
+
+
+def exercise_mixture(seed: int, revert: Optional[str] = None,
+                     rounds: int = 2) -> dict:
+    """Poisoned PARKED members through the REAL mixture step: the
+    heterogeneous fleet keeps every member type's state resident and
+    `lax.switch` steps only the live one, so a parked slot is the
+    mixture's padding lane. Filling the 3 parked states with the poison
+    must leave the live transition (obs/reward/done/info and the live
+    member's next state) bitwise unchanged, and the mask-multiplied
+    padded obs must keep its dead lanes at exactly 0.0."""
+    _check_revert("mixture", revert)
+    import jax
+    import jax.numpy as jnp
+
+    env, reset_t, step = _mixture_fixture()
+    n_types = len(env.member_names)
+    rng = random.Random(seed)
+    report = {
+        "seed": seed, "scenario": "mixture", "revert": revert,
+        "programs": 0, "violations": 0, "trace": [],
+    }
+    for round_ in range(rounds):
+        live = rng.randrange(n_types)
+        poison = POISONS[rng.randrange(len(POISONS))]
+        fill = _fill(poison, np.float32)
+        key = jax.random.key(seed * 73 + round_)
+        state, _obs0 = reset_t(key, jnp.asarray(live, jnp.int32))
+        action = jnp.asarray(
+            rng.randrange(env.spec.action_dim), jnp.int32
+        )
+        outs = []
+        for pad_fill in (0.0, fill):
+            s = state._replace(
+                members=_fill_members(state.members, live, pad_fill)
+            )
+            out = step(s, action)
+            report["programs"] += 1
+            outs.append(out)
+        out_a, out_b = outs
+        for name, a, b in (
+            ("obs", out_a.obs, out_b.obs),
+            ("reward", out_a.reward, out_b.reward),
+            ("done", out_a.done, out_b.done),
+            ("terminated", out_a.info["terminated"],
+             out_b.info["terminated"]),
+            ("final_obs", out_a.info["final_obs"],
+             out_b.info["final_obs"]),
+        ):
+            _assert_bitwise(
+                jax.device_get(a), jax.device_get(b),
+                f"the live transition's {name}", seed, "mixture",
+                poison, report,
+            )
+        for la, lb in zip(
+            jax.tree.leaves(out_a.state.members[live]),
+            jax.tree.leaves(out_b.state.members[live]),
+        ):
+            _assert_bitwise(
+                _leaf_np(la), _leaf_np(lb),
+                "the live member's next state", seed, "mixture",
+                poison, report,
+            )
+        # the obs mask contract: dead lanes exactly 0.0 even under
+        # poison (the inline mask-multiply in mixture._pad)
+        width = env.member_specs[live].obs_shape[0]
+        dead = np.asarray(jax.device_get(out_b.obs))[width:]
+        if dead.size and (dead != 0.0).any():
+            report["violations"] += 1
+            raise PadSanError(
+                f"seed {seed}: mixture/{poison} poison reached the "
+                f"padded obs lanes past width {width} — the mask "
+                "multiply in mixture._pad is not holding them at 0.0"
+            )
+        va, ma = _member_float_plane(
+            state._replace(
+                members=_fill_members(state.members, live, 0.0)
+            ).members, live,
+        )
+        vb, _ = _member_float_plane(
+            state._replace(
+                members=_fill_members(state.members, live, fill)
+            ).members, live,
+        )
+        _assert_summary(
+            masked_summary(va, ma, revert),
+            masked_summary(vb, ma, revert),
+            seed, "mixture", poison, revert, report,
+        )
+        report["trace"].append(
+            (round_, env.member_names[live], poison,
+             _sha(jax.device_get(out_a.obs)))
+        )
+    report["digest"] = _digest(report)
+    return report
+
+
+# ---------------------------------------------------------------------------
+# serving exerciser: PolicyEngine.act across buckets with backfill rows
+# ---------------------------------------------------------------------------
+
+_SERVE_FIXTURE = None
+
+
+def _serving_fixture():
+    """One REAL warmed PolicyEngine, built once per process. The ddpg
+    tanh actor (point-mass spec) is deliberate: its pad-row outputs
+    under poison (tanh(±huge) = ±1.0, NaN stays NaN) always differ
+    bitwise from the zero-fill rows (exactly 0.0 at init-scale
+    params), so the no-slice revert is caught on EVERY schedule — a
+    discrete argmax could coincide."""
+    global _SERVE_FIXTURE
+    if _SERVE_FIXTURE is not None:
+        return _SERVE_FIXTURE
+    from actor_critic_tpu.algos.ddpg import DDPGConfig
+    from actor_critic_tpu.envs.testbeds import make_point_mass
+    from actor_critic_tpu.serving import engine as serving
+
+    spec = make_point_mass().spec
+    cfg = DDPGConfig(hidden=(16, 16))
+    eng = serving.PolicyEngine(
+        spec, cfg, algo="ddpg", buckets=(1, 2, 4, 8)
+    )
+    params = serving.init_params(spec, cfg, "ddpg", seed=0)
+    eng.warm(params)
+    _SERVE_FIXTURE = (eng, params)
+    return _SERVE_FIXTURE
+
+
+def exercise_serving(seed: int, revert: Optional[str] = None,
+                     rounds: int = 2) -> dict:
+    """Poisoned bucket-backfill rows through the REAL `PolicyEngine.act`
+    dispatch: ragged n pads to its bucket through `pad_to_bucket`, and
+    the B-run's seam wrapper fills those standby rows with the poison —
+    the n returned actions must be bitwise those of the zero-fill
+    dispatch (the MLP is row-independent and act slices [:n]).
+    `revert="no-slice"` dispatches the same padded batch directly and
+    compares the FULL bucket width: the junk-row actions differ by
+    construction and must be caught."""
+    _check_revert("serving", revert)
+    import jax
+
+    from actor_critic_tpu.utils import compile_cache
+
+    eng, params = _serving_fixture()
+    rng = random.Random(seed)
+    report = {
+        "seed": seed, "scenario": "serving", "revert": revert,
+        "programs": 0, "violations": 0, "trace": [],
+    }
+    for round_ in range(rounds):
+        nprng = np.random.default_rng(seed * 79 + round_)
+        n = (3, 5, 6, 7)[rng.randrange(4)]  # never a bucket size:
+        poison = POISONS[rng.randrange(len(POISONS))]  # backfill engages
+        fill = _fill(poison, np.float32)
+        obs = (nprng.normal(size=(n, 1)) * 0.7).astype(np.float32)
+        padded, mask = compile_cache.pad_to_bucket(obs, eng.buckets)
+        padded_p = padded.copy()
+        padded_p[n:] = fill
+
+        if revert == "no-slice":
+            outs = []
+            for batch in (padded, padded_p):
+                out = jax.device_get(
+                    eng._program(params, jax.device_put(batch))
+                )
+                report["programs"] += 1
+                outs.append(np.asarray(out))
+            if outs[0].tobytes() != outs[1].tobytes():
+                report["violations"] += 1
+                raise PadSanError(
+                    f"seed {seed}: REVERTED GUARD DETECTED — returning "
+                    f"the full bucket width exposes the {poison} "
+                    f"standby rows past n={n} (zero-fill and "
+                    "poison-fill actions differ); act()'s [:n] slice "
+                    "is the guard"
+                )
+            raise PadSanError(  # pragma: no cover - rows always differ
+                f"seed {seed}: serving no-slice revert NOT caught"
+            )
+
+        acts_a = eng.act(params, obs)
+        report["programs"] += 1
+        orig = compile_cache.pad_to_bucket
+
+        def poisoned_pad(x, buckets, axis=0):
+            out, m = orig(x, buckets, axis)
+            out = np.array(out)
+            out[x.shape[0]:] = fill
+            return out, m
+
+        compile_cache.pad_to_bucket = poisoned_pad
+        try:
+            acts_b = eng.act(params, obs)
+            report["programs"] += 1
+        finally:
+            compile_cache.pad_to_bucket = orig
+        _assert_bitwise(
+            acts_a, acts_b, f"the first-{n} actions", seed, "serving",
+            poison, report,
+        )
+        _assert_summary(
+            masked_summary(padded, mask[:, None], revert),
+            masked_summary(padded_p, mask[:, None], revert),
+            seed, "serving", poison, revert, report,
+        )
+        report["trace"].append((round_, n, poison, _sha(acts_a)))
+    report["digest"] = _digest(report)
+    return report
+
+
+# ---------------------------------------------------------------------------
+# device-plane exerciser: ring slots outside the leased gather
+# ---------------------------------------------------------------------------
+
+_DECODE_JITS: dict = {}
+
+
+def _ring_decode(codecs_key: str, codecs: dict):
+    """One jitted gather+decode program per codec layout, shared by
+    every schedule's (fresh) ring — the learner's zero-transfer consume
+    shape."""
+    if codecs_key in _DECODE_JITS:
+        return _DECODE_JITS[codecs_key]
+    import jax
+
+    from actor_critic_tpu.data_plane import ring as ring_mod
+
+    fn = jax.jit(
+        lambda state, slot: ring_mod.gather_block(state, slot, codecs)
+    )
+    _DECODE_JITS[codecs_key] = fn
+    return fn
+
+
+def exercise_device_plane(seed: int, revert: Optional[str] = None,
+                          rounds: int = 2) -> dict:
+    """Poisoned NON-leased slots through the REAL `DeviceTrajRing` +
+    in-jit `gather_block`: a depth-3 ring holds one real block, every
+    OTHER slot's storage is filled with the poison (int8 storage takes
+    the saturating int fill), and the leased slot's decode must be
+    bitwise unchanged — the slot gather dynamic-slices exactly one row,
+    so a neighboring slot is a padding lane. A fresh ring per schedule
+    keeps int8 calibration state seed-local (the decode jit and the
+    shared enqueue program compile once)."""
+    _check_revert("device-plane", revert)
+    import jax
+    import jax.numpy as jnp
+
+    from actor_critic_tpu.data_plane import ring as ring_mod
+
+    rng = random.Random(seed)
+    report = {
+        "seed": seed, "scenario": "device-plane", "revert": revert,
+        "programs": 0, "violations": 0, "trace": [],
+    }
+    depth = 3
+    spec = {
+        "obs": jax.ShapeDtypeStruct((4, 6, 3), jnp.float32),
+        "reward": jax.ShapeDtypeStruct((4, 6), jnp.float32),
+        "action": jax.ShapeDtypeStruct((4, 6), jnp.int32),
+    }
+    for round_ in range(rounds):
+        nprng = np.random.default_rng(seed * 83 + round_)
+        kind = ("fp32", "int8")[rng.randrange(2)]
+        poison = POISONS[rng.randrange(len(POISONS))]
+        ring = ring_mod.DeviceTrajRing(
+            depth, spec, codec=kind, register_gauge=False
+        )
+        decode = _ring_decode(
+            repr(sorted(ring.codecs.items())), ring.codecs
+        )
+        block = {
+            "obs": (nprng.normal(size=(4, 6, 3)) * 0.8).astype(
+                np.float32
+            ),
+            "reward": (nprng.normal(size=(4, 6)) * 0.5).astype(
+                np.float32
+            ),
+            "action": nprng.integers(0, 5, (4, 6)).astype(np.int32),
+        }
+        assert ring.put(block, version=round_)
+        lease = ring.get()
+        out_a = ring.run(
+            lambda st: {
+                k: np.asarray(jax.device_get(v))
+                for k, v in decode(st, lease.slot).items()
+            }
+        )
+        report["programs"] += 1
+        # poison every slot EXCEPT the leased one, dtype-aware
+        with ring._cv:
+            st = ring._state
+            storage = {}
+            for name, arr in st.storage.items():
+                host = np.array(jax.device_get(arr))
+                f = _fill(poison, host.dtype)
+                sel = np.arange(depth) != lease.slot
+                host[sel] = f
+                storage[name] = jax.device_put(host)
+            ring._state = st._replace(storage=storage)
+        out_b = ring.run(
+            lambda st: {
+                k: np.asarray(jax.device_get(v))
+                for k, v in decode(st, lease.slot).items()
+            }
+        )
+        report["programs"] += 1
+        for name in sorted(out_a):
+            _assert_bitwise(
+                out_a[name], out_b[name],
+                f"the leased slot's decoded {name!r}", seed,
+                "device-plane", poison, report,
+            )
+        slot_mask = (np.arange(depth) == lease.slot).astype(np.float64)
+        plane_a = np.zeros((depth, 4, 6), np.float64)
+        plane_b = np.full(
+            (depth, 4, 6), float(_fill(poison, np.float32)), np.float64
+        )
+        block_plane = np.asarray(block["reward"], np.float64)
+        plane_a[lease.slot] = block_plane
+        plane_b[lease.slot] = block_plane
+        _assert_summary(
+            masked_summary(plane_a, slot_mask[:, None, None], revert),
+            masked_summary(plane_b, slot_mask[:, None, None], revert),
+            seed, "device-plane", poison, revert, report,
+        )
+        ring.release(lease)
+        report["trace"].append(
+            (round_, kind, poison, int(lease.slot),
+             {k: _sha(v) for k, v in sorted(out_a.items())})
+        )
+    report["digest"] = _digest(report)
+    return report
+
+
+# ---------------------------------------------------------------------------
+# sweep + the tier-1 quick profile
+# ---------------------------------------------------------------------------
+
+
+def exercise_sweep(seeds: Iterable[int], scenario) -> dict:
+    reports = [scenario(seed) for seed in seeds]
+    return {
+        "schedules": len(reports),
+        "programs": sum(r.get("programs", 0) for r in reports),
+        "violations": sum(r.get("violations", 0) for r in reports),
+    }
+
+
+def quick_profile(schedules: int = 16, seed0: int = 0) -> dict:
+    """The tier-1 fast profile: `schedules` seeded poison schedules
+    split across the five guarded programs — every pad seam must keep
+    its junk lanes unobservable, bitwise. The compiled fixtures
+    (masked chunk program, mixture switch, warmed engine buckets,
+    enqueue/decode pair) build once per process; the Pallas kernels run
+    interpret-mode on CPU."""
+    n = max(schedules // 5, 1)
+    chunked = exercise_sweep(
+        range(seed0, seed0 + n), lambda s: exercise_chunked(s)
+    )
+    pallas = exercise_sweep(
+        range(seed0, seed0 + n), lambda s: exercise_pallas(s)
+    )
+    mixture = exercise_sweep(
+        range(seed0, seed0 + n), lambda s: exercise_mixture(s)
+    )
+    serving = exercise_sweep(
+        range(seed0, seed0 + n), lambda s: exercise_serving(s)
+    )
+    device_plane = exercise_sweep(
+        range(seed0, seed0 + (schedules - 4 * n)),
+        lambda s: exercise_device_plane(s),
+    )
+    parts = (chunked, pallas, mixture, serving, device_plane)
+    return {
+        "schedules": sum(x["schedules"] for x in parts),
+        "chunked": chunked,
+        "pallas": pallas,
+        "mixture": mixture,
+        "serving": serving,
+        "device_plane": device_plane,
+        "programs": sum(x["programs"] for x in parts),
+        "violations": sum(x["violations"] for x in parts),
+    }

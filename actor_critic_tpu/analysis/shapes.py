@@ -1,0 +1,252 @@
+"""jaxlint shape/padding passes (ISSUE 20 static half).
+
+Three checks over analysis/shape_model.py's per-scope padding flow —
+the SHAPES dimension's lint surface, alongside racesan/fleetsan/
+numsan/perfsan's lint siblings:
+
+- **pad-mask-discipline** — a reduction (mean/sum/max/logsumexp/
+  argmax/...) over an axis a padding producer widened, with neither a
+  mask multiply/`where` nor an inline valid-slice. The canonical miss:
+  `padded, mask = pad_to_bucket(obs, buckets); jnp.mean(padded)` —
+  the mean silently rescales by n/bucket and every gradient built on
+  it is wrong by the same factor.
+- **mask-propagation** — a padded array crossing a USER function
+  boundary (a jit seam, a dispatch, a helper) without its mask riding
+  along and without the result being sliced back afterwards. The
+  callee has no way to know which lanes are real; the mixture obs
+  contract (pad * mask) and the serving act contract (`out[:n]`) are
+  the two sanctioned shapes.
+- **slice-before-commit** — a padded buffer reaching a commit point
+  (publish/save/swap/put/enqueue/send/... — durable or
+  externally-visible state) without the slice-back. Junk lanes that
+  cross a commit stop being "compute junk, slice it away" and become
+  someone else's wrong answer.
+
+The runtime companion is analysis/padsan.py: these passes prove the
+discipline is WRITTEN; padsan poisons the pad lanes of the real
+steady-state programs and proves it HOLDS bitwise.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from actor_critic_tpu.analysis import shape_model
+from actor_critic_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    register_check,
+)
+
+PAD_MASK = "pad-mask-discipline"
+MASK_PROP = "mask-propagation"
+SLICE_COMMIT = "slice-before-commit"
+
+
+def _own_calls(stmt: ast.stmt) -> Iterable[ast.Call]:
+    """Call nodes in `stmt`'s OWN expressions — nested statements are
+    separate entries in the scope flow, so descending into them here
+    would double-visit (an `if` header owns its test, not its body)."""
+    stack: list[ast.AST] = [stmt]
+    while stack:
+        node = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.stmt):
+                continue
+            if isinstance(child, ast.Call):
+                yield child
+            stack.append(child)
+
+
+def _finding(
+    check: str, mod: ModuleInfo, node: ast.AST, message: str
+) -> Finding:
+    return Finding(
+        check=check,
+        path=mod.relpath,
+        line=node.lineno,
+        col=node.col_offset,
+        message=message,
+        context=mod.enclosing_function(node),
+    )
+
+
+def _arg_exprs(call: ast.Call) -> list[ast.AST]:
+    return list(call.args) + [kw.value for kw in call.keywords]
+
+
+def _padded_arg_names(mod: ModuleInfo, call: ast.Call, env: dict) -> list[str]:
+    """Padded bindings passed (possibly behind a shape-preserving
+    wrapper: `program(p, jax.device_put(padded))`) as call arguments."""
+    out = []
+    for a in _arg_exprs(call):
+        inner = shape_model._unwrap_preserving(mod, a)
+        if isinstance(inner, ast.Name) and inner.id in env:
+            out.append(inner.id)
+    return sorted(set(out))
+
+
+def _mask_rides_along(call: ast.Call, flow) -> bool:
+    for a in _arg_exprs(call):
+        for n in shape_model.bare_names(a):
+            if n in flow.masks or shape_model.is_maskish(n):
+                return True
+    return False
+
+
+def _result_sliced(mod: ModuleInfo, stmt: ast.stmt, call: ast.Call, flow) -> bool:
+    """Whether the call's RESULT is cut back to valid lanes: inline
+    (`program(p, padded)[:n]`), or via the assignment target appearing
+    under a slice later in the scope (`out = program(...)`, then
+    `np.asarray(out)[:n]`)."""
+    for anc in mod.ancestors(call):
+        if isinstance(anc, ast.stmt):
+            break
+        if isinstance(anc, ast.Subscript) and shape_model._contains_slice(
+            anc.slice
+        ):
+            return True
+    targets, value = shape_model._assign_parts(stmt)
+    if targets is None or value is None:
+        return False
+    if not any(n is call for n in ast.walk(value)):
+        return False
+    from actor_critic_tpu.analysis.core import target_names
+
+    names = {n for t in targets for n in target_names(t)}
+    return bool(names & flow.sliced)
+
+
+@register_check(
+    PAD_MASK,
+    "reduction over a padding-widened axis without a mask or valid-slice",
+)
+def check_pad_mask_discipline(mod: ModuleInfo) -> list[Finding]:
+    findings: list[Finding] = []
+    for flow in shape_model.module_flows(mod):
+        if shape_model.is_producer_scope(flow.scope):
+            continue
+        for stmt in flow.stmts:
+            env = flow.env_before[id(stmt)]
+            if not env:
+                continue
+            for call in _own_calls(stmt):
+                operand = shape_model.reduction_operand(mod, call)
+                if operand is None:
+                    continue
+                hit = sorted(shape_model.bare_names(operand) & set(env))
+                if not hit:
+                    continue
+                if any(kw.arg == "where" for kw in call.keywords):
+                    continue  # np-style masked reduction
+                if shape_model.has_mask_guard(mod, operand, flow.masks):
+                    continue
+                if shape_model.has_valid_slice(operand, set(hit)):
+                    continue
+                b = env[hit[0]]
+                mask_hint = (
+                    f"its mask `{b.mask}` is in scope — multiply or "
+                    f"`where` it in, or reduce over `{hit[0]}[:n]`"
+                    if b.mask
+                    else "no mask was kept — slice back to the valid "
+                    "prefix before reducing, or keep the mask from "
+                    "the producer"
+                )
+                findings.append(
+                    _finding(
+                        PAD_MASK, mod, call,
+                        f"reduction over `{hit[0]}`, which `{b.producer}` "
+                        f"(line {b.lineno}) widened with junk lanes: the "
+                        f"result silently rescales by n_valid/n_padded "
+                        f"(a mean over a 7-of-128-lane pad is off 18x); "
+                        f"{mask_hint}",
+                    )
+                )
+    findings.sort(key=lambda f: (f.path, f.line, f.col))
+    return findings
+
+
+@register_check(
+    MASK_PROP,
+    "padded array crosses a function/jit seam without its mask or a "
+    "slice-back",
+)
+def check_mask_propagation(mod: ModuleInfo) -> list[Finding]:
+    findings: list[Finding] = []
+    for flow in shape_model.module_flows(mod):
+        if shape_model.is_producer_scope(flow.scope):
+            continue
+        for stmt in flow.stmts:
+            env = flow.env_before[id(stmt)]
+            if not env:
+                continue
+            for call in _own_calls(stmt):
+                if shape_model.reduction_operand(mod, call) is not None:
+                    continue  # pad-mask-discipline's domain
+                dotted = shape_model.call_name(mod, call)
+                if shape_model._is_lib_root(mod, dotted):
+                    continue  # library math preserves lanes
+                if shape_model.producer_kind(mod, call):
+                    continue
+                last = (dotted or "").split(".")[-1]
+                if last in shape_model.COMMIT_NAMES:
+                    continue  # slice-before-commit's domain
+                padded_args = _padded_arg_names(mod, call, env)
+                if not padded_args:
+                    continue
+                if _mask_rides_along(call, flow):
+                    continue
+                if _result_sliced(mod, stmt, call, flow):
+                    continue
+                b = env[padded_args[0]]
+                callee = dotted or "<callee>"
+                findings.append(
+                    _finding(
+                        MASK_PROP, mod, call,
+                        f"`{padded_args[0]}` (padded by `{b.producer}`, "
+                        f"line {b.lineno}) crosses `{callee}` without its "
+                        f"mask, and the result is never sliced back: the "
+                        f"callee cannot tell junk lanes from real ones — "
+                        f"pass the mask/n_valid along, or slice the "
+                        f"result to the valid prefix",
+                    )
+                )
+    findings.sort(key=lambda f: (f.path, f.line, f.col))
+    return findings
+
+
+@register_check(
+    SLICE_COMMIT,
+    "padded buffer reaches a commit point (publish/save/enqueue/...) "
+    "without slice-back",
+)
+def check_slice_before_commit(mod: ModuleInfo) -> list[Finding]:
+    findings: list[Finding] = []
+    for flow in shape_model.module_flows(mod):
+        if shape_model.is_producer_scope(flow.scope):
+            continue
+        for stmt in flow.stmts:
+            env = flow.env_before[id(stmt)]
+            if not env:
+                continue
+            for call in _own_calls(stmt):
+                dotted = shape_model.call_name(mod, call)
+                last = (dotted or "").split(".")[-1]
+                if last not in shape_model.COMMIT_NAMES:
+                    continue
+                for name in _padded_arg_names(mod, call, env):
+                    b = env[name]
+                    findings.append(
+                        _finding(
+                            SLICE_COMMIT, mod, call,
+                            f"`{name}` (padded by `{b.producer}`, line "
+                            f"{b.lineno}) reaches commit point `{last}` "
+                            f"with its junk lanes intact: once committed "
+                            f"(published/checkpointed/enqueued/served) "
+                            f"the pad rows become downstream wrong "
+                            f"answers — commit `{name}[:n]` instead",
+                        )
+                    )
+    findings.sort(key=lambda f: (f.path, f.line, f.col))
+    return findings

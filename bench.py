@@ -176,7 +176,7 @@ DEFAULT_CPU_METRICS = (
     "host_pool_scaling,startup_to_first_step,async_decoupling,update_wall,"
     "fused_update_wall,replay_sample_throughput,multihost_scaling,"
     "serving_latency,serving_fleet_scaling,scenario_fleet,"
-    "consumed_env_steps_per_s"
+    "consumed_env_steps_per_s,pad_overhead"
 )
 
 

@@ -1456,6 +1456,109 @@ def bench_serving_fleet_scaling():
     }
 
 
+def bench_pad_overhead():
+    """Shape-stabilization tax (ISSUE 20 satellite): the dispatch wall
+    of the REAL padded paths vs the same program at the exact aligned
+    shape, at both pad seams padsan guards. Pallas side: `gae` at the
+    ragged env batches E=7/96/200 (the kernel pads to the 128-lane tile
+    and slices back) vs the already-aligned Ep width — the gap is what
+    the pad/slice machinery plus the dead lanes cost. Serving side:
+    `PolicyEngine.act` at a non-bucket n (backfill rows engage) vs an
+    engine whose bucket IS n — the gap is what the bucket ladder costs
+    per dispatch. The headline value is the WORST overhead ratio across
+    all measured shapes, so a pad path quietly growing a copy (or a
+    bucket ladder over-padding) trends as one number; the per-shape
+    walls ride along for attribution."""
+    from actor_critic_tpu import serving
+    from actor_critic_tpu.algos.ddpg import DDPGConfig
+    from actor_critic_tpu.envs.testbeds import make_point_mass
+    from actor_critic_tpu.ops import pallas_scan
+
+    def timeit(fn, *args, reps=30):
+        out = fn(*args)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / reps * 1e6
+
+    T = 32
+    gae = jax.jit(lambda *a: pallas_scan.gae(*a, 0.99, 0.95))
+    pallas = {}
+    for E in (7, 96, 200):
+        Ep = pallas_scan._pad_env(E)
+        block = pallas_scan.kernel_block("gae", T, E)
+        assert block > 0, f"gae kernel must engage at E={E}"
+
+        def wall(width):
+            rng = np.random.default_rng(width)
+            r = jnp.asarray(rng.normal(size=(T, width)), jnp.float32)
+            v = jnp.asarray(rng.normal(size=(T, width)), jnp.float32)
+            d = jnp.asarray(rng.random((T, width)) < 0.02, jnp.float32)
+            b = jnp.asarray(rng.normal(size=(width,)), jnp.float32)
+            return timeit(gae, r, v, d, b)
+
+        padded_us, exact_us = wall(E), wall(Ep)
+        pallas[f"E{E}"] = {
+            "padded_us": round(padded_us, 1),
+            "exact_us": round(exact_us, 1),
+            "pad_lanes": Ep - E,
+            "kernel_block": block,
+            "overhead_x": round(padded_us / max(exact_us, 1e-9), 2),
+        }
+
+    spec = make_point_mass().spec
+    cfg = DDPGConfig(hidden=(16, 16))
+    params = serving.init_params(spec, cfg, "ddpg", seed=0)
+    ladder = (1, 2, 4, 8)
+    bucketed = {}
+    for n in (3, 5):
+        bucket = next(b for b in ladder if b >= n)
+        eng_pad = serving.PolicyEngine(
+            spec, cfg, algo="ddpg", buckets=ladder
+        )
+        eng_exact = serving.PolicyEngine(
+            spec, cfg, algo="ddpg", buckets=(n,)
+        )
+        eng_pad.warm(params)
+        eng_exact.warm(params)
+        rng = np.random.default_rng(n)
+        obs = (rng.normal(size=(n, *spec.obs_shape)) * 0.7).astype(
+            np.float32
+        )
+
+        def act_wall(eng, reps=50):
+            eng.act(params, obs)  # act blocks (device_get), no fence
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                eng.act(params, obs)
+            return (time.perf_counter() - t0) / reps * 1e6
+
+        padded_us, exact_us = act_wall(eng_pad), act_wall(eng_exact)
+        bucketed[f"n{n}"] = {
+            "padded_us": round(padded_us, 1),
+            "exact_us": round(exact_us, 1),
+            "bucket": bucket,
+            "backfill_rows": bucket - n,
+            "overhead_x": round(padded_us / max(exact_us, 1e-9), 2),
+        }
+
+    worst_key, worst = max(
+        [*((f"pallas {k}", v) for k, v in pallas.items()),
+         *((f"serving {k}", v) for k, v in bucketed.items())],
+        key=lambda kv: kv[1]["overhead_x"],
+    )
+    return {
+        "metric": "pad_overhead",
+        "value": worst["overhead_x"],
+        "unit": "x padded vs exact-shape dispatch wall "
+                f"(worst: {worst_key})",
+        "pallas": pallas,
+        "serving": bucketed,
+    }
+
+
 BENCHES = {
     "a2c": bench_a2c,
     "ppo": bench_ppo,
@@ -1475,6 +1578,7 @@ BENCHES = {
     "scenario_fleet": bench_scenario_fleet,
     "mujoco": bench_mujoco_host,
     "pallas": bench_pallas_ops,
+    "pad_overhead": bench_pad_overhead,
     "startup_to_first_step": bench_startup_to_first_step,
 }
 

@@ -200,6 +200,22 @@ def serving_cell(rec: dict | None, field: str) -> str:
     return _numeric_cell(sub.get(field))
 
 
+def pad_overhead_cell(rec: dict | None, group: str, key: str) -> str:
+    """One padded-vs-exact shape pair of the pad-overhead record
+    (ISSUE 20 satellite: the shape-stabilization tax — Pallas ragged
+    lanes, serving bucket backfill — trends per round)."""
+    entry, cell = _metric_entry(rec, "pad_overhead")
+    if entry is None:
+        return cell
+    sub = entry.get(group)
+    if not isinstance(sub, dict):
+        return "?"
+    pair = sub.get(key)
+    if not isinstance(pair, dict):
+        return "?"
+    return _numeric_cell(pair.get("overhead_x"))
+
+
 def fleet_replica_counts(recs: list[dict | None]) -> list[int]:
     """Union of fleet-curve replica counts across rounds (the ISSUE 17
     record nests per-count runs under `points`, keyed by `replicas`)."""
@@ -547,6 +563,22 @@ def trend_rows(root: str) -> tuple[list[int], list[tuple[str, list[str]]]]:
                 rows.append((
                     f"consumed_env_steps_per_s.{field}",
                     [data_plane_measured_cell(r, field) for r in recs],
+                ))
+        if name == "pad_overhead":
+            # Pad-tax sub-rows (ISSUE 20): the padded-vs-exact dispatch
+            # overhead at every guarded shape — the Pallas ragged env
+            # batches and the serving backfill sizes — so one pad seam
+            # quietly growing a copy is attributable even when the
+            # worst-case headline is carried by a different seam.
+            for key in ("E7", "E96", "E200"):
+                rows.append((
+                    f"pad_overhead.pallas_{key}",
+                    [pad_overhead_cell(r, "pallas", key) for r in recs],
+                ))
+            for key in ("n3", "n5"):
+                rows.append((
+                    f"pad_overhead.serving_{key}",
+                    [pad_overhead_cell(r, "serving", key) for r in recs],
                 ))
     return rounds, rows
 

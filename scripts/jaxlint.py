@@ -6,6 +6,8 @@
     python scripts/jaxlint.py --list-checks
     python scripts/jaxlint.py --select lock-discipline,check-then-act
     python scripts/jaxlint.py --diff HEAD             # changed files only
+    python scripts/jaxlint.py --since HEAD~2          # + untracked files,
+                                                      # fixture-pair re-lint
     python scripts/jaxlint.py --json                  # machine output
     python scripts/jaxlint.py --write-baseline        # regenerate
     python scripts/jaxlint.py --prune-stale           # drop dead entries
@@ -93,6 +95,18 @@ def main(argv=None) -> int:
         "unchanged; zero changed files is a clean exit 0",
     )
     p.add_argument(
+        "--since", metavar="REV", default=None,
+        help="like --diff, with the pre-commit ergonomics on top: REV "
+        "is resolved through `git rev-parse` first (HEAD~2, branch "
+        "names, tags — a typo'd rev is a clear exit-2 error, not an "
+        "empty diff), untracked .py files count as changed (a "
+        "brand-new module is linted before its first commit), and a "
+        "change touching only a check's FIXTURE pair "
+        "(tests/jaxlint_fixtures/<check>_{flag,ok}.py) re-lints the "
+        "module implementing that check — editing the pinned contract "
+        "re-examines the pass it pins",
+    )
+    p.add_argument(
         "--prune-stale", action="store_true",
         help="rewrite the baseline WITHOUT the stale entries this run "
         "can see (scanned paths × selected checks) and exit 0 — stale "
@@ -130,25 +144,105 @@ def main(argv=None) -> int:
     skip = args.skip.split(",") if args.skip else ()
     baseline_path = args.baseline or analysis.default_baseline_path(REPO)
 
+    if args.diff is not None and args.since is not None:
+        print(
+            "jaxlint: error: --diff and --since are the same fast path "
+            "with different ergonomics — pass one",
+            file=sys.stderr,
+        )
+        return 2
+
     paths = list(args.paths)
-    if args.diff is not None:
+    ref = args.since if args.since is not None else args.diff
+    if ref is not None:
         import subprocess
 
+        flag = "--since" if args.since is not None else "--diff"
+        if args.since is not None:
+            # Resolve the rev up front: `git diff` against a typo'd rev
+            # fails with the same message an empty tree would, so the
+            # pre-commit path names the bad input explicitly.
+            try:
+                proc = subprocess.run(
+                    ["git", "rev-parse", "--verify",
+                     f"{ref}^{{commit}}"],
+                    capture_output=True, text=True, cwd=REPO, check=True,
+                )
+                ref = proc.stdout.strip()
+            except (OSError, subprocess.CalledProcessError) as e:
+                detail = (getattr(e, "stderr", "") or str(e)).strip()
+                print(
+                    f"jaxlint: error: --since {args.since}: not a "
+                    f"resolvable rev ({detail.splitlines()[-1]})",
+                    file=sys.stderr,
+                )
+                return 2
         try:
             proc = subprocess.run(
-                ["git", "diff", "--name-only", args.diff, "--", "*.py"],
+                ["git", "diff", "--name-only", ref, "--", "*.py"],
                 capture_output=True, text=True, cwd=REPO, check=True,
             )
         except (OSError, subprocess.CalledProcessError) as e:
             detail = getattr(e, "stderr", "") or str(e)
             print(
-                f"jaxlint: error: --diff {args.diff}: {detail.strip()}",
+                f"jaxlint: error: {flag} {ref}: {detail.strip()}",
                 file=sys.stderr,
             )
             return 2
         changed = {
             ln.strip() for ln in proc.stdout.splitlines() if ln.strip()
         }
+        if args.since is not None:
+            # Untracked modules are "changed vs REV" for pre-commit
+            # purposes: a brand-new file must be linted before its
+            # first commit, and `git diff REV` cannot see it.
+            try:
+                proc = subprocess.run(
+                    ["git", "ls-files", "--others", "--exclude-standard",
+                     "--", "*.py"],
+                    capture_output=True, text=True, cwd=REPO, check=True,
+                )
+            except (OSError, subprocess.CalledProcessError) as e:
+                detail = getattr(e, "stderr", "") or str(e)
+                print(
+                    f"jaxlint: error: --since untracked scan: "
+                    f"{detail.strip()}",
+                    file=sys.stderr,
+                )
+                return 2
+            changed |= {
+                ln.strip() for ln in proc.stdout.splitlines() if ln.strip()
+            }
+            # Fixture-pair rule: the fixture files pin a check's
+            # flag/ok contract, so a change touching ONLY
+            # tests/jaxlint_fixtures/<check>_{flag,ok}.py re-lints the
+            # module IMPLEMENTING that check — the pass and its pinned
+            # contract are one unit of review.
+            import re as _re
+
+            fixture_re = _re.compile(
+                r"^tests/jaxlint_fixtures/(.+)_(?:flag|ok)\.py$"
+            )
+            registry = {c.name: c for c in analysis.registered_checks()}
+            for f in sorted(changed):
+                m = fixture_re.match(f)
+                if not m:
+                    continue
+                check = analysis.core.resolve_check_name(
+                    m.group(1).replace("_", "-")
+                )
+                c = registry.get(check)
+                if c is None:
+                    continue  # a fixture with no registered pass
+                mod_file = getattr(
+                    sys.modules.get(c.fn.__module__), "__file__", None
+                )
+                if mod_file:
+                    changed.add(
+                        os.path.relpath(mod_file, REPO).replace(
+                            os.sep, "/"
+                        )
+                    )
         # Intersect with the scan set: a changed file outside the
         # requested paths (tests, scripts) stays out, exactly as in a
         # full run over the same paths.
@@ -166,8 +260,8 @@ def main(argv=None) -> int:
         )
         if not paths:
             print(
-                f"jaxlint: no scanned .py files changed vs {args.diff} "
-                "— nothing to lint"
+                f"jaxlint: no scanned .py files changed vs "
+                f"{args.since or args.diff} — nothing to lint"
             )
             return 0
 

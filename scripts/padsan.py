@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""padsan: deterministic padding-lane poison sanitizer for the
+shape-stabilization seams (ISSUE 20).
+
+    python scripts/padsan.py                        # quick profile
+    python scripts/padsan.py --schedules 64         # wider sweep
+    python scripts/padsan.py --scenario pallas --revert no-slice
+                                                    # reproduce a
+                                                    # missing slice-back
+                                                    # (exit 1)
+    python scripts/padsan.py --scenario serving --revert unmasked-mean
+                                                    # reverted masked
+                                                    # summary (exit 1)
+    python scripts/padsan.py --json                 # machine output
+
+Each schedule runs a REAL steady-state program twice — pad lanes
+zeroed vs poisoned (nan / ±3e38 / int8-saturating) — and asserts the
+valid-lane outputs are BITWISE identical. Exit codes (scripts/tier1.sh
+runs `--quick` between perfsan and the multihost smoke, under its own
+timeout):
+    0  clean: no pad seam leaked a single byte into a valid lane
+    1  violation: a junk lane is observable — or a reverted mask/slice
+       guard was detected (the sanitizer working)
+    2  crash: unexpected error (a broken exerciser, not a detection)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[1].strip())
+    p.add_argument(
+        "--schedules", type=int, default=16,
+        help="seeded poison schedules to sweep (default 16, the tier-1 "
+        "quick profile: split across chunked/pallas/mixture/serving/"
+        "device-plane)",
+    )
+    p.add_argument(
+        "--seed0", type=int, default=0,
+        help="first seed of the sweep (fixed seeds keep tier-1 "
+        "deterministic; a violation names its seed for bit-identical "
+        "replay)",
+    )
+    p.add_argument(
+        "--scenario",
+        choices=(
+            "all", "chunked", "pallas", "mixture", "serving",
+            "device-plane",
+        ),
+        default="all",
+        help="which pad seam to exercise (default: the quick profile; "
+        "'chunked' drives make_chunked_step's masked tail program, "
+        "'pallas' the GAE/λ/V-trace kernels at ragged env batches, "
+        "'mixture' the lax.switch fleet's parked members, 'serving' "
+        "PolicyEngine.act's bucket backfill rows, 'device-plane' the "
+        "ring slots outside the leased gather)",
+    )
+    p.add_argument(
+        "--revert", choices=("unmasked-mean", "no-slice"), default=None,
+        help="reverted-guard mode (expected exit 1): 'unmasked-mean' "
+        "swaps the masked where-select summary for a plain mean (any "
+        "scenario); 'no-slice' commits the full padded width instead "
+        "of the valid slice (pallas, serving) — padsan must detect "
+        "the junk lanes on every schedule",
+    )
+    p.add_argument(
+        "--quick", action="store_true",
+        help="alias for the default quick profile (the tier-1 entry "
+        "point; explicit so the tier-1 line documents what it runs)",
+    )
+    p.add_argument("--json", action="store_true", help="machine output")
+    args = p.parse_args(argv)
+
+    from actor_critic_tpu.analysis import padsan
+
+    if args.revert is not None:
+        if args.scenario == "all":
+            print(
+                "padsan: error: --revert needs a single --scenario "
+                "(the quick profile only sweeps the guarded modes)",
+                file=sys.stderr,
+            )
+            return 2
+        if args.revert not in padsan.SCENARIO_REVERTS[args.scenario]:
+            print(
+                f"padsan: error: scenario {args.scenario!r} supports "
+                f"revert modes {padsan.SCENARIO_REVERTS[args.scenario]}"
+                f", got {args.revert!r}",
+                file=sys.stderr,
+            )
+            return 2
+
+    try:
+        if args.scenario == "all":
+            out = padsan.quick_profile(
+                schedules=args.schedules, seed0=args.seed0
+            )
+        else:
+            exerciser = {
+                "chunked": padsan.exercise_chunked,
+                "pallas": padsan.exercise_pallas,
+                "mixture": padsan.exercise_mixture,
+                "serving": padsan.exercise_serving,
+                "device-plane": padsan.exercise_device_plane,
+            }[args.scenario]
+            out = padsan.exercise_sweep(
+                range(args.seed0, args.seed0 + args.schedules),
+                lambda s: exerciser(s, revert=args.revert),
+            )
+    except padsan.PadSanError as e:
+        # A detection names its seed: rerun that single seed to replay
+        # the poison schedule bit-identically.
+        print(f"padsan: VIOLATION DETECTED: {e}", file=sys.stderr)
+        return 1
+    except Exception as e:
+        print(f"padsan: error: {type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(json.dumps(out, indent=2, default=str))
+    else:
+        print(
+            f"padsan: {out.get('schedules', 0)} poison schedule(s) "
+            "clean — no pad lane leaked a byte into a valid-lane output"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

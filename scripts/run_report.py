@@ -789,6 +789,24 @@ def static_findings() -> list[str]:
             "the mailbox/gossip/gateway stack under deterministic "
             "chaos schedules",
         ]
+    shapes = [
+        f for f in new
+        if f.get("check")
+        in ("pad-mask-discipline", "mask-propagation",
+            "slice-before-commit")
+    ]
+    if shapes:
+        # Shapes row (ISSUE 20): a padded-lane hazard is a silently
+        # rescaled loss or a junk row reaching a commit point — a run
+        # being diagnosed for "the gradient is subtly wrong" should see
+        # it before the per-finding list.
+        out += [
+            f"- **shapes**: {len(shapes)} of these are padding/mask "
+            "hazards (pad-mask-discipline / mask-propagation / "
+            "slice-before-commit) — `python scripts/padsan.py` poisons "
+            "the pad lanes of the real programs and asserts valid-lane "
+            "outputs are bitwise unchanged",
+        ]
     out += [
         f"- `{f.get('path')}:{f.get('line')}` **[{f.get('check')}]** "
         f"{f.get('message')}"

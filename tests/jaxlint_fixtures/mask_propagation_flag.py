@@ -1,0 +1,22 @@
+"""mask-propagation flag fixture: padded arrays crossing user
+function/jit seams with the mask left behind and no slice-back.
+
+Parsed (never imported) by tests/test_jaxlint.py.
+"""
+
+from actor_critic_tpu.ops.pallas_scan import _pad_lanes
+from actor_critic_tpu.utils.compile_cache import pad_to_bucket
+
+
+def dispatch_without_mask(program, params, obs, buckets):
+    padded, mask = pad_to_bucket(obs, buckets)
+    # the mask stays behind: the program cannot tell junk rows from
+    # real ones, and nothing downstream cuts them away
+    out = program(params, padded)
+    return out
+
+
+def lane_dispatch_unsliced(kernel, Ep, rewards):
+    (wide,) = _pad_lanes(Ep, rewards)
+    # the kernel's junk-lane output flows on at full Ep width
+    return kernel(wide)

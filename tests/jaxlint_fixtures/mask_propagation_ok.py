@@ -1,0 +1,29 @@
+"""mask-propagation near-miss fixture: the two sanctioned seam shapes
+(mask rides along; result sliced back) — must stay completely clean.
+
+Parsed (never imported) by tests/test_jaxlint.py.
+"""
+
+from actor_critic_tpu.ops.pallas_scan import _pad_lanes
+from actor_critic_tpu.utils.compile_cache import pad_to_bucket
+
+
+def dispatch_with_mask(program, params, obs, buckets):
+    padded, mask = pad_to_bucket(obs, buckets)
+    # the mask crosses the seam with the array: the callee can keep
+    # the discipline
+    return program(params, padded, mask)
+
+
+def dispatch_then_slice(program, params, obs, buckets, n):
+    padded, _ = pad_to_bucket(obs, buckets)
+    out = program(params, padded)
+    # the serving act contract: only the valid prefix escapes
+    return out[:n]
+
+
+def lane_dispatch_sliced(kernel, Ep, E, rewards):
+    (wide,) = _pad_lanes(Ep, rewards)
+    adv = kernel(wide)
+    # the Pallas contract: compute junk, slice it away
+    return adv[:, :E]

@@ -1,0 +1,29 @@
+"""pad-mask-discipline flag fixture: reductions over padding-widened
+axes with no mask and no valid-slice — every producer class fires.
+
+Parsed (never imported) by tests/test_jaxlint.py.
+"""
+
+import jax.numpy as jnp
+
+from actor_critic_tpu.ops.pallas_scan import _pad_lanes
+from actor_critic_tpu.utils.compile_cache import pad_to_bucket
+
+
+def unmasked_bucket_mean(obs, buckets):
+    padded, mask = pad_to_bucket(obs, buckets)
+    # mean over the widened batch axis without the mask: silently
+    # rescales by n/bucket (7 rows in a 128 bucket -> off 18x)
+    return jnp.mean(padded)
+
+
+def unmasked_lane_sum(Ep, rewards):
+    (wide,) = _pad_lanes(Ep, rewards)
+    # the Mosaic junk lanes are summed in with the real envs
+    return jnp.sum(wide)
+
+
+def unmasked_raw_pad_max(x, extra):
+    wide = jnp.pad(x, (0, extra))
+    # argmax can land IN the pad: zeros beat negative valid entries
+    return jnp.argmax(wide)

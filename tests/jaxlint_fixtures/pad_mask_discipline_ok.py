@@ -1,0 +1,36 @@
+"""pad-mask-discipline near-miss fixture: the sanctioned masked /
+sliced reduction idioms — must stay completely clean.
+
+Parsed (never imported) by tests/test_jaxlint.py.
+"""
+
+import jax.numpy as jnp
+
+from actor_critic_tpu.ops.pallas_scan import _pad_lanes
+from actor_critic_tpu.utils.compile_cache import pad_to_bucket
+
+
+def masked_bucket_mean(obs, buckets):
+    padded, mask = pad_to_bucket(obs, buckets)
+    # the mask multiply keeps the junk lanes at exactly zero, and the
+    # floored denominator counts only valid rows
+    return jnp.sum(padded * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def where_bucket_max(obs, buckets):
+    padded, mask = pad_to_bucket(obs, buckets)
+    # where-select: junk lanes replaced before the reduction sees them
+    return jnp.max(jnp.where(mask > 0.5, padded, -jnp.inf))
+
+
+def sliced_lane_sum(Ep, E, rewards):
+    (wide,) = _pad_lanes(Ep, rewards)
+    # inline valid-slice: the reduction only ever sees real lanes
+    return jnp.sum(wide[:, :E])
+
+
+def rebind_then_reduce(x, extra, n):
+    wide = jnp.pad(x, (0, extra))
+    valid = wide[:n]
+    # the slice-back rebind clears the padded fact before the mean
+    return jnp.mean(valid)

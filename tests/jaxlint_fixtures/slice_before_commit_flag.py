@@ -1,0 +1,19 @@
+"""slice-before-commit flag fixture: padded buffers reaching commit
+points (data-plane slot, socket response) with junk lanes intact.
+
+Parsed (never imported) by tests/test_jaxlint.py.
+"""
+
+from actor_critic_tpu.utils.compile_cache import pad_to_bucket
+
+
+def enqueue_padded(ring, obs, buckets):
+    padded, mask = pad_to_bucket(obs, buckets)
+    # the data-plane slot now holds junk rows a consumer will decode
+    ring.put(padded, version=1)
+
+
+def respond_padded(sock, obs, buckets):
+    padded, _ = pad_to_bucket(obs, buckets)
+    # the client receives bucket-width rows it never asked for
+    sock.send(padded)

@@ -1,0 +1,20 @@
+"""slice-before-commit near-miss fixture: the slice-back happens
+before anything durable sees the buffer — must stay completely clean.
+
+Parsed (never imported) by tests/test_jaxlint.py.
+"""
+
+from actor_critic_tpu.utils.compile_cache import pad_to_bucket
+
+
+def enqueue_valid(ring, obs, buckets, n):
+    padded, _ = pad_to_bucket(obs, buckets)
+    # inline valid-slice at the commit point
+    ring.put(padded[:n], version=1)
+
+
+def respond_valid(sock, obs, buckets, n):
+    padded, _ = pad_to_bucket(obs, buckets)
+    valid = padded[:n]
+    # the rebind carries only real rows into the send
+    sock.send(valid)

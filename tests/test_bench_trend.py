@@ -582,3 +582,71 @@ def test_data_plane_sub_rows(tmp_path):
         "consumed_env_steps_per_s.host_measured",
         "consumed_env_steps_per_s.enqueue_measured",
     ]
+
+
+def test_pad_overhead_sub_rows(tmp_path):
+    """ISSUE 20 satellite: pad_overhead expands into per-shape
+    overhead_x sub-rows (Pallas ragged lanes + serving backfill sizes);
+    '-' before the metric existed, '?' for malformed sub-records, 'err'
+    for failed subprocesses."""
+    mod = _load()
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps({
+        "metric": "a2c", "value": 1.0,
+        "cpu_metrics": {"host_pool_scaling": {"value": 3.0}},
+    }) + "\n")
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps({
+        "metric": "a2c", "value": 1.0,
+        "cpu_metrics": {
+            "pad_overhead": {
+                "value": 1.31,
+                "pallas": {
+                    "E7": {"overhead_x": 1.02},
+                    "E96": {"overhead_x": 1.05},
+                    "E200": {"overhead_x": 1.31},
+                },
+                "serving": {
+                    "n3": {"overhead_x": 1.11},
+                    "n5": {"overhead_x": 1.08},
+                },
+            },
+        },
+    }) + "\n")
+    # r03: present but malformed — the pallas group is a string, one
+    # serving pair lost its overhead_x, the other pair isn't a dict.
+    (tmp_path / "BENCH_r03.json").write_text(json.dumps({
+        "metric": "a2c", "value": 1.0,
+        "cpu_metrics": {
+            "pad_overhead": {
+                "value": 1.0,
+                "pallas": "oops",
+                "serving": {
+                    "n3": {"padded_us": 9.0},
+                    "n5": "oops",
+                },
+            },
+        },
+    }) + "\n")
+    (tmp_path / "BENCH_r04.json").write_text(json.dumps({
+        "metric": "a2c", "value": 1.0,
+        "cpu_metrics": {"pad_overhead": {"error": "rc=1"}},
+    }) + "\n")
+    rounds, rows = mod.trend_rows(str(tmp_path))
+    assert rounds == [1, 2, 3, 4]
+    table = dict(rows)
+    assert table["pad_overhead"] == ["-", "1.31", "1", "err"]
+    assert table["pad_overhead.pallas_E7"] == ["-", "1.02", "?", "err"]
+    assert table["pad_overhead.pallas_E96"] == ["-", "1.05", "?", "err"]
+    assert table["pad_overhead.pallas_E200"] == [
+        "-", "1.31", "?", "err",
+    ]
+    assert table["pad_overhead.serving_n3"] == ["-", "1.11", "?", "err"]
+    assert table["pad_overhead.serving_n5"] == ["-", "1.08", "?", "err"]
+    labels = [label for label, _ in rows]
+    i = labels.index("pad_overhead")
+    assert labels[i + 1:i + 6] == [
+        "pad_overhead.pallas_E7",
+        "pad_overhead.pallas_E96",
+        "pad_overhead.pallas_E200",
+        "pad_overhead.serving_n3",
+        "pad_overhead.serving_n5",
+    ]

@@ -4,6 +4,9 @@ matmuls in bf16, accumulation/optimizer in f32), and — ISSUE 19 — the
 `--update-dtype bf16` path lands same-seed eval parity with fp32 on every
 on-policy algo, mirroring the PR 8 replay-dtype parity suite."""
 
+import os
+import tempfile
+
 import jax
 import jax.numpy as jnp
 import pytest
@@ -44,6 +47,37 @@ def test_bf16_train_step_finite(mod, cfg, make_env):
 # policy converges to. Configs were tuned so the fp32 leg demonstrably
 # learns in a few seconds on CPU; thresholds mirror PR 8's
 # test_eval_parity_fp32_vs_mixed.
+#
+# The six training legs run behind ONE session-scoped fixture (ISSUE 20
+# satellite) under the ISSUE 4 persistent compilation cache: these legs
+# are compile-bound (~4 s XLA compile vs ~0.3 s of actual training per
+# leg on this 1-core host), so the steady-state tier-1 run deserializes
+# every leg's programs instead of recompiling them — measured 24 s cold
+# vs 10 s warm (~17 s clawed back from the second run onward). The
+# assertions are unchanged; only where the compiled programs come from
+# moved.
+
+_PARITY_CACHE_DIR = os.environ.get(
+    "BF16_PARITY_CACHE_DIR",
+    os.path.join(
+        tempfile.gettempdir(), "actor_critic_tpu_bf16_parity_cache"
+    ),
+)
+
+_PARITY_CFGS = {
+    "ppo": (ppo, lambda bf16: ppo.PPOConfig(
+        num_envs=32, rollout_steps=16, epochs=4, num_minibatches=2,
+        lr=3e-3, hidden=(32, 32), bf16_compute=bf16,
+    ), 120),
+    "a2c": (a2c, lambda bf16: a2c.A2CConfig(
+        num_envs=32, rollout_steps=16, lr=3e-3, hidden=(32, 32),
+        bf16_compute=bf16,
+    ), 200),
+    "impala": (impala, lambda bf16: impala.ImpalaConfig(
+        num_envs=32, rollout_steps=16, lr=3e-3, hidden=(32, 32),
+        bf16_compute=bf16,
+    ), 200),
+}
 
 
 def _train_and_eval(mod, env, cfg, iters, seed):
@@ -55,29 +89,35 @@ def _train_and_eval(mod, env, cfg, iters, seed):
     return float(eval_fn(state, jax.random.key(99), 32, 16))
 
 
+@pytest.fixture(scope="session")
+def bf16_parity_legs():
+    """Lazy per-algo trainer: `legs('ppo') -> {False: ret, True: ret}`,
+    each algo's two precision legs trained at most once per session,
+    all compiles routed through the persistent cache so repeat tier-1
+    runs skip straight to the ~0.3 s of actual training per leg."""
+    from actor_critic_tpu.utils import compile_cache
+
+    trained: dict = {}
+
+    def legs(algo: str) -> dict:
+        if algo not in trained:
+            mod, make_cfg, iters = _PARITY_CFGS[algo]
+            env = make_point_mass()
+            with compile_cache.temporary_cache(_PARITY_CACHE_DIR):
+                trained[algo] = {
+                    bf16: _train_and_eval(
+                        mod, env, make_cfg(bf16), iters, seed=0
+                    )
+                    for bf16 in (False, True)
+                }
+        return trained[algo]
+
+    return legs
+
+
 @pytest.mark.parametrize("algo", ["ppo", "a2c", "impala"])
-def test_eval_parity_fp32_vs_bf16(algo):
-    env = make_point_mass()
-    results = {}
-    for bf16 in (False, True):
-        if algo == "ppo":
-            cfg = ppo.PPOConfig(
-                num_envs=32, rollout_steps=16, epochs=4, num_minibatches=2,
-                lr=3e-3, hidden=(32, 32), bf16_compute=bf16,
-            )
-            results[bf16] = _train_and_eval(ppo, env, cfg, 120, seed=0)
-        elif algo == "a2c":
-            cfg = a2c.A2CConfig(
-                num_envs=32, rollout_steps=16, lr=3e-3, hidden=(32, 32),
-                bf16_compute=bf16,
-            )
-            results[bf16] = _train_and_eval(a2c, env, cfg, 200, seed=0)
-        else:
-            cfg = impala.ImpalaConfig(
-                num_envs=32, rollout_steps=16, lr=3e-3, hidden=(32, 32),
-                bf16_compute=bf16,
-            )
-            results[bf16] = _train_and_eval(impala, env, cfg, 200, seed=0)
+def test_eval_parity_fp32_vs_bf16(algo, bf16_parity_legs):
+    results = bf16_parity_legs(algo)
     assert results[False] > -1.0, results
     assert results[True] > -1.0, results
     assert abs(results[False] - results[True]) < 1.0, results

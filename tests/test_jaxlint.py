@@ -50,6 +50,9 @@ PAIRS = [
     ("precision-discipline", "precision_discipline"),
     ("nonfinite-hazard", "nonfinite_hazard"),
     ("sink-guard", "sink_guard"),
+    ("pad-mask-discipline", "pad_mask_discipline"),
+    ("mask-propagation", "mask_propagation"),
+    ("slice-before-commit", "slice_before_commit"),
 ]
 
 
@@ -452,7 +455,7 @@ def test_malformed_baseline_is_a_crash_not_a_clean_run(tmp_path):
 # ---------------------------------------------------------------------------
 
 
-def test_cli_list_checks_names_all_eighteen(capsys):
+def test_cli_list_checks_names_all_twenty_one(capsys):
     cli = _load_cli()
     assert cli.main(["--list-checks"]) == 0
     out = capsys.readouterr().out
@@ -463,6 +466,7 @@ def test_cli_list_checks_names_all_eighteen(capsys):
         "collective-discipline", "mailbox-protocol", "rank-affinity",
         "precision-discipline", "nonfinite-hazard", "sink-guard",
         "donation-discipline", "dispatch-granularity",
+        "pad-mask-discipline", "mask-propagation", "slice-before-commit",
     ):
         assert name in out
     # absorbed: no registered check is NAMED host-sync any more (the
@@ -693,6 +697,118 @@ def test_diff_mode_lints_only_changed_files(tmp_path, capsys):
         assert rc == 2
     finally:
         cli.REPO = old_repo
+
+
+# ---------------------------------------------------------------------------
+# --since mode (ISSUE 20 satellite): --diff + rev-parse + untracked +
+# fixture-pair re-lint
+# ---------------------------------------------------------------------------
+
+
+def test_since_mode_includes_untracked_files(tmp_path, capsys):
+    cli = _load_cli()
+    root = _scratch_repo(tmp_path)
+    old_repo = cli.REPO
+    cli.REPO = str(root)
+    try:
+        # a brand-new (never-committed) module: invisible to --diff,
+        # linted by --since
+        (root / "fresh.py").write_text(
+            "import jax\n"
+            "def f(seed):\n"
+            "    key = jax.random.key(seed)\n"
+            "    a = jax.random.normal(key, (2,))\n"
+            "    b = jax.random.uniform(key, (2,))\n"
+            "    return a + b\n"
+        )
+        rc = cli.main(["fresh.py", "--no-baseline", "--diff", "HEAD"])
+        out = capsys.readouterr().out
+        assert rc == 0 and "nothing to lint" in out
+        rc = cli.main(["fresh.py", "--no-baseline", "--since", "HEAD",
+                       "--json", "--skip", "warmup-registry"])
+        payload = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert {f["path"] for f in payload["new"]} == {"fresh.py"}
+    finally:
+        cli.REPO = old_repo
+
+
+def test_since_mode_resolves_revs_and_rejects_typos(tmp_path, capsys):
+    cli = _load_cli()
+    root = _scratch_repo(tmp_path)
+    old_repo = cli.REPO
+    cli.REPO = str(root)
+    try:
+        # a symbolic rev a plain `git diff` would also take — --since
+        # resolves it through rev-parse first, same answer
+        rc = cli.main(["clean.py", "--no-baseline", "--since", "HEAD"])
+        out = capsys.readouterr().out
+        assert rc == 0 and "nothing to lint" in out
+        rc = cli.main(["clean.py", "--no-baseline",
+                       "--since", "no-such-rev"])
+        err = capsys.readouterr().err
+        assert rc == 2 and "not a resolvable rev" in err
+        # --diff and --since together is a usage error, not a merge
+        rc = cli.main(["clean.py", "--no-baseline",
+                       "--since", "HEAD", "--diff", "HEAD"])
+        capsys.readouterr()
+        assert rc == 2
+    finally:
+        cli.REPO = old_repo
+
+
+def test_since_mode_fixture_pair_relints_the_pass_module(
+    tmp_path, capsys
+):
+    """A change touching ONLY a check's fixture pair re-lints the
+    module implementing that check: the fixture pins the pass's
+    flag/ok contract, so editing one without re-examining the other is
+    the drift --since exists to catch."""
+    import sys as _sys
+    import types
+
+    cli = _load_cli()
+    root = _scratch_repo(tmp_path)
+    (root / "passmod.py").write_text("z = 3\n")
+    import subprocess
+
+    git = ["git", "-C", str(root), "-c", "user.email=t@t",
+           "-c", "user.name=t"]
+    subprocess.run([*git, "add", "-A"], check=True)
+    subprocess.run([*git, "commit", "-qm", "pass module"], check=True)
+    # a registered check whose implementing module file lives in the
+    # scratch repo (the real registry's modules live outside it)
+    modname = "jaxlint_scratch_pass"
+    mod = types.ModuleType(modname)
+    mod.__file__ = str(root / "passmod.py")
+    _sys.modules[modname] = mod
+
+    def scratch_check(mod_info):
+        return []
+
+    scratch_check.__module__ = modname
+    analysis.core.register_check("scratch-pair", "test-only")(
+        scratch_check
+    )
+    old_repo = cli.REPO
+    cli.REPO = str(root)
+    try:
+        fixdir = root / "tests" / "jaxlint_fixtures"
+        fixdir.mkdir(parents=True)
+        (fixdir / "scratch_pair_flag.py").write_text("w = 4\n")
+        rc = cli.main(["passmod.py", "--no-baseline",
+                       "--since", "HEAD",
+                       "--skip", "warmup-registry"])
+        out = capsys.readouterr().out
+        # the fixture itself is outside the scanned paths, but its
+        # pass module was pulled in and linted (clean)
+        assert rc == 0
+        assert "nothing to lint" not in out
+        assert "0 new finding(s)" in out
+    finally:
+        cli.REPO = old_repo
+        analysis.core._CHECKS.pop("scratch-pair", None)
+        _sys.modules.pop(modname, None)
 
 
 # ---------------------------------------------------------------------------

@@ -230,6 +230,73 @@ def test_micro_batch_equivalence_at_mixed_request_sizes(ppo_serving):
         )
 
 
+def test_standby_backfill_rows_poisoned_do_not_leak(
+    ppo_serving, monkeypatch
+):
+    """Bucket backfill isolation (ISSUE 20 satellite): the standby rows
+    `pad_to_bucket` appends are dead weight, so poisoning them with
+    padsan's menu (NaN / ±3e38) must not move a single byte of the
+    first-n actions — the row-independent MLP plus act()'s [:n] slice
+    are the guard, and this pins them outside the sanitizer too."""
+    from actor_critic_tpu.utils import compile_cache
+
+    gw, engine, params, spec, cfg = ppo_serving
+    rng = np.random.default_rng(7)
+    orig = compile_cache.pad_to_bucket
+    for n, fill in ((3, np.nan), (5, 3.0e38), (6, -3.0e38)):
+        obs = rng.normal(size=(n, *spec.obs_shape)).astype(np.float32)
+        clean = engine.act(params, obs)
+
+        def poisoned(x, buckets, axis=0, _fill=fill):
+            out, mask = orig(x, buckets, axis)
+            out = np.array(out)
+            out[x.shape[0]:] = _fill
+            return out, mask
+
+        monkeypatch.setattr(compile_cache, "pad_to_bucket", poisoned)
+        dirty = engine.act(params, obs)
+        monkeypatch.setattr(compile_cache, "pad_to_bucket", orig)
+        assert dirty.shape[0] == n
+        assert clean.tobytes() == dirty.tobytes()
+
+
+def test_concurrent_mixed_sizes_match_batch1_bitwise(ppo_serving):
+    """Strictest no-cross-row-contamination contract (ISSUE 20
+    satellite): concurrent mixed-size requests, merged and padded
+    through the bucket ladder, must answer BITWISE what each row gets
+    from a batch-1 dispatch — not just the same size-n direct act."""
+    gw, engine, params, spec, cfg = ppo_serving
+    rng = np.random.default_rng(3)
+    sizes = (1, 3, 2, 1, 4)
+    payloads = [
+        rng.normal(size=(n, *spec.obs_shape)).astype(np.float32)
+        for n in sizes
+    ]
+    results: list = [None] * len(sizes)
+
+    def worker(i: int) -> None:
+        results[i] = _post(
+            gw.url + "/v1/act", {"obs": payloads[i].tolist()}
+        )
+
+    threads = [
+        threading.Thread(target=worker, args=(i,))
+        for i in range(len(sizes))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    for i, n in enumerate(sizes):
+        status, body = results[i]
+        assert status == 200, body
+        for j in range(n):
+            solo = engine.act(params, payloads[i][j:j + 1])
+            assert solo.shape[0] == 1
+            got = np.asarray(body["actions"], dtype=solo.dtype)[j]
+            assert got.tobytes() == solo[0].tobytes()
+
+
 def test_unknown_policy_and_bad_payloads(ppo_serving):
     gw, *_ = ppo_serving
     status, body = _post(gw.url + "/v1/act", {"obs": [0.0] * 4,

@@ -1,0 +1,246 @@
+"""Unit tests for the shape/padding lint dimension (ISSUE 20 static
+half): analysis/shape_model.py's per-scope padding flow and the three
+analysis/shapes.py passes' discharge rules, beyond what the fixture
+pairs in tests/test_jaxlint.py pin down.
+
+AST-only (nothing here imports the scanned source), CPU-safe, fast.
+"""
+
+import ast
+import textwrap
+
+from actor_critic_tpu import analysis
+from actor_critic_tpu.analysis import shape_model
+
+CHECKS = ("pad-mask-discipline", "mask-propagation", "slice-before-commit")
+
+
+def _mod(src: str) -> analysis.ModuleInfo:
+    return analysis.ModuleInfo("x.py", "x.py", textwrap.dedent(src))
+
+
+def _run(src: str, checks=CHECKS):
+    return analysis.run_checks([_mod(src)], checks=checks)
+
+
+def _flow(src: str, name: str) -> shape_model.ScopeFlow:
+    mod = _mod(src)
+    for flow in shape_model.module_flows(mod):
+        if shape_model.scope_name(flow.scope) == name:
+            return flow
+    raise AssertionError(f"no scope named {name}")
+
+
+# ---------------------------------------------------------------------------
+# shape model facts
+# ---------------------------------------------------------------------------
+
+
+def test_model_binds_producer_and_mask():
+    flow = _flow(
+        """
+        from actor_critic_tpu.utils.compile_cache import pad_to_bucket
+
+        def f(obs, buckets):
+            padded, mask = pad_to_bucket(obs, buckets)
+            return padded
+        """,
+        "f",
+    )
+    ret = [s for s in flow.stmts if isinstance(s, ast.Return)][0]
+    env = flow.env_before[id(ret)]
+    assert set(env) == {"padded"}
+    assert env["padded"].producer == "pad_to_bucket"
+    assert env["padded"].mask == "mask"
+    assert "mask" in flow.masks
+
+
+def test_model_discarded_mask_is_none():
+    flow = _flow(
+        """
+        from actor_critic_tpu.utils.compile_cache import pad_to_bucket
+
+        def f(obs, buckets):
+            padded, _ = pad_to_bucket(obs, buckets)
+            return padded
+        """,
+        "f",
+    )
+    ret = [s for s in flow.stmts if isinstance(s, ast.Return)][0]
+    assert flow.env_before[id(ret)]["padded"].mask is None
+
+
+def test_model_propagates_through_wrappers_and_clears_on_slice():
+    flow = _flow(
+        """
+        import jax
+        from actor_critic_tpu.utils.compile_cache import pad_to_bucket
+
+        def f(obs, buckets, n):
+            padded, _ = pad_to_bucket(obs, buckets)
+            staged = jax.device_put(padded)
+            valid = padded[:n]
+            return staged, valid
+        """,
+        "f",
+    )
+    ret = [s for s in flow.stmts if isinstance(s, ast.Return)][0]
+    env = flow.env_before[id(ret)]
+    assert "staged" in env  # preserving wrapper propagates the binding
+    assert "valid" not in env  # slice-back clears it
+    assert "padded" in flow.sliced
+
+
+def test_model_mixture_inline_mask_multiply_is_disciplined():
+    # the mixture obs contract: jnp.pad(...) * mask in ONE expression
+    flow = _flow(
+        """
+        import jax.numpy as jnp
+
+        def f(obs, widths, masks, i):
+            wide = jnp.pad(obs, (0, widths[i])) * masks[i]
+            return wide
+        """,
+        "f",
+    )
+    ret = [s for s in flow.stmts if isinstance(s, ast.Return)][0]
+    assert flow.env_before[id(ret)] == {}
+
+
+def test_model_rebind_clears_padded_fact():
+    flow = _flow(
+        """
+        import jax.numpy as jnp
+
+        def f(x, extra):
+            wide = jnp.pad(x, (0, extra))
+            wide = jnp.zeros_like(x)
+            return wide
+        """,
+        "f",
+    )
+    ret = [s for s in flow.stmts if isinstance(s, ast.Return)][0]
+    assert flow.env_before[id(ret)] == {}
+
+
+# ---------------------------------------------------------------------------
+# pass discharge rules
+# ---------------------------------------------------------------------------
+
+
+def test_wrapped_arg_still_flags_mask_propagation():
+    findings = _run(
+        """
+        import jax
+        from actor_critic_tpu.utils.compile_cache import pad_to_bucket
+
+        def f(program, params, obs, buckets):
+            padded, _ = pad_to_bucket(obs, buckets)
+            out = program(params, jax.device_put(padded))
+            return out
+        """
+    )
+    assert [f.check for f in findings] == ["mask-propagation"]
+
+
+def test_downstream_slice_discharges_mask_propagation():
+    findings = _run(
+        """
+        import numpy as np
+        from actor_critic_tpu.utils.compile_cache import pad_to_bucket
+
+        def f(program, params, obs, buckets, n):
+            padded, _ = pad_to_bucket(obs, buckets)
+            out = program(params, padded)
+            return np.asarray(out)[:n]
+        """
+    )
+    assert findings == []
+
+
+def test_where_keyword_discharges_pad_mask_discipline():
+    findings = _run(
+        """
+        import jax.numpy as jnp
+        from actor_critic_tpu.utils.compile_cache import pad_to_bucket
+
+        def f(obs, buckets):
+            padded, mask = pad_to_bucket(obs, buckets)
+            return jnp.mean(padded, where=mask > 0.5)
+        """
+    )
+    assert findings == []
+
+
+def test_method_form_reduction_is_flagged():
+    findings = _run(
+        """
+        from actor_critic_tpu.utils.compile_cache import pad_to_bucket
+
+        def f(obs, buckets):
+            padded, mask = pad_to_bucket(obs, buckets)
+            return padded.mean()
+        """
+    )
+    assert [f.check for f in findings] == ["pad-mask-discipline"]
+
+
+def test_commit_callee_belongs_to_slice_before_commit_only():
+    findings = _run(
+        """
+        from actor_critic_tpu.utils.compile_cache import pad_to_bucket
+
+        def f(ring, obs, buckets):
+            padded, _ = pad_to_bucket(obs, buckets)
+            ring.put(padded, version=1)
+        """
+    )
+    assert [f.check for f in findings] == ["slice-before-commit"]
+
+
+def test_producer_def_bodies_are_exempt():
+    # pad helpers construct the pad on purpose; their own internals
+    # must not self-flag
+    findings = _run(
+        """
+        import jax.numpy as jnp
+
+        def _pad_lanes(Ep, *arrays):
+            out = []
+            for a in arrays:
+                wide = jnp.pad(a, ((0, 0), (0, Ep - a.shape[-1])))
+                out.append(wide)
+            return out
+        """
+    )
+    assert findings == []
+
+
+def test_inline_suppression_covers_the_deliberate_site():
+    findings = _run(
+        """
+        from actor_critic_tpu.utils.compile_cache import pad_to_bucket
+
+        def f(program, params, obs, buckets):
+            padded, _ = pad_to_bucket(obs, buckets)
+            # jaxlint: disable=mask-propagation (timing-only dispatch)
+            out = program(params, padded)
+            return out
+        """
+    )
+    assert findings == []
+
+
+def test_library_elementwise_calls_do_not_flag():
+    findings = _run(
+        """
+        import jax.numpy as jnp
+        from actor_critic_tpu.utils.compile_cache import pad_to_bucket
+
+        def f(obs, buckets, n):
+            padded, _ = pad_to_bucket(obs, buckets)
+            scaled = jnp.tanh(padded)
+            return scaled[:n]
+        """
+    )
+    assert findings == []
